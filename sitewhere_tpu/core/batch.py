@@ -1,0 +1,199 @@
+"""Columnar event batches — the hot-path representation.
+
+TPU-first design decision (SURVEY.md §7 step 1): the ingest→score path moves
+structs-of-arrays, not lists of objects. A ``MeasurementBatch`` holds device
+measurements as parallel numpy arrays (stream id, value, timestamps) so that:
+
+- the micro-batcher can concatenate/pad/bucket without Python loops,
+- host→TPU transfer is a handful of contiguous arrays,
+- the windowed scoring step is a single gather/scatter + model apply
+  under ``jit`` (see ``pipeline.inference``).
+
+``stream_id`` identifies a (device, measurement-name) series — assigned by
+the device registry at inbound-processing time — and indexes directly into
+the on-device window state (``ops.windows``). Object-shaped events
+(``core.events.DeviceMeasurement``) are materialized only at the edges
+(REST, outbound connectors, event store rows).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from sitewhere_tpu.core.events import DeviceMeasurement
+
+
+@dataclass(slots=True)
+class MeasurementBatch:
+    """A columnar batch of device measurements for one tenant.
+
+    Invariant: all arrays share length ``n``. ``pad_to`` produces bucketed
+    static shapes for XLA (padding rows carry ``valid == False``).
+    """
+
+    tenant: str
+    stream_ids: np.ndarray      # int32 [n]  (device,measurement) series index
+    values: np.ndarray          # float32 [n]
+    event_ts: np.ndarray        # float64 [n] epoch ms (device time)
+    received_ts: np.ndarray     # float64 [n] epoch ms (ingest time)
+    valid: np.ndarray           # bool [n]  False on padding rows
+    # edge-materialization support: original event ids / tokens (object dtype
+    # kept host-side only; never shipped to device)
+    event_ids: Optional[np.ndarray] = None     # object [n]
+    device_tokens: Optional[np.ndarray] = None  # object [n]
+    names: Optional[np.ndarray] = None          # object [n]
+
+    @property
+    def n(self) -> int:
+        return int(self.stream_ids.shape[0])
+
+    @property
+    def n_valid(self) -> int:
+        return int(self.valid.sum())
+
+    @staticmethod
+    def empty(tenant: str = "default") -> "MeasurementBatch":
+        return MeasurementBatch(
+            tenant=tenant,
+            stream_ids=np.zeros((0,), np.int32),
+            values=np.zeros((0,), np.float32),
+            event_ts=np.zeros((0,), np.float64),
+            received_ts=np.zeros((0,), np.float64),
+            valid=np.zeros((0,), bool),
+        )
+
+    @staticmethod
+    def from_arrays(
+        tenant: str,
+        stream_ids: np.ndarray,
+        values: np.ndarray,
+        event_ts: Optional[np.ndarray] = None,
+        received_ts: Optional[np.ndarray] = None,
+    ) -> "MeasurementBatch":
+        n = int(np.asarray(stream_ids).shape[0])
+        ts = np.full((n,), time.time() * 1000.0, np.float64)
+        return MeasurementBatch(
+            tenant=tenant,
+            stream_ids=np.asarray(stream_ids, np.int32),
+            values=np.asarray(values, np.float32),
+            event_ts=ts if event_ts is None else np.asarray(event_ts, np.float64),
+            received_ts=ts if received_ts is None else np.asarray(received_ts, np.float64),
+            valid=np.ones((n,), bool),
+        )
+
+    @staticmethod
+    def from_events(
+        events: Sequence[DeviceMeasurement],
+        stream_ids: Sequence[int],
+        tenant: str = "default",
+    ) -> "MeasurementBatch":
+        n = len(events)
+        return MeasurementBatch(
+            tenant=tenant,
+            stream_ids=np.asarray(stream_ids, np.int32),
+            values=np.asarray([e.value for e in events], np.float32),
+            event_ts=np.asarray([e.event_ts for e in events], np.float64),
+            received_ts=np.asarray([e.received_ts for e in events], np.float64),
+            valid=np.ones((n,), bool),
+            event_ids=np.asarray([e.id for e in events], object),
+            device_tokens=np.asarray([e.device_token for e in events], object),
+            names=np.asarray([e.name for e in events], object),
+        )
+
+    @staticmethod
+    def concat(batches: Iterable["MeasurementBatch"]) -> "MeasurementBatch":
+        bs: List[MeasurementBatch] = [b for b in batches if b.n]
+        if not bs:
+            return MeasurementBatch.empty()
+        any_obj = any(b.event_ids is not None for b in bs)
+
+        def _cat_obj(col: str) -> Optional[np.ndarray]:
+            # preserve identity columns row-aligned even when some inputs
+            # lack them (those rows get ""), rather than dropping the column
+            if not any_obj:
+                return None
+            parts = []
+            for b in bs:
+                a = getattr(b, col)
+                parts.append(a if a is not None else np.full((b.n,), "", object))
+            return np.concatenate(parts)
+
+        return MeasurementBatch(
+            tenant=bs[0].tenant,
+            stream_ids=np.concatenate([b.stream_ids for b in bs]),
+            values=np.concatenate([b.values for b in bs]),
+            event_ts=np.concatenate([b.event_ts for b in bs]),
+            received_ts=np.concatenate([b.received_ts for b in bs]),
+            valid=np.concatenate([b.valid for b in bs]),
+            event_ids=_cat_obj("event_ids"),
+            device_tokens=_cat_obj("device_tokens"),
+            names=_cat_obj("names"),
+        )
+
+    def pad_to(self, size: int) -> "MeasurementBatch":
+        """Pad (with invalid rows) to a bucketed static shape for XLA.
+
+        Padding rows point at stream 0 with value 0; they still flow through
+        the jitted step (branchless) but their window-state writes are masked
+        and their scores discarded (``valid`` mask).
+        """
+        n = self.n
+        if n == size:
+            return self
+        if n > size:
+            raise ValueError(f"batch of {n} cannot pad to {size}")
+        pad = size - n
+
+        def _pad(a: np.ndarray, fill: float = 0.0) -> np.ndarray:
+            return np.concatenate([a, np.full((pad,), fill, a.dtype)])
+
+        def _pad_obj(a: Optional[np.ndarray]) -> Optional[np.ndarray]:
+            if a is None:
+                return None
+            return np.concatenate([a, np.full((pad,), "", object)])
+
+        return MeasurementBatch(
+            tenant=self.tenant,
+            stream_ids=_pad(self.stream_ids),
+            values=_pad(self.values),
+            event_ts=_pad(self.event_ts),
+            received_ts=_pad(self.received_ts),
+            valid=np.concatenate([self.valid, np.zeros((pad,), bool)]),
+            event_ids=_pad_obj(self.event_ids),
+            device_tokens=_pad_obj(self.device_tokens),
+            names=_pad_obj(self.names),
+        )
+
+    def take(self, n: int) -> "tuple[MeasurementBatch, MeasurementBatch]":
+        """Split into (first n rows, rest) — used by the micro-batcher."""
+
+        def cut(a: Optional[np.ndarray], lo: int, hi: Optional[int]) -> Optional[np.ndarray]:
+            return None if a is None else a[lo:hi]
+
+        head = MeasurementBatch(
+            tenant=self.tenant,
+            stream_ids=self.stream_ids[:n],
+            values=self.values[:n],
+            event_ts=self.event_ts[:n],
+            received_ts=self.received_ts[:n],
+            valid=self.valid[:n],
+            event_ids=cut(self.event_ids, 0, n),
+            device_tokens=cut(self.device_tokens, 0, n),
+            names=cut(self.names, 0, n),
+        )
+        tail = MeasurementBatch(
+            tenant=self.tenant,
+            stream_ids=self.stream_ids[n:],
+            values=self.values[n:],
+            event_ts=self.event_ts[n:],
+            received_ts=self.received_ts[n:],
+            valid=self.valid[n:],
+            event_ids=cut(self.event_ids, n, None),
+            device_tokens=cut(self.device_tokens, n, None),
+            names=cut(self.names, n, None),
+        )
+        return head, tail

@@ -1,0 +1,304 @@
+"""Device event model — the six event types every pipeline stage speaks.
+
+Capability parity with the reference event SPI
+(``com.sitewhere.spi.device.event.IDeviceMeasurement / IDeviceLocation /
+IDeviceAlert / IDeviceCommandInvocation / IDeviceCommandResponse /
+IDeviceStateChange`` — SURVEY.md §2.1 [U]; reference mount empty, see
+provenance banner), redesigned as slotted dataclasses with dict/JSON round
+trips so the hot path can stay columnar (see ``core.batch``) while the API
+surface stays object-shaped.
+
+Design note (TPU-first): individual event objects are the *edge*
+representation (REST, connectors, rules). The ingest→score hot path moves
+``MeasurementBatch`` structs-of-arrays instead; objects are materialized only
+where a human-facing API needs them.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional, Type
+
+
+class EventType(str, enum.Enum):
+    """Discriminator for the six device event kinds."""
+
+    MEASUREMENT = "measurement"
+    LOCATION = "location"
+    ALERT = "alert"
+    COMMAND_INVOCATION = "command_invocation"
+    COMMAND_RESPONSE = "command_response"
+    STATE_CHANGE = "state_change"
+
+
+class AlertLevel(str, enum.Enum):
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+    CRITICAL = "critical"
+
+
+def new_event_id() -> str:
+    return uuid.uuid4().hex
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+@dataclass(slots=True)
+class DeviceEvent:
+    """Common envelope carried by every event.
+
+    ``event_ts`` is device time, ``received_ts`` ingestion time; per-stage
+    timestamps for latency tracing ride in ``trace`` (stage name → ms), which
+    is how the rebuild makes p99 latency a first-class, per-event observable
+    (SURVEY.md §5 "tracing").
+    """
+
+    id: str = field(default_factory=new_event_id)
+    device_token: str = ""
+    assignment_token: str = ""
+    tenant: str = "default"
+    area_token: str = ""
+    asset_token: str = ""
+    customer_token: str = ""
+    event_ts: int = field(default_factory=now_ms)
+    received_ts: int = field(default_factory=now_ms)
+    metadata: Dict[str, str] = field(default_factory=dict)
+    trace: Dict[str, float] = field(default_factory=dict)
+
+    EVENT_TYPE: EventType = field(default=EventType.MEASUREMENT, repr=False)
+
+    def mark(self, stage: str) -> None:
+        """Record a pipeline-stage timestamp (epoch ms, float) on the event."""
+        self.trace[stage] = time.time() * 1000.0
+
+    # -- serde -----------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "id": self.id,
+            "type": self.EVENT_TYPE.value,
+            "device_token": self.device_token,
+            "assignment_token": self.assignment_token,
+            "tenant": self.tenant,
+            "area_token": self.area_token,
+            "asset_token": self.asset_token,
+            "customer_token": self.customer_token,
+            "event_ts": self.event_ts,
+            "received_ts": self.received_ts,
+            "metadata": dict(self.metadata),
+        }
+        if self.trace:
+            d["trace"] = dict(self.trace)
+        d.update(self._payload_dict())
+        return d
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), separators=(",", ":"))
+
+    @classmethod
+    def _common_kwargs(cls, d: Mapping[str, Any]) -> Dict[str, Any]:
+        return {
+            "id": d.get("id") or new_event_id(),
+            "device_token": d.get("device_token", ""),
+            "assignment_token": d.get("assignment_token", ""),
+            "tenant": d.get("tenant", "default"),
+            "area_token": d.get("area_token", ""),
+            "asset_token": d.get("asset_token", ""),
+            "customer_token": d.get("customer_token", ""),
+            "event_ts": int(d.get("event_ts", now_ms())),
+            "received_ts": int(d.get("received_ts", now_ms())),
+            "metadata": dict(d.get("metadata", {})),
+            "trace": dict(d.get("trace", {})),
+        }
+
+
+@dataclass(slots=True)
+class DeviceMeasurement(DeviceEvent):
+    """A named scalar sample — the hot-path event type that gets TPU-scored."""
+
+    name: str = ""
+    value: float = 0.0
+    score: Optional[float] = None  # anomaly score attached by tpu-inference
+
+    EVENT_TYPE: EventType = field(default=EventType.MEASUREMENT, repr=False)
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "value": self.value}
+        if self.score is not None:
+            d["score"] = self.score
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeviceMeasurement":
+        return cls(
+            name=str(d.get("name", "")),
+            value=float(d.get("value", 0.0)),
+            score=(float(d["score"]) if d.get("score") is not None else None),
+            **cls._common_kwargs(d),
+        )
+
+
+@dataclass(slots=True)
+class DeviceLocation(DeviceEvent):
+    latitude: float = 0.0
+    longitude: float = 0.0
+    elevation: float = 0.0
+
+    EVENT_TYPE: EventType = field(default=EventType.LOCATION, repr=False)
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        return {
+            "latitude": self.latitude,
+            "longitude": self.longitude,
+            "elevation": self.elevation,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeviceLocation":
+        return cls(
+            latitude=float(d.get("latitude", 0.0)),
+            longitude=float(d.get("longitude", 0.0)),
+            elevation=float(d.get("elevation", 0.0)),
+            **cls._common_kwargs(d),
+        )
+
+
+@dataclass(slots=True)
+class DeviceAlert(DeviceEvent):
+    source: str = "device"
+    level: AlertLevel = AlertLevel.INFO
+    alert_type: str = ""
+    message: str = ""
+
+    EVENT_TYPE: EventType = field(default=EventType.ALERT, repr=False)
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        return {
+            "source": self.source,
+            "level": self.level.value,
+            "alert_type": self.alert_type,
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeviceAlert":
+        return cls(
+            source=str(d.get("source", "device")),
+            level=AlertLevel(d.get("level", "info")),
+            alert_type=str(d.get("alert_type", "")),
+            message=str(d.get("message", "")),
+            **cls._common_kwargs(d),
+        )
+
+
+@dataclass(slots=True)
+class DeviceCommandInvocation(DeviceEvent):
+    command_token: str = ""
+    initiator: str = "rest"  # rest | rule | schedule | batch
+    initiator_id: str = ""
+    target: str = "assignment"
+    parameters: Dict[str, str] = field(default_factory=dict)
+
+    EVENT_TYPE: EventType = field(
+        default=EventType.COMMAND_INVOCATION, repr=False
+    )
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        return {
+            "command_token": self.command_token,
+            "initiator": self.initiator,
+            "initiator_id": self.initiator_id,
+            "target": self.target,
+            "parameters": dict(self.parameters),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeviceCommandInvocation":
+        return cls(
+            command_token=str(d.get("command_token", "")),
+            initiator=str(d.get("initiator", "rest")),
+            initiator_id=str(d.get("initiator_id", "")),
+            target=str(d.get("target", "assignment")),
+            parameters=dict(d.get("parameters", {})),
+            **cls._common_kwargs(d),
+        )
+
+
+@dataclass(slots=True)
+class DeviceCommandResponse(DeviceEvent):
+    originating_event_id: str = ""
+    response: str = ""
+
+    EVENT_TYPE: EventType = field(default=EventType.COMMAND_RESPONSE, repr=False)
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        return {
+            "originating_event_id": self.originating_event_id,
+            "response": self.response,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeviceCommandResponse":
+        return cls(
+            originating_event_id=str(d.get("originating_event_id", "")),
+            response=str(d.get("response", "")),
+            **cls._common_kwargs(d),
+        )
+
+
+@dataclass(slots=True)
+class DeviceStateChange(DeviceEvent):
+    attribute: str = ""
+    state_type: str = ""
+    previous_state: str = ""
+    new_state: str = ""
+
+    EVENT_TYPE: EventType = field(default=EventType.STATE_CHANGE, repr=False)
+
+    def _payload_dict(self) -> Dict[str, Any]:
+        return {
+            "attribute": self.attribute,
+            "state_type": self.state_type,
+            "previous_state": self.previous_state,
+            "new_state": self.new_state,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeviceStateChange":
+        return cls(
+            attribute=str(d.get("attribute", "")),
+            state_type=str(d.get("state_type", "")),
+            previous_state=str(d.get("previous_state", "")),
+            new_state=str(d.get("new_state", "")),
+            **cls._common_kwargs(d),
+        )
+
+
+_EVENT_CLASSES: Dict[EventType, Type[DeviceEvent]] = {
+    EventType.MEASUREMENT: DeviceMeasurement,
+    EventType.LOCATION: DeviceLocation,
+    EventType.ALERT: DeviceAlert,
+    EventType.COMMAND_INVOCATION: DeviceCommandInvocation,
+    EventType.COMMAND_RESPONSE: DeviceCommandResponse,
+    EventType.STATE_CHANGE: DeviceStateChange,
+}
+
+
+def event_from_dict(d: Mapping[str, Any]) -> DeviceEvent:
+    """Reconstruct a typed event from its dict form (inverse of to_dict)."""
+    etype = EventType(d.get("type", "measurement"))
+    cls = _EVENT_CLASSES[etype]
+    return cls.from_dict(d)  # type: ignore[attr-defined]
+
+
+def event_from_json(s: str) -> DeviceEvent:
+    return event_from_dict(json.loads(s))

@@ -1,0 +1,193 @@
+"""Device-and-tenant domain model.
+
+Capability parity with the reference device SPI
+(``com.sitewhere.spi.device.IDevice / IDeviceType / IDeviceAssignment``,
+areas/customers/zones/groups, assets, tenants, users — SURVEY.md §2.1 [U];
+reference mount empty, see provenance banner). Plain slotted dataclasses with
+dict round-trips; persistence lives in ``services.*`` behind store interfaces.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+
+def new_token(prefix: str = "") -> str:
+    t = uuid.uuid4().hex[:12]
+    return f"{prefix}-{t}" if prefix else t
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class DeviceStatus(str, enum.Enum):
+    ACTIVE = "active"
+    MISSING = "missing"
+    DECOMMISSIONED = "decommissioned"
+
+
+class AssignmentStatus(str, enum.Enum):
+    ACTIVE = "active"
+    MISSING = "missing"
+    RELEASED = "released"
+
+
+@dataclass(slots=True)
+class _Entity:
+    """Shared shape for tokened, metadata-bearing domain entities."""
+
+    token: str = field(default_factory=new_token)
+    name: str = ""
+    description: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+    created_ts: int = field(default_factory=now_ms)
+    updated_ts: int = field(default_factory=now_ms)
+
+    def touch(self) -> None:
+        self.updated_ts = now_ms()
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in self.__dataclass_fields__:  # type: ignore[attr-defined]
+            v = getattr(self, f)
+            if isinstance(v, enum.Enum):
+                v = v.value
+            out[f] = v
+        return out
+
+
+@dataclass(slots=True)
+class DeviceCommand(_Entity):
+    """A command a device type understands (namespace + typed parameters)."""
+
+    namespace: str = "default"
+    parameters: List[Dict[str, str]] = field(default_factory=list)
+    # each parameter: {"name": ..., "type": "string|double|int64|bool", "required": "true|false"}
+
+
+@dataclass(slots=True)
+class DeviceType(_Entity):
+    container_policy: str = "standalone"  # standalone | composite
+    image_url: str = ""
+    commands: List[DeviceCommand] = field(default_factory=list)
+
+    def command_by_token(self, token: str) -> Optional[DeviceCommand]:
+        for c in self.commands:
+            if c.token == token:
+                return c
+        return None
+
+
+@dataclass(slots=True)
+class Device(_Entity):
+    device_type_token: str = ""
+    status: DeviceStatus = DeviceStatus.ACTIVE
+    comments: str = ""
+    parent_device_token: str = ""  # composite containment
+
+
+@dataclass(slots=True)
+class DeviceAssignment(_Entity):
+    """Binding of a device to (customer, area, asset) for a period of time."""
+
+    device_token: str = ""
+    customer_token: str = ""
+    area_token: str = ""
+    asset_token: str = ""
+    status: AssignmentStatus = AssignmentStatus.ACTIVE
+    active_date: int = field(default_factory=now_ms)
+    released_date: Optional[int] = None
+
+    def release(self) -> None:
+        self.status = AssignmentStatus.RELEASED
+        self.released_date = now_ms()
+        self.touch()
+
+
+@dataclass(slots=True)
+class Area(_Entity):
+    area_type_token: str = ""
+    parent_token: str = ""
+    bounds: List[Tuple[float, float]] = field(default_factory=list)  # lat/lon polygon
+
+
+@dataclass(slots=True)
+class Zone(_Entity):
+    area_token: str = ""
+    bounds: List[Tuple[float, float]] = field(default_factory=list)
+    border_color: str = "#ff0000"
+    fill_color: str = "#ff000080"
+
+
+@dataclass(slots=True)
+class Customer(_Entity):
+    customer_type_token: str = ""
+    parent_token: str = ""
+
+
+@dataclass(slots=True)
+class AssetType(_Entity):
+    asset_category: str = "device"  # device | person | hardware | location
+
+
+@dataclass(slots=True)
+class Asset(_Entity):
+    asset_type_token: str = ""
+    image_url: str = ""
+
+
+@dataclass(slots=True)
+class DeviceGroupElement:
+    group_token: str = ""
+    device_token: str = ""       # exactly one of device_token / nested_group_token
+    nested_group_token: str = ""
+    roles: List[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class DeviceGroup(_Entity):
+    roles: List[str] = field(default_factory=list)
+    elements: List[DeviceGroupElement] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class Tenant(_Entity):
+    """A tenant: isolation unit for engines, data, models and mesh placement.
+
+    ``mesh_shard`` is the rebuild-specific field: which shard along the TPU
+    mesh's tenant axis this tenant's models live on (BASELINE.json north star:
+    tenant→mesh-axis router; -1 = unplaced).
+    """
+
+    auth_token: str = field(default_factory=lambda: new_token("auth"))
+    template: str = "default"
+    logo_url: str = ""
+    mesh_shard: int = -1
+
+
+@dataclass(slots=True)
+class User:
+    username: str = ""
+    # salted SHA-256; never store plaintext (reference: jjwt-based user mgmt [U])
+    password_hash: str = ""
+    salt: str = field(default_factory=lambda: uuid.uuid4().hex)
+    first_name: str = ""
+    last_name: str = ""
+    authorities: List[str] = field(default_factory=list)
+    enabled: bool = True
+    created_ts: int = field(default_factory=now_ms)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "username": self.username,
+            "first_name": self.first_name,
+            "last_name": self.last_name,
+            "authorities": list(self.authorities),
+            "enabled": self.enabled,
+            "created_ts": self.created_ts,
+        }

@@ -1,0 +1,33 @@
+"""L2 runtime chassis: lifecycle trees, event bus, tenant engines, config."""
+
+from sitewhere_tpu.runtime.lifecycle import (
+    LifecycleComponent,
+    LifecycleException,
+    LifecycleState,
+)
+from sitewhere_tpu.runtime.bus import EventBus, Topic, TopicNaming
+from sitewhere_tpu.runtime.config import (
+    InstanceConfig,
+    MicroserviceConfig,
+    TenantEngineConfig,
+)
+from sitewhere_tpu.runtime.metrics import Counter, Gauge, Histogram, MetricsRegistry
+from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
+
+__all__ = [
+    "Counter",
+    "EventBus",
+    "Gauge",
+    "Histogram",
+    "InstanceConfig",
+    "LifecycleComponent",
+    "LifecycleException",
+    "LifecycleState",
+    "MetricsRegistry",
+    "MicroserviceConfig",
+    "MultitenantService",
+    "TenantEngine",
+    "TenantEngineConfig",
+    "Topic",
+    "TopicNaming",
+]

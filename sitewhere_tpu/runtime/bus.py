@@ -1,0 +1,295 @@
+"""Topic-named async event bus — the Kafka-shaped backbone.
+
+Capability parity with the reference's Kafka plumbing
+(``MicroserviceKafkaConsumer/Producer`` + ``KafkaTopicNaming`` in
+``sitewhere-microservice`` — SURVEY.md §2.1/§5 [U]; reference mount empty,
+see provenance banner). Kafka semantics preserved where they matter:
+
+- named topics with instance/tenant-scoped naming (``TopicNaming``),
+- append-only per-topic logs with monotonically increasing offsets,
+- consumer groups: each group has ONE cursor per topic; multiple consumers
+  in a group share (compete for) the cursor — scale-out parity,
+- replay: a group may seek to any retained offset (crash-resume and the
+  event-management replay config [B:9] depend on this),
+- bounded retention + backpressure (awaitable publish when a topic is full),
+- fault-injection hooks (drop / delay / duplicate) for chaos tests
+  (SURVEY.md §5 failure detection — rebuild adds what the reference lacks).
+
+Redesign notes: single-process asyncio replaces brokers; payloads are
+arbitrary Python objects (columnar ``MeasurementBatch`` on the hot path — no
+serialization cost in-proc). A Kafka-backed implementation can slot in behind
+the same interface later.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+
+class TopicNaming:
+    """Instance/tenant-scoped topic names (reference: KafkaTopicNaming [U])."""
+
+    def __init__(self, instance_id: str = "sw") -> None:
+        self.instance_id = instance_id
+
+    def global_topic(self, name: str) -> str:
+        return f"{self.instance_id}.global.{name}"
+
+    def tenant_topic(self, tenant: str, name: str) -> str:
+        return f"{self.instance_id}.tenant.{tenant}.{name}"
+
+    # canonical pipeline topics (SURVEY.md §3.1)
+    def decoded_events(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "event-source-decoded-events")
+
+    def failed_decode(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "event-source-failed-decode")
+
+    def inbound_events(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "inbound-events")
+
+    def scored_events(self, tenant: str) -> str:
+        # rebuild-only: output of the tpu-inference stage (BASELINE.json:5)
+        return self.tenant_topic(tenant, "tpu-scored-events")
+
+    def persisted_events(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "outbound-events")
+
+    def unregistered_devices(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "unregistered-device-events")
+
+    def command_invocations(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "command-invocations")
+
+    def undelivered_commands(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "undelivered-command-invocations")
+
+    def tenant_model_updates(self) -> str:
+        return self.global_topic("tenant-model-updates")
+
+
+@dataclass
+class FaultPlan:
+    """Fault injection knobs for tests (drop/delay/duplicate)."""
+
+    drop_p: float = 0.0
+    dup_p: float = 0.0
+    delay_s: float = 0.0
+    rng: random.Random = field(default_factory=lambda: random.Random(0))
+
+
+class Topic:
+    """Append-only log with offset-addressed reads and group cursors."""
+
+    def __init__(self, name: str, retention: int = 65536) -> None:
+        self.name = name
+        self.retention = retention
+        # list + head index: O(1) random access (deque indexing is O(n)),
+        # amortized-O(1) eviction via periodic compaction
+        self._log: List[Tuple[int, Any]] = []
+        self._head = 0
+        self._next_offset = 0
+        self._data_event = asyncio.Event()
+        self._space_event = asyncio.Event()
+        self._space_event.set()
+        self.group_offsets: Dict[str, int] = {}
+        self.fault: Optional[FaultPlan] = None
+
+    def _live_len(self) -> int:
+        return len(self._log) - self._head
+
+    def _evict_oldest(self) -> None:
+        self._head += 1
+        if self._head >= 1024 and self._head * 2 >= len(self._log):
+            del self._log[: self._head]
+            self._head = 0
+
+    # -- producer side ---------------------------------------------------
+    def _oldest_still_needed(self) -> bool:
+        """True if some registered group hasn't consumed the oldest entry.
+
+        Retention is independent of consumption (Kafka semantics): the log
+        keeps up to ``retention`` entries for late joiners / replay. But
+        where Kafka would *lose* data past retention, the in-proc bus
+        backpressures producers as long as a subscribed group still needs
+        the would-be-evicted entry.
+        """
+        if self._live_len() == 0 or not self.group_offsets:
+            return False
+        return min(self.group_offsets.values()) <= self._log[self._head][0]
+
+    async def publish(self, payload: Any) -> int:
+        """Append; backpressures while full AND a group needs the oldest."""
+        if self.fault is not None:
+            f = self.fault
+            if f.delay_s:
+                await asyncio.sleep(f.delay_s)
+            if f.drop_p and f.rng.random() < f.drop_p:
+                return self._next_offset  # silently dropped
+            if f.dup_p and f.rng.random() < f.dup_p:
+                await self._publish_one(payload)
+        return await self._publish_one(payload)
+
+    async def _publish_one(self, payload: Any) -> int:
+        while self._live_len() >= self.retention and self._oldest_still_needed():
+            self._space_event.clear()
+            await self._space_event.wait()
+        if self._live_len() >= self.retention:
+            self._evict_oldest()  # retention eviction (no group needs it)
+        return self._append(payload)
+
+    def publish_nowait(self, payload: Any) -> int:
+        """Non-blocking append; evicts oldest beyond retention (lossy)."""
+        if self._live_len() >= self.retention:
+            self._evict_oldest()
+        return self._append(payload)
+
+    def _append(self, payload: Any) -> int:
+        off = self._next_offset
+        self._next_offset += 1
+        self._log.append((off, payload))
+        self._data_event.set()
+        return off
+
+    # -- consumer side ---------------------------------------------------
+    @property
+    def latest_offset(self) -> int:
+        return self._next_offset
+
+    @property
+    def earliest_retained(self) -> int:
+        return (
+            self._log[self._head][0]
+            if self._live_len()
+            else self._next_offset
+        )
+
+    def subscribe(self, group: str, at: str = "earliest") -> None:
+        """Register a consumer group cursor ahead of any poll.
+
+        Registration is what makes a group count for backpressure; a group
+        that first appears at poll time starts at the earliest retained
+        offset (like a Kafka auto-offset-reset).
+        """
+        if group not in self.group_offsets:
+            self.group_offsets[group] = (
+                self.earliest_retained if at == "earliest" else self.latest_offset
+            )
+
+    def seek(self, group: str, offset: int) -> None:
+        self.group_offsets[group] = max(offset, 0)
+        # seeking past the oldest entry may release a backpressured producer
+        if not self._oldest_still_needed():
+            self._space_event.set()
+
+    def unsubscribe(self, group: str) -> None:
+        """Deregister a group; may release a backpressured producer."""
+        self.group_offsets.pop(group, None)
+        if not self._oldest_still_needed():
+            self._space_event.set()
+
+    def committed(self, group: str) -> int:
+        return self.group_offsets.get(group, 0)
+
+    def lag(self, group: str) -> int:
+        return self.latest_offset - self.committed(group)
+
+    async def poll(
+        self, group: str, max_items: int = 256, timeout_s: Optional[float] = None
+    ) -> List[Any]:
+        """Fetch up to ``max_items`` past the group cursor; advances cursor.
+
+        Returns [] on timeout. Items older than retention are skipped (the
+        cursor jumps to earliest retained, like a Kafka out-of-range reset).
+        """
+        if group not in self.group_offsets:
+            self.group_offsets[group] = self.earliest_retained
+        while True:
+            cur = max(self.group_offsets[group], self.earliest_retained)
+            # offsets in the log are dense, so the entry at offset ``cur``
+            # sits at index head + (cur - earliest) — O(items), not a scan
+            start = self._head + (cur - self.earliest_retained)
+            stop = min(start + max_items, len(self._log))
+            items: List[Any] = [payload for _, payload in self._log[start:stop]]
+            if items:
+                cur = self._log[stop - 1][0] + 1
+            if items:
+                self.group_offsets[group] = cur
+                if not self._oldest_still_needed():
+                    self._space_event.set()
+                return items
+            self._data_event.clear()
+            if timeout_s == 0:
+                return []
+            try:
+                await asyncio.wait_for(self._data_event.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                return []
+
+
+
+class EventBus:
+    """Registry of topics + convenience pub/sub API."""
+
+    def __init__(self, naming: Optional[TopicNaming] = None, retention: int = 65536) -> None:
+        self.naming = naming or TopicNaming()
+        self.retention = retention
+        self._topics: Dict[str, Topic] = {}
+
+    def topic(self, name: str) -> Topic:
+        t = self._topics.get(name)
+        if t is None:
+            t = self._topics[name] = Topic(name, self.retention)
+        return t
+
+    def topics(self) -> List[str]:
+        return sorted(self._topics)
+
+    def subscribe(self, topic: str, group: str, at: str = "earliest") -> None:
+        self.topic(topic).subscribe(group, at)
+
+    async def publish(self, topic: str, payload: Any) -> int:
+        return await self.topic(topic).publish(payload)
+
+    def publish_nowait(self, topic: str, payload: Any) -> int:
+        return self.topic(topic).publish_nowait(payload)
+
+    async def consume(
+        self,
+        topic: str,
+        group: str,
+        max_items: int = 256,
+        timeout_s: Optional[float] = None,
+    ) -> List[Any]:
+        return await self.topic(topic).poll(group, max_items, timeout_s)
+
+    async def stream(
+        self, topic: str, group: str, max_items: int = 256
+    ) -> AsyncIterator[List[Any]]:
+        """Async iterator of poll batches — the consumer-loop idiom."""
+        t = self.topic(topic)
+        while True:
+            items = await t.poll(group, max_items)
+            if items:
+                yield items
+
+    def inject_faults(self, topic: str, plan: FaultPlan) -> None:
+        self.topic(topic).fault = plan
+
+    def clear_faults(self, topic: str) -> None:
+        self.topic(topic).fault = None
+
+    def snapshot_offsets(self) -> Dict[str, Dict[str, int]]:
+        """Offsets for persistence → crash-resume (SURVEY.md §5 checkpoint)."""
+        return {
+            name: dict(t.group_offsets) for name, t in self._topics.items()
+        }
+
+    def restore_offsets(self, snap: Dict[str, Dict[str, int]]) -> None:
+        for name, groups in snap.items():
+            t = self.topic(name)
+            for g, off in groups.items():
+                t.seek(g, off)

@@ -1,0 +1,129 @@
+"""Layered configuration: instance → microservice → tenant engine.
+
+Capability parity with the reference's config system (2.x: per-tenant XML in
+Zookeeper, hot-reloadable; 3.0: k8s CRDs ``SiteWhereInstance/-Microservice/
+-Tenant/-TenantEngine`` — SURVEY.md §5 [U]; reference mount empty, see
+provenance banner). Preserved capabilities: per-tenant hot reconfigure and
+template-based tenant bootstrap. Redesigned as dataclasses loaded from
+JSON/TOML-ish dicts; no external coordination service.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field, replace
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """TPU mesh layout for the tpu-inference path (rebuild-only; BASELINE.json:5)."""
+
+    tenant_axis: int = 1      # shards along the tenant axis
+    data_axis: int = 1        # data-parallel shards per tenant shard
+    model_axis: int = 1       # tensor-parallel shards (large models)
+    dtype: str = "bfloat16"
+
+
+@dataclass(frozen=True)
+class MicroBatchConfig:
+    """Micro-batcher knobs — the p99-vs-throughput tradeoff (SURVEY.md §7)."""
+
+    max_batch: int = 4096          # events per pjit call (per tenant shard)
+    deadline_ms: float = 5.0       # max collect window before flushing
+    buckets: tuple = (256, 1024, 4096)  # static-shape buckets (XLA recompile avoidance)
+    window: int = 32               # series window length fed to models
+
+
+@dataclass(frozen=True)
+class TenantEngineConfig:
+    tenant: str = "default"
+    model: str = "lstm_ad"          # model-zoo key for the scoring model
+    model_config: Dict[str, Any] = field(default_factory=dict)
+    microbatch: MicroBatchConfig = field(default_factory=MicroBatchConfig)
+    max_streams: int = 4096         # window-state capacity (series slots)
+    decoder: str = "json"
+
+
+@dataclass(frozen=True)
+class MicroserviceConfig:
+    name: str = "pipeline"
+    consumer_group: Optional[str] = None   # default: name
+    poll_batch: int = 1024
+
+    @property
+    def group(self) -> str:
+        return self.consumer_group or self.name
+
+
+@dataclass(frozen=True)
+class InstanceConfig:
+    instance_id: str = "sw"
+    data_dir: str = "./_data"
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    default_tenant_template: str = "default"
+    bus_retention: int = 65536
+
+
+# -- tenant templates (reference: tenant templates + datasets bootstrap
+# new tenants, SURVEY.md §5 [U]) -----------------------------------------
+
+TENANT_TEMPLATES: Dict[str, Dict[str, Any]] = {
+    "default": {
+        "model": "lstm_ad",
+        "model_config": {},
+        "datasets": ["empty"],
+    },
+    "iot-temperature": {
+        "model": "lstm_ad",
+        "model_config": {"hidden": 64},
+        "datasets": ["temperature-sensors"],
+    },
+    "forecasting": {
+        "model": "deepar",
+        "model_config": {"context": 128},
+        "datasets": ["empty"],
+    },
+    "media": {
+        "model": "vit_b16",
+        "model_config": {},
+        "datasets": ["empty"],
+    },
+}
+
+
+def tenant_config_from_template(
+    tenant: str, template: str = "default", **overrides: Any
+) -> TenantEngineConfig:
+    tpl = TENANT_TEMPLATES.get(template, TENANT_TEMPLATES["default"])
+    cfg = TenantEngineConfig(
+        tenant=tenant,
+        model=tpl["model"],
+        model_config=dict(tpl["model_config"]),
+    )
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    return cfg
+
+
+# -- (de)serialization ----------------------------------------------------
+
+def _to_jsonable(obj: Any) -> Any:
+    if hasattr(obj, "__dataclass_fields__"):
+        return {k: _to_jsonable(v) for k, v in asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def save_instance_config(cfg: InstanceConfig, path: str | Path) -> None:
+    Path(path).write_text(json.dumps(_to_jsonable(cfg), indent=2))
+
+
+def load_instance_config(path: str | Path) -> InstanceConfig:
+    d = json.loads(Path(path).read_text())
+    mesh = MeshConfig(**d.pop("mesh", {}))
+    return InstanceConfig(mesh=mesh, **d)

@@ -1,0 +1,286 @@
+"""Nested lifecycle component trees — the heart of the L2 chassis.
+
+Capability parity with the reference lifecycle SPI
+(``com.sitewhere.spi.server.lifecycle.ILifecycleComponent`` and the
+``LifecycleComponent`` base in ``sitewhere-microservice`` — SURVEY.md §2.1 /
+§3.3 [U]; reference mount empty, see provenance banner). Reproduces the
+load-bearing semantics SURVEY.md §7 calls out:
+
+- initialize → start → stop → terminate state machine with explicit
+  error states,
+- nested child components: initialize/start cascade top-down in
+  registration order, stop cascades bottom-up in reverse order,
+- errors propagate up the tree and park the component in
+  ``*_ERROR`` states instead of raising through the host loop,
+- per-component progress + error log for operator visibility,
+- independent restart of any subtree (how per-tenant hot restart works:
+  a TenantEngine is just a subtree).
+
+Async-first redesign: lifecycle methods are coroutines (the reference uses
+threads + progress monitors); supervision / restart policy lives here rather
+than in k8s probes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("sitewhere.lifecycle")
+
+
+class LifecycleState(str, enum.Enum):
+    UNINITIALIZED = "uninitialized"
+    INITIALIZING = "initializing"
+    INITIALIZED = "initialized"
+    INITIALIZATION_ERROR = "initialization_error"
+    STARTING = "starting"
+    STARTED = "started"
+    START_ERROR = "start_error"
+    PAUSED = "paused"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    STOP_ERROR = "stop_error"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+
+
+#: states from which start() is legal
+_STARTABLE = {
+    LifecycleState.INITIALIZED,
+    LifecycleState.STOPPED,
+    LifecycleState.PAUSED,
+}
+
+
+class LifecycleException(RuntimeError):
+    pass
+
+
+class LifecycleComponent:
+    """A named node in the component tree with lifecycle state."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.state = LifecycleState.UNINITIALIZED
+        self.children: List["LifecycleComponent"] = []
+        self.parent: Optional["LifecycleComponent"] = None
+        self.errors: List[str] = []
+        self.state_since: float = time.time()
+
+    # -- tree ------------------------------------------------------------
+    def add_child(self, child: "LifecycleComponent") -> "LifecycleComponent":
+        child.parent = self
+        self.children.append(child)
+        return child
+
+    def remove_child(self, child: "LifecycleComponent") -> None:
+        self.children.remove(child)
+        child.parent = None
+
+    def find(self, name: str) -> Optional["LifecycleComponent"]:
+        if self.name == name:
+            return self
+        for c in self.children:
+            hit = c.find(name)
+            if hit:
+                return hit
+        return None
+
+    # -- hooks for subclasses -------------------------------------------
+    async def on_initialize(self) -> None:  # pragma: no cover - default
+        pass
+
+    async def on_start(self) -> None:  # pragma: no cover - default
+        pass
+
+    async def on_stop(self) -> None:  # pragma: no cover - default
+        pass
+
+    async def on_terminate(self) -> None:  # pragma: no cover - default
+        pass
+
+    # -- state machine ---------------------------------------------------
+    def _set_state(self, s: LifecycleState) -> None:
+        self.state = s
+        self.state_since = time.time()
+
+    def _record_error(self, phase: str, exc: BaseException) -> None:
+        msg = f"{phase} failed in '{self.name}': {exc!r}"
+        self.errors.append(msg)
+        logger.error(msg)
+        # propagate a breadcrumb up the tree (reference: error propagation
+        # up nested component trees, SURVEY.md §3.3)
+        p = self.parent
+        while p is not None:
+            p.errors.append(f"(from child {self.name}) {msg}")
+            p = p.parent
+
+    async def initialize(self) -> None:
+        if self.state not in (
+            LifecycleState.UNINITIALIZED,
+            LifecycleState.TERMINATED,
+            LifecycleState.INITIALIZATION_ERROR,
+        ):
+            return
+        self._set_state(LifecycleState.INITIALIZING)
+        try:
+            await self.on_initialize()
+            for c in self.children:
+                await c.initialize()
+                if c.state is LifecycleState.INITIALIZATION_ERROR:
+                    raise LifecycleException(f"child '{c.name}' failed to initialize")
+            self._set_state(LifecycleState.INITIALIZED)
+        except BaseException as exc:  # noqa: BLE001 - park in error state
+            self._record_error("initialize", exc)
+            self._set_state(LifecycleState.INITIALIZATION_ERROR)
+
+    async def start(self) -> None:
+        if self.state is LifecycleState.UNINITIALIZED:
+            await self.initialize()
+            if self.state is LifecycleState.INITIALIZATION_ERROR:
+                return  # parked in error state; errors carry the cause
+        if self.state not in _STARTABLE:
+            if self.state is LifecycleState.STARTED:
+                return
+            raise LifecycleException(
+                f"cannot start '{self.name}' from state {self.state.value}"
+            )
+        self._set_state(LifecycleState.STARTING)
+        try:
+            await self.on_start()
+            for c in self.children:
+                await c.start()
+                if c.state is LifecycleState.START_ERROR:
+                    raise LifecycleException(f"child '{c.name}' failed to start")
+            self._set_state(LifecycleState.STARTED)
+        except BaseException as exc:  # noqa: BLE001
+            self._record_error("start", exc)
+            self._set_state(LifecycleState.START_ERROR)
+
+    async def stop(self) -> None:
+        if self.state not in (
+            LifecycleState.STARTED,
+            LifecycleState.PAUSED,
+            LifecycleState.START_ERROR,
+        ):
+            return
+        self._set_state(LifecycleState.STOPPING)
+        try:
+            # bottom-up, reverse registration order
+            for c in reversed(self.children):
+                await c.stop()
+            await self.on_stop()
+            self._set_state(LifecycleState.STOPPED)
+        except BaseException as exc:  # noqa: BLE001
+            self._record_error("stop", exc)
+            self._set_state(LifecycleState.STOP_ERROR)
+
+    async def terminate(self) -> None:
+        await self.stop()
+        self._set_state(LifecycleState.TERMINATING)
+        try:
+            for c in reversed(self.children):
+                await c.terminate()
+            await self.on_terminate()
+        finally:
+            self._set_state(LifecycleState.TERMINATED)
+
+    async def restart(self) -> None:
+        """Hot restart of this subtree (per-tenant restart uses this).
+
+        Recovers from any error state — including INITIALIZATION_ERROR,
+        which stop() won't touch — by resetting the whole subtree to
+        UNINITIALIZED so initialize()/start() run fresh.
+        """
+        await self.stop()
+        if self.state in (
+            LifecycleState.STOP_ERROR,
+            LifecycleState.INITIALIZATION_ERROR,
+        ):
+            self._set_state(
+                LifecycleState.UNINITIALIZED
+                if self.state is LifecycleState.INITIALIZATION_ERROR
+                else LifecycleState.STOPPED
+            )
+        for c in self.children:
+            _reset_errors(c)
+        await self.start()
+
+    # -- introspection ---------------------------------------------------
+    def status_tree(self) -> Dict:
+        return {
+            "name": self.name,
+            "state": self.state.value,
+            "since": self.state_since,
+            "errors": list(self.errors[-5:]),
+            "children": [c.status_tree() for c in self.children],
+        }
+
+
+def _reset_errors(c: LifecycleComponent) -> None:
+    if c.state in (
+        LifecycleState.INITIALIZATION_ERROR,
+        LifecycleState.START_ERROR,
+        LifecycleState.STOP_ERROR,
+    ):
+        c._set_state(LifecycleState.UNINITIALIZED)
+    for ch in c.children:
+        _reset_errors(ch)
+
+
+class SupervisedTask(LifecycleComponent):
+    """A lifecycle component wrapping a long-running asyncio task with a
+    restart policy (rebuild of the reference's k8s-probe elasticity as an
+    in-process supervisor, SURVEY.md §5 failure detection)."""
+
+    def __init__(
+        self,
+        name: str,
+        coro_factory,
+        max_restarts: int = 3,
+        backoff_s: float = 0.5,
+    ) -> None:
+        super().__init__(name)
+        self._factory = coro_factory
+        self._task: Optional[asyncio.Task] = None
+        self._supervisor: Optional[asyncio.Task] = None
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.restarts = 0
+
+    async def on_start(self) -> None:
+        self._supervisor = asyncio.create_task(
+            self._supervise(), name=f"supervise:{self.name}"
+        )
+
+    async def _supervise(self) -> None:
+        backoff = self.backoff_s
+        while True:
+            self._task = asyncio.create_task(self._factory(), name=self.name)
+            try:
+                await self._task
+                return  # clean exit
+            except asyncio.CancelledError:
+                raise
+            except BaseException as exc:  # noqa: BLE001
+                self._record_error("run", exc)
+                if self.restarts >= self.max_restarts:
+                    self._set_state(LifecycleState.START_ERROR)
+                    return
+                self.restarts += 1
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+
+    async def on_stop(self) -> None:
+        for t in (self._task, self._supervisor):
+            if t and not t.done():
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        self._task = self._supervisor = None

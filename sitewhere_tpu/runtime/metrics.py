@@ -1,0 +1,201 @@
+"""Metrics: counters, gauges, streaming histograms with p50/p95/p99.
+
+Capability parity with the reference's Prometheus metrics (3.0 per-service
+registries: consumer lag, event counts — SURVEY.md §5 [U]; reference mount
+empty, see provenance banner). The north-star metrics (events/sec scored,
+p99 inference latency, tenants/chip — BASELINE.json:2) are first-class here;
+a Prometheus-format scrape endpoint is exposed by ``api.rest``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Counter:
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Log-bucketed latency histogram with quantile estimates.
+
+    Buckets are exponential from 1 µs to ~100 s (ratio 1.25) — accurate to
+    ~12% at any scale, O(1) record, no per-sample storage. Good enough for
+    p99 tracking at 1M events/s (recording must never be the bottleneck).
+    """
+
+    RATIO = 1.25
+    MIN = 1e-6
+
+    def __init__(self, name: str, unit: str = "s") -> None:
+        self.name = name
+        self.unit = unit
+        n = int(math.log(1e8) / math.log(self.RATIO)) + 2
+        self._counts = [0] * n
+        self._sum = 0.0
+        self._n = 0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def _bucket(self, v: float) -> int:
+        if v <= self.MIN:
+            return 0
+        b = int(math.log(v / self.MIN) / math.log(self.RATIO)) + 1
+        return min(b, len(self._counts) - 1)
+
+    def record(self, v: float) -> None:
+        b = self._bucket(v)
+        with self._lock:
+            self._counts[b] += 1
+            self._sum += v
+            self._n += 1
+            if v > self._max:
+                self._max = v
+
+    def record_many(self, vs) -> None:
+        for v in vs:
+            self.record(float(v))
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._n if self._n else 0.0
+
+    def quantile(self, q: float) -> float:
+        if not self._n:
+            return 0.0
+        target = q * self._n
+        acc = 0
+        for i, c in enumerate(self._counts):
+            acc += c
+            if acc >= target:
+                # bucket upper edge
+                return self.MIN * (self.RATIO ** i)
+        return self._max
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": float(self._n),
+            "mean": self.mean,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+            "max": self._max,
+        }
+
+
+class MeterRate:
+    """Sliding-window rate meter (events/sec over the last ``window_s``)."""
+
+    def __init__(self, name: str, window_s: float = 10.0) -> None:
+        self.name = name
+        self.window_s = window_s
+        self._events: List[Tuple[float, float]] = []  # (ts, n)
+        self._lock = threading.Lock()
+
+    def mark(self, n: float = 1.0) -> None:
+        now = time.time()
+        with self._lock:
+            self._events.append((now, n))
+            cutoff = now - self.window_s
+            i = bisect.bisect_left(self._events, (cutoff, -1.0))
+            if i:
+                del self._events[:i]
+
+    def rate(self) -> float:
+        now = time.time()
+        with self._lock:
+            cutoff = now - self.window_s
+            total = sum(n for ts, n in self._events if ts >= cutoff)
+        return total / self.window_s
+
+
+class MetricsRegistry:
+    """Named metric registry; one per instance, shared across services."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histos: Dict[str, Histogram] = {}
+        self._meters: Dict[str, MeterRate] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges.setdefault(name, Gauge(name))
+
+    def histogram(self, name: str, unit: str = "s") -> Histogram:
+        return self._histos.setdefault(name, Histogram(name, unit))
+
+    def meter(self, name: str, window_s: float = 10.0) -> MeterRate:
+        return self._meters.setdefault(name, MeterRate(name, window_s))
+
+    def snapshot(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for n, c in self._counters.items():
+            out[n] = c.value
+        for n, g in self._gauges.items():
+            out[n] = g.value
+        for n, h in self._histos.items():
+            out[n] = h.summary()
+        for n, m in self._meters.items():
+            out[n] = m.rate()
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format for the scrape endpoint."""
+        lines: List[str] = []
+        for n, c in self._counters.items():
+            lines.append(f"# TYPE {_sanitize(n)} counter")
+            lines.append(f"{_sanitize(n)} {c.value}")
+        for n, g in self._gauges.items():
+            lines.append(f"# TYPE {_sanitize(n)} gauge")
+            lines.append(f"{_sanitize(n)} {g.value}")
+        for n, h in self._histos.items():
+            base = _sanitize(n)
+            s = h.summary()
+            lines.append(f"# TYPE {base} summary")
+            for q, label in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+                lines.append(f'{base}{{quantile="{label}"}} {s[q]}')
+            lines.append(f"{base}_sum {h.mean * h.count}")
+            lines.append(f"{base}_count {h.count}")
+        for n, m in self._meters.items():
+            lines.append(f"# TYPE {_sanitize(n)}_rate gauge")
+            lines.append(f"{_sanitize(n)}_rate {m.rate()}")
+        return "\n".join(lines) + "\n"
+
+
+def _sanitize(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_").replace("/", "_")

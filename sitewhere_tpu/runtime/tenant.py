@@ -1,0 +1,161 @@
+"""Multitenant service host + per-tenant engines.
+
+Capability parity with the reference's multitenant chassis
+(``MultitenantMicroservice`` / ``MicroserviceTenantEngine`` in
+``sitewhere-microservice`` — SURVEY.md §2.1/§3.3 [U]; reference mount empty,
+see provenance banner). Preserved semantics:
+
+- every (multitenant) service hosts one engine per tenant, each an
+  independently restartable lifecycle subtree,
+- tenant add/update/remove propagates to all services via the global
+  tenant-model-updates topic (bus analog of the reference's Kafka topic),
+- engine bootstrap applies the tenant's template config.
+
+Rebuild-specific extension (the north star's tenant→mesh router): engines
+carry a ``mesh_shard`` assignment delegated to ``parallel.tenant_router``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from sitewhere_tpu.core.model import Tenant
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.config import (
+    TenantEngineConfig,
+    tenant_config_from_template,
+)
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, LifecycleState
+
+logger = logging.getLogger("sitewhere.tenant")
+
+
+class TenantEngine(LifecycleComponent):
+    """Base class for per-tenant engines hosted inside a service."""
+
+    def __init__(self, service_name: str, config: TenantEngineConfig) -> None:
+        super().__init__(f"{service_name}/engine[{config.tenant}]")
+        self.tenant = config.tenant
+        self.config = config
+
+    async def reconfigure(self, config: TenantEngineConfig) -> None:
+        """Hot reconfigure: stop → swap config → start (reference parity:
+        per-tenant hot reload, SURVEY.md §5 config)."""
+        running = self.state is LifecycleState.STARTED
+        if running:
+            await self.stop()
+        self.config = config
+        if running:
+            await self.restart()
+
+
+EngineFactory = Callable[[TenantEngineConfig], TenantEngine]
+
+
+class MultitenantService(LifecycleComponent):
+    """A service hosting one TenantEngine per tenant."""
+
+    def __init__(
+        self,
+        name: str,
+        bus: EventBus,
+        engine_factory: EngineFactory,
+    ) -> None:
+        super().__init__(name)
+        self.bus = bus
+        self.engine_factory = engine_factory
+        self.engines: Dict[str, TenantEngine] = {}
+
+    @property
+    def _updates_group(self) -> str:
+        return f"{self.name}-tenant-updates"
+
+    async def on_start(self) -> None:
+        # register the consumer group before any update can be published so
+        # fan-out reaches services that haven't polled yet
+        self.bus.subscribe(self.bus.naming.tenant_model_updates(), self._updates_group)
+
+    # -- tenant lifecycle fan-out ---------------------------------------
+    async def add_tenant(self, cfg: TenantEngineConfig) -> TenantEngine:
+        if cfg.tenant in self.engines:
+            raise ValueError(f"tenant '{cfg.tenant}' already hosted by {self.name}")
+        engine = self.engine_factory(cfg)
+        self.engines[cfg.tenant] = engine
+        self.add_child(engine)
+        if self.state is LifecycleState.STARTED:
+            await engine.start()
+        return engine
+
+    async def remove_tenant(self, tenant: str) -> None:
+        engine = self.engines.pop(tenant, None)
+        if engine is None:
+            return
+        await engine.terminate()
+        self.remove_child(engine)
+
+    async def restart_tenant(self, tenant: str) -> None:
+        engine = self.engines.get(tenant)
+        if engine is not None:
+            await engine.restart()
+
+    async def reconfigure_tenant(self, cfg: TenantEngineConfig) -> None:
+        engine = self.engines.get(cfg.tenant)
+        if engine is not None:
+            await engine.reconfigure(cfg)
+
+    def engine_for(self, tenant: str) -> Optional[TenantEngine]:
+        return self.engines.get(tenant)
+
+    def tenants(self) -> List[str]:
+        return sorted(self.engines)
+
+    # -- tenant-model-updates subscription ------------------------------
+    async def apply_tenant_update(self, update: dict) -> None:
+        """Handle one message from the tenant-model-updates topic.
+
+        ``update``: {"op": "add"|"remove"|"update"|"restart",
+                     "tenant": token, "template": name, "overrides": {...}}
+        """
+        op = update.get("op")
+        tenant = update.get("tenant", "")
+        if op == "add" and tenant not in self.engines:
+            cfg = tenant_config_from_template(
+                tenant, update.get("template", "default"),
+                **update.get("overrides", {}),
+            )
+            await self.add_tenant(cfg)
+        elif op == "remove":
+            await self.remove_tenant(tenant)
+        elif op == "restart":
+            await self.restart_tenant(tenant)
+        elif op == "update" and tenant in self.engines:
+            cfg = tenant_config_from_template(
+                tenant, update.get("template", "default"),
+                **update.get("overrides", {}),
+            )
+            await self.reconfigure_tenant(cfg)
+
+    async def drain_tenant_updates(self, timeout_s: float = 0) -> int:
+        """Poll the global updates topic and apply everything pending."""
+        topic = self.bus.naming.tenant_model_updates()
+        updates = await self.bus.consume(
+            topic, group=self._updates_group, timeout_s=timeout_s
+        )
+        for u in updates:
+            # the cursor is already committed for the whole poll batch: one
+            # bad update must not drop the rest of the batch
+            try:
+                await self.apply_tenant_update(u)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "%s: failed to apply tenant update %r", self.name, u
+                )
+        return len(updates)
+
+
+async def broadcast_tenant_update(bus: EventBus, update: dict) -> None:
+    """Publish a tenant lifecycle change for every service to apply
+    (reference parity: tenant-management triggers fleet-wide engine
+    lifecycle via Kafka, SURVEY.md §2.2 service-tenant-management [U])."""
+    await bus.publish(bus.naming.tenant_model_updates(), update)

@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+imports so mesh/sharding logic is exercised without TPU hardware
+(SURVEY.md §4 "TPU-without-TPU")."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def bus():
+    from sitewhere_tpu.runtime.bus import EventBus
+
+    return EventBus()

@@ -1,0 +1,184 @@
+"""Event bus: topics, groups, offsets, replay, backpressure, faults."""
+
+import asyncio
+
+from sitewhere_tpu.runtime.bus import EventBus, FaultPlan, TopicNaming
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_topic_naming():
+    n = TopicNaming("inst1")
+    assert n.decoded_events("acme") == "inst1.tenant.acme.event-source-decoded-events"
+    assert n.inbound_events("acme").endswith("inbound-events")
+    assert n.scored_events("acme").endswith("tpu-scored-events")
+    assert n.tenant_model_updates() == "inst1.global.tenant-model-updates"
+
+
+def test_publish_poll_advances_cursor():
+    async def go():
+        bus = EventBus()
+        for i in range(10):
+            await bus.publish("t", i)
+        got = await bus.consume("t", "g1", max_items=4)
+        assert got == [0, 1, 2, 3]
+        got = await bus.consume("t", "g1", max_items=100)
+        assert got == list(range(4, 10))
+        # empty poll with timeout 0 returns []
+        assert await bus.consume("t", "g1", timeout_s=0) == []
+
+    run(go())
+
+
+def test_independent_groups_and_replay():
+    async def go():
+        bus = EventBus()
+        for i in range(5):
+            await bus.publish("t", i)
+        a = await bus.consume("t", "a")
+        b = await bus.consume("t", "b")
+        assert a == b == [0, 1, 2, 3, 4]
+        # replay: seek group a back to offset 2
+        bus.topic("t").seek("a", 2)
+        assert await bus.consume("t", "a") == [2, 3, 4]
+
+    run(go())
+
+
+def test_offsets_snapshot_restore():
+    async def go():
+        bus = EventBus()
+        for i in range(5):
+            await bus.publish("t", i)
+        await bus.consume("t", "g")
+        snap = bus.snapshot_offsets()
+        bus2 = EventBus()
+        for i in range(5):
+            await bus2.publish("t", i)
+        bus2.restore_offsets(snap)
+        assert await bus2.consume("t", "g", timeout_s=0) == []
+
+    run(go())
+
+
+def test_poll_blocks_until_data():
+    async def go():
+        bus = EventBus()
+
+        async def producer():
+            await asyncio.sleep(0.05)
+            await bus.publish("t", "x")
+
+        prod = asyncio.create_task(producer())
+        got = await bus.consume("t", "g", timeout_s=1.0)
+        assert got == ["x"]
+        await prod
+
+    run(go())
+
+
+def test_backpressure_publish_awaits_consumer():
+    async def go():
+        bus = EventBus(retention=4)
+        t = bus.topic("t")
+        t.subscribe("g")  # registered group ⇒ backpressure instead of eviction
+        for i in range(4):
+            await t.publish(i)
+
+        published = []
+
+        async def producer():
+            await t.publish(99)
+            published.append(True)
+
+        prod = asyncio.create_task(producer())
+        await asyncio.sleep(0.02)
+        assert not published  # blocked: log full, nobody consumed
+        await t.poll("g", max_items=4)
+        await asyncio.wait_for(prod, 1.0)
+        assert published
+
+    run(go())
+
+
+def test_consumer_lag_metric():
+    async def go():
+        bus = EventBus()
+        for i in range(8):
+            await bus.publish("t", i)
+        t = bus.topic("t")
+        await t.poll("g", max_items=3)
+        assert t.lag("g") == 5
+
+    run(go())
+
+
+def test_fault_injection_drop_all():
+    async def go():
+        bus = EventBus()
+        bus.inject_faults("t", FaultPlan(drop_p=1.0))
+        for i in range(5):
+            await bus.publish("t", i)
+        assert await bus.consume("t", "g", timeout_s=0) == []
+        bus.clear_faults("t")
+        await bus.publish("t", "ok")
+        assert await bus.consume("t", "g", timeout_s=0) == ["ok"]
+
+    run(go())
+
+
+def test_fault_injection_duplicate():
+    async def go():
+        bus = EventBus()
+        bus.inject_faults("t", FaultPlan(dup_p=1.0))
+        await bus.publish("t", "x")
+        got = await bus.consume("t", "g", timeout_s=0)
+        assert got == ["x", "x"]
+
+    run(go())
+
+
+def test_seek_releases_backpressured_producer():
+    async def go():
+        bus = EventBus(retention=4)
+        t = bus.topic("t")
+        t.subscribe("slow")
+        for i in range(4):
+            await t.publish(i)
+        blocked = asyncio.create_task(t.publish(99))
+        await asyncio.sleep(0.02)
+        assert not blocked.done()
+        t.seek("slow", t.latest_offset)  # operator skips the backlog
+        await asyncio.wait_for(blocked, 1.0)
+
+    run(go())
+
+
+def test_unsubscribe_releases_backpressured_producer():
+    async def go():
+        bus = EventBus(retention=4)
+        t = bus.topic("t")
+        t.subscribe("gone")
+        for i in range(4):
+            await t.publish(i)
+        blocked = asyncio.create_task(t.publish(99))
+        await asyncio.sleep(0.02)
+        assert not blocked.done()
+        t.unsubscribe("gone")
+        await asyncio.wait_for(blocked, 1.0)
+
+    run(go())
+
+
+def test_compaction_keeps_offsets_dense():
+    async def go():
+        bus = EventBus(retention=16)
+        t = bus.topic("t")
+        for i in range(5000):  # forces many evictions + compactions
+            await t.publish(i)
+        got = await t.poll("g", max_items=100)
+        assert got == list(range(4984, 5000))
+
+    run(go())

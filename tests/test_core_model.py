@@ -1,0 +1,119 @@
+"""L1 core model: event serde round-trips, entity basics, columnar batches."""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core import (
+    AlertLevel,
+    AssignmentStatus,
+    Device,
+    DeviceAlert,
+    DeviceAssignment,
+    DeviceCommandInvocation,
+    DeviceCommandResponse,
+    DeviceLocation,
+    DeviceMeasurement,
+    DeviceStateChange,
+    DeviceType,
+    EventType,
+    MeasurementBatch,
+    Tenant,
+    event_from_dict,
+)
+from sitewhere_tpu.core.events import event_from_json
+
+
+EVENTS = [
+    DeviceMeasurement(device_token="d1", name="temp", value=21.5),
+    DeviceLocation(device_token="d1", latitude=33.75, longitude=-84.39, elevation=300),
+    DeviceAlert(device_token="d1", level=AlertLevel.CRITICAL, alert_type="over", message="hot"),
+    DeviceCommandInvocation(device_token="d1", command_token="reboot", parameters={"delay": "5"}),
+    DeviceCommandResponse(device_token="d1", originating_event_id="abc", response="ok"),
+    DeviceStateChange(device_token="d1", attribute="presence", new_state="online"),
+]
+
+
+@pytest.mark.parametrize("ev", EVENTS, ids=lambda e: e.EVENT_TYPE.value)
+def test_event_roundtrip(ev):
+    d = ev.to_dict()
+    back = event_from_dict(d)
+    assert type(back) is type(ev)
+    assert back.to_dict() == d
+    assert event_from_json(ev.to_json()).to_dict() == d
+
+
+def test_measurement_score_survives_roundtrip():
+    m = DeviceMeasurement(name="t", value=1.0, score=0.93)
+    assert event_from_dict(m.to_dict()).score == pytest.approx(0.93)
+
+
+def test_event_trace_marks():
+    m = DeviceMeasurement(name="t", value=1.0)
+    m.mark("decode")
+    m.mark("score")
+    assert set(m.trace) == {"decode", "score"}
+    assert m.trace["score"] >= m.trace["decode"]
+
+
+def test_assignment_release():
+    a = DeviceAssignment(device_token="d1")
+    assert a.status is AssignmentStatus.ACTIVE
+    a.release()
+    assert a.status is AssignmentStatus.RELEASED
+    assert a.released_date is not None
+
+
+def test_device_type_command_lookup():
+    from sitewhere_tpu.core.model import DeviceCommand
+
+    dt = DeviceType(name="sensor", commands=[DeviceCommand(token="cmd1", name="reboot")])
+    assert dt.command_by_token("cmd1").name == "reboot"
+    assert dt.command_by_token("nope") is None
+
+
+def test_tenant_defaults():
+    t = Tenant(name="acme")
+    assert t.mesh_shard == -1
+    assert t.auth_token.startswith("auth-")
+
+
+class TestMeasurementBatch:
+    def test_from_events_and_concat(self):
+        evs = [DeviceMeasurement(device_token=f"d{i}", name="t", value=float(i)) for i in range(5)]
+        b1 = MeasurementBatch.from_events(evs[:3], stream_ids=[0, 1, 2])
+        b2 = MeasurementBatch.from_events(evs[3:], stream_ids=[3, 4])
+        b = MeasurementBatch.concat([b1, b2])
+        assert b.n == 5 and b.n_valid == 5
+        np.testing.assert_array_equal(b.stream_ids, [0, 1, 2, 3, 4])
+        np.testing.assert_allclose(b.values, [0, 1, 2, 3, 4])
+        assert list(b.device_tokens) == [f"d{i}" for i in range(5)]
+
+    def test_pad_to_bucket(self):
+        b = MeasurementBatch.from_arrays("default", np.arange(3), np.ones(3))
+        p = b.pad_to(8)
+        assert p.n == 8 and p.n_valid == 3
+        assert not p.valid[3:].any()
+        with pytest.raises(ValueError):
+            p.pad_to(4)
+
+    def test_take_split(self):
+        b = MeasurementBatch.from_arrays("default", np.arange(10), np.arange(10.0))
+        head, tail = b.take(4)
+        assert head.n == 4 and tail.n == 6
+        np.testing.assert_array_equal(tail.stream_ids, np.arange(4, 10))
+
+    def test_empty(self):
+        e = MeasurementBatch.empty()
+        assert e.n == 0
+        assert MeasurementBatch.concat([]).n == 0
+
+
+    def test_pad_keeps_object_columns_aligned(self):
+        evs = [DeviceMeasurement(device_token=f"d{i}", name="t", value=float(i)) for i in range(3)]
+        b = MeasurementBatch.from_events(evs, stream_ids=[0, 1, 2]).pad_to(8)
+        assert len(b.event_ids) == 8 and b.event_ids[3] == ""
+        # concat of mixed object/plain batches keeps identity rows aligned
+        plain = MeasurementBatch.from_arrays("default", np.arange(2), np.ones(2))
+        c = MeasurementBatch.concat([b, plain])
+        assert len(c.event_ids) == c.n == 10
+        assert c.device_tokens[0] == "d0" and c.device_tokens[8] == ""

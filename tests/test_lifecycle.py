@@ -1,0 +1,166 @@
+"""L2 lifecycle state machine: cascades, error propagation, hot restart."""
+
+import asyncio
+
+import pytest
+
+from sitewhere_tpu.runtime.lifecycle import (
+    LifecycleComponent,
+    LifecycleState,
+    SupervisedTask,
+)
+
+
+class Recorder(LifecycleComponent):
+    def __init__(self, name, log, fail_on=None):
+        super().__init__(name)
+        self.log = log
+        self.fail_on = fail_on or set()
+
+    async def on_initialize(self):
+        if "initialize" in self.fail_on:
+            raise RuntimeError("boom-init")
+        self.log.append(("init", self.name))
+
+    async def on_start(self):
+        if "start" in self.fail_on:
+            raise RuntimeError("boom-start")
+        self.log.append(("start", self.name))
+
+    async def on_stop(self):
+        self.log.append(("stop", self.name))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_start_cascades_topdown_stop_bottomup():
+    log = []
+    root = Recorder("root", log)
+    a = root.add_child(Recorder("a", log))
+    a.add_child(Recorder("a1", log))
+    root.add_child(Recorder("b", log))
+
+    async def go():
+        await root.start()
+        assert root.state is LifecycleState.STARTED
+        assert all(c.state is LifecycleState.STARTED for c in (a,))
+        await root.stop()
+
+    run(go())
+    starts = [n for op, n in log if op == "start"]
+    stops = [n for op, n in log if op == "stop"]
+    assert starts == ["root", "a", "a1", "b"]
+    assert stops == ["b", "a1", "a", "root"]  # reverse order, bottom-up
+
+
+def test_child_failure_parks_parent_in_error_state():
+    log = []
+    root = Recorder("root", log)
+    root.add_child(Recorder("bad", log, fail_on={"start"}))
+
+    async def go():
+        await root.start()
+
+    run(go())
+    assert root.state is LifecycleState.START_ERROR
+    assert any("bad" in e for e in root.errors)
+
+
+def test_error_propagates_breadcrumbs_to_ancestors():
+    log = []
+    root = Recorder("root", log)
+    mid = root.add_child(Recorder("mid", log))
+    mid.add_child(Recorder("leaf", log, fail_on={"initialize"}))
+    run(root.initialize())
+    assert root.state is LifecycleState.INITIALIZATION_ERROR
+    assert any("leaf" in e for e in root.errors)
+
+
+def test_hot_restart_of_subtree():
+    log = []
+    root = Recorder("root", log)
+    eng = root.add_child(Recorder("engine[t1]", log))
+
+    async def go():
+        await root.start()
+        await eng.restart()
+        assert eng.state is LifecycleState.STARTED
+        assert root.state is LifecycleState.STARTED  # parent untouched
+
+    run(go())
+    assert [n for op, n in log if op == "stop"] == ["engine[t1]"]
+
+
+def test_restart_clears_error_state():
+    log = []
+    comp = Recorder("flaky", log, fail_on={"start"})
+
+    async def go():
+        await comp.start()
+        assert comp.state is LifecycleState.START_ERROR
+        comp.fail_on = set()
+        await comp.restart()
+        assert comp.state is LifecycleState.STARTED
+
+    run(go())
+
+
+def test_supervised_task_restarts_on_crash():
+    crashes = []
+
+    async def flaky():
+        crashes.append(1)
+        if len(crashes) < 3:
+            raise RuntimeError("crash")
+        await asyncio.sleep(10)  # stay alive
+
+    async def go():
+        t = SupervisedTask("worker", flaky, max_restarts=5, backoff_s=0.01)
+        await t.start()
+        await asyncio.sleep(0.2)
+        assert len(crashes) == 3
+        assert t.restarts == 2
+        await t.stop()
+        assert t.state is LifecycleState.STOPPED
+
+    run(go())
+
+
+def test_supervised_task_gives_up_after_max_restarts():
+    async def always_fails():
+        raise RuntimeError("nope")
+
+    async def go():
+        t = SupervisedTask("doomed", always_fails, max_restarts=2, backoff_s=0.01)
+        await t.start()
+        await asyncio.sleep(0.3)
+        assert t.state is LifecycleState.START_ERROR
+        await t.stop()
+
+    run(go())
+
+
+def test_status_tree_shape():
+    log = []
+    root = Recorder("root", log)
+    root.add_child(Recorder("a", log))
+    tree = root.status_tree()
+    assert tree["name"] == "root"
+    assert tree["children"][0]["name"] == "a"
+    assert tree["state"] == "uninitialized"
+
+
+def test_restart_recovers_from_initialization_error():
+    log = []
+    comp = Recorder("flaky", log, fail_on={"initialize"})
+
+    async def go():
+        await comp.start()
+        assert comp.state is LifecycleState.INITIALIZATION_ERROR
+        comp.fail_on = set()
+        await comp.restart()
+        assert comp.state is LifecycleState.STARTED
+
+    run(go())

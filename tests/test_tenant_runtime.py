@@ -1,0 +1,169 @@
+"""Tenant engines: host lifecycle, add/remove/restart, update fan-out, config."""
+
+import asyncio
+
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.config import (
+    MicroBatchConfig,
+    TenantEngineConfig,
+    tenant_config_from_template,
+)
+from sitewhere_tpu.runtime.lifecycle import LifecycleState
+from sitewhere_tpu.runtime.tenant import (
+    MultitenantService,
+    TenantEngine,
+    broadcast_tenant_update,
+)
+
+
+class DummyEngine(TenantEngine):
+    def __init__(self, cfg):
+        super().__init__("svc", cfg)
+        self.started = 0
+
+    async def on_start(self):
+        self.started += 1
+
+
+def make_service(bus=None):
+    bus = bus or EventBus()
+    return MultitenantService("svc", bus, DummyEngine), bus
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_add_tenant_before_and_after_start():
+    svc, _ = make_service()
+
+    async def go():
+        await svc.add_tenant(TenantEngineConfig(tenant="t1"))
+        await svc.start()
+        assert svc.engine_for("t1").state is LifecycleState.STARTED
+        # added while running → starts immediately
+        await svc.add_tenant(TenantEngineConfig(tenant="t2"))
+        assert svc.engine_for("t2").state is LifecycleState.STARTED
+        assert svc.tenants() == ["t1", "t2"]
+
+    run(go())
+
+
+def test_remove_tenant_terminates_engine():
+    svc, _ = make_service()
+
+    async def go():
+        await svc.start()
+        await svc.add_tenant(TenantEngineConfig(tenant="t1"))
+        eng = svc.engine_for("t1")
+        await svc.remove_tenant("t1")
+        assert eng.state is LifecycleState.TERMINATED
+        assert svc.engine_for("t1") is None
+
+    run(go())
+
+
+def test_restart_single_tenant_leaves_others_running():
+    svc, _ = make_service()
+
+    async def go():
+        await svc.start()
+        await svc.add_tenant(TenantEngineConfig(tenant="t1"))
+        await svc.add_tenant(TenantEngineConfig(tenant="t2"))
+        e1, e2 = svc.engine_for("t1"), svc.engine_for("t2")
+        await svc.restart_tenant("t1")
+        assert e1.started == 2 and e2.started == 1
+
+    run(go())
+
+
+def test_hot_reconfigure_swaps_config():
+    svc, _ = make_service()
+
+    async def go():
+        await svc.start()
+        await svc.add_tenant(TenantEngineConfig(tenant="t1", model="lstm_ad"))
+        new = TenantEngineConfig(tenant="t1", model="deepar")
+        await svc.reconfigure_tenant(new)
+        eng = svc.engine_for("t1")
+        assert eng.config.model == "deepar"
+        assert eng.state is LifecycleState.STARTED
+        assert eng.started == 2  # restarted with new config
+
+    run(go())
+
+
+def test_tenant_update_broadcast_fanout():
+    async def go():
+        bus = EventBus()
+        svc_a = MultitenantService("a", bus, DummyEngine)
+        svc_b = MultitenantService("b", bus, DummyEngine)
+        await svc_a.start()
+        await svc_b.start()
+        await broadcast_tenant_update(
+            bus, {"op": "add", "tenant": "acme", "template": "iot-temperature"}
+        )
+        for svc in (svc_a, svc_b):
+            n = await svc.drain_tenant_updates()
+            assert n == 1
+            assert svc.engine_for("acme") is not None
+        assert svc_a.engine_for("acme").config.model == "lstm_ad"
+        await broadcast_tenant_update(bus, {"op": "remove", "tenant": "acme"})
+        await svc_a.drain_tenant_updates()
+        assert svc_a.engine_for("acme") is None
+        assert svc_b.engine_for("acme") is not None  # b hasn't drained yet
+
+    run(go())
+
+
+def test_template_bootstrap_and_overrides():
+    cfg = tenant_config_from_template(
+        "x", "forecasting", microbatch=MicroBatchConfig(max_batch=128)
+    )
+    assert cfg.model == "deepar"
+    assert cfg.model_config["context"] == 128
+    assert cfg.microbatch.max_batch == 128
+    # unknown template falls back to default
+    assert tenant_config_from_template("y", "nope").model == "lstm_ad"
+
+
+def test_instance_config_roundtrip(tmp_path):
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        load_instance_config,
+        save_instance_config,
+    )
+
+    cfg = InstanceConfig(instance_id="i9", mesh=MeshConfig(tenant_axis=4))
+    p = tmp_path / "cfg.json"
+    save_instance_config(cfg, p)
+    back = load_instance_config(p)
+    assert back.instance_id == "i9"
+    assert back.mesh.tenant_axis == 4
+
+
+def test_bad_update_does_not_drop_rest_of_batch():
+    async def go():
+        bus = EventBus()
+        svc = MultitenantService("svc", bus, DummyEngine)
+        await svc.start()
+        # first update is malformed (bad override key → TypeError inside),
+        # second is fine: both were committed in one poll batch
+        await broadcast_tenant_update(
+            bus, {"op": "add", "tenant": "bad", "overrides": {"nope": 1}}
+        )
+        await broadcast_tenant_update(bus, {"op": "add", "tenant": "good"})
+        await svc.drain_tenant_updates()
+        assert svc.engine_for("good") is not None
+
+    run(go())
+
+
+def test_prometheus_quantile_labels():
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.histogram("lat").record(0.01)
+    text = reg.prometheus_text()
+    assert 'quantile="0.99"' in text and 'quantile="99"' not in text
