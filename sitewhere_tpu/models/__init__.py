@@ -1,0 +1,100 @@
+"""Model zoo registry.
+
+Tenant templates (``runtime.config``) name models by key; the tpu-inference
+engine resolves them here. Scorer models share one contract:
+
+    cfg    = spec.config_cls(**model_config_overrides)
+    params = spec.init(key, cfg)
+    scores = spec.score(params, cfg, windows[B, W], n_valid[B])  # f32[B]
+
+which is what lets heterogeneous tenants stack along the mesh tenant axis
+as long as they share a model *family* (SURVEY.md §7 "tenants-on-mesh").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Dict, Optional
+
+from sitewhere_tpu.models import deepar, lstm_ad, transformer, vit
+from sitewhere_tpu.models.common import param_count
+
+__all__ = [
+    "ModelSpec",
+    "MODEL_REGISTRY",
+    "get_model",
+    "make_config",
+    "param_count",
+    "lstm_ad",
+    "deepar",
+    "transformer",
+    "vit",
+]
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    config_cls: type
+    init: Callable
+    score: Optional[Callable] = None      # scorer contract (windows, n_valid)
+    loss: Optional[Callable] = None
+    forecast: Optional[Callable] = None
+    apply: Optional[Callable] = None      # classifier contract (images)
+    train_step: Optional[Callable] = None
+
+
+MODEL_REGISTRY: Dict[str, ModelSpec] = {
+    "lstm_ad": ModelSpec(
+        name="lstm_ad",
+        config_cls=lstm_ad.LstmAdConfig,
+        init=lstm_ad.init,
+        score=lstm_ad.score,
+        loss=lstm_ad.loss,
+        train_step=lstm_ad.train_step,
+    ),
+    "deepar": ModelSpec(
+        name="deepar",
+        config_cls=deepar.DeepArConfig,
+        init=deepar.init,
+        score=deepar.score,
+        loss=deepar.loss,
+        forecast=deepar.forecast,
+        train_step=deepar.train_step,
+    ),
+    "transformer": ModelSpec(
+        name="transformer",
+        config_cls=transformer.TransformerForecasterConfig,
+        init=transformer.init,
+        score=transformer.score,
+        loss=transformer.loss,
+        forecast=transformer.forecast,
+        train_step=transformer.train_step,
+    ),
+    "vit_b16": ModelSpec(
+        name="vit_b16",
+        config_cls=vit.ViTConfig,
+        init=vit.init,
+        apply=vit.apply,
+        loss=vit.loss,
+        train_step=vit.train_step,
+    ),
+}
+
+
+def get_model(name: str) -> ModelSpec:
+    try:
+        return MODEL_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model '{name}' (known: {sorted(MODEL_REGISTRY)})"
+        ) from None
+
+
+def make_config(name: str, overrides: Optional[Dict[str, Any]] = None):
+    """Build a model config from a template's ``model_config`` dict,
+    ignoring unknown keys (forward-compatible tenant templates)."""
+    spec = get_model(name)
+    known = {f.name for f in fields(spec.config_cls)}
+    kwargs = {k: v for k, v in (overrides or {}).items() if k in known}
+    return spec.config_cls(**kwargs)

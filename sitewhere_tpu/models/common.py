@@ -1,0 +1,130 @@
+"""Shared pure-JAX building blocks for the model zoo.
+
+Models are plain pytrees (nested dicts of arrays) + pure ``init``/``apply``
+functions — no framework class hierarchy, so stacking per-tenant parameters
+along a leading tenant axis (``parallel.sharded``) and checkpointing
+(``runtime.checkpoint``) are trivial tree ops.
+
+TPU notes: params are stored float32, compute defaults to bfloat16 (MXU
+native); all matmuls are batched ``einsum``s so XLA tiles them onto the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def dense_init(key, in_dim: int, out_dim: int, scale: Optional[float] = None) -> Params:
+    scale = scale if scale is not None else 1.0 / math.sqrt(in_dim)
+    return {
+        "w": jax.random.normal(key, (in_dim, out_dim), jnp.float32) * scale,
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def dense(p: Params, x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return jnp.einsum("...i,io->...o", x.astype(dtype), p["w"].astype(dtype)) + p[
+        "b"
+    ].astype(dtype)
+
+
+def layernorm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layernorm(p: Params, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    # LN in float32 for numerical stability, cast back after
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def mha_init(key, dim: int, heads: int) -> Params:
+    del heads  # head count is config, not a parameter (keeps pytrees array-only)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "wq": dense_init(k1, dim, dim),
+        "wk": dense_init(k2, dim, dim),
+        "wv": dense_init(k3, dim, dim),
+        "wo": dense_init(k4, dim, dim),
+    }
+
+
+def mha(
+    p: Params,
+    x: jnp.ndarray,                      # [..., T, D]
+    heads: int,
+    causal: bool = False,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Multi-head self-attention. Softmax in f32; QK^T/AV are MXU matmuls."""
+    t, d = x.shape[-2], x.shape[-1]
+    hd = d // heads
+
+    def split(a):
+        return a.reshape(*a.shape[:-1], heads, hd)
+
+    q = split(dense(p["wq"], x, dtype))
+    k = split(dense(p["wk"], x, dtype))
+    v = split(dense(p["wv"], x, dtype))
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    attn = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    out = jnp.einsum("...hqk,...khd->...qhd", attn, v)
+    out = out.reshape(*out.shape[:-2], d)
+    return dense(p["wo"], out, dtype)
+
+
+def mlp_init(key, dim: int, hidden: int) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {"fc1": dense_init(k1, dim, hidden), "fc2": dense_init(k2, hidden, dim)}
+
+
+def mlp(p: Params, x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return dense(p["fc2"], jax.nn.gelu(dense(p["fc1"], x, dtype)), dtype)
+
+
+def transformer_block_init(key, dim: int, heads: int, mlp_ratio: int = 4) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {
+        "ln1": layernorm_init(dim),
+        "attn": mha_init(k1, dim, heads),
+        "ln2": layernorm_init(dim),
+        "mlp": mlp_init(k2, dim, dim * mlp_ratio),
+    }
+
+
+def transformer_block(
+    p: Params, x: jnp.ndarray, heads: int, causal: bool = False, dtype=jnp.bfloat16
+) -> jnp.ndarray:
+    x = x + mha(p["attn"], layernorm(p["ln1"], x), heads, causal=causal, dtype=dtype)
+    x = x + mlp(p["mlp"], layernorm(p["ln2"], x), dtype=dtype)
+    return x
+
+
+def normalize_windows(windows: jnp.ndarray, eps: float = 1e-6):
+    """Per-row standardization of [..., W] windows → (normed, mu, sigma).
+
+    Models score/forecast in normalized space; callers un-normalize with the
+    returned (mu, sigma). Keeps params scale-free across heterogeneous
+    sensors (°C vs kPa vs rpm).
+    """
+    wf = windows.astype(jnp.float32)
+    mu = wf.mean(-1, keepdims=True)
+    sigma = wf.std(-1, keepdims=True) + eps
+    return (wf - mu) / sigma, mu, sigma
+
+
+def param_count(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,159 @@
+"""DeepAR-style probabilistic forecaster (GRU, Gaussian head).
+
+North-star model #2 (BASELINE.json:9 "Transformer/DeepAR forecaster on
+multi-sensor telemetry (event-management replay)"; no reference counterpart,
+SURVEY.md §2.3). Follows the DeepAR recipe (autoregressive RNN emitting a
+distribution per step, ancestral sampling for multi-horizon forecasts) in
+pure JAX.
+
+TPU notes: recurrence is ``lax.scan``; sampling the forecast horizon is a
+second scan carrying (h, last_value, key) — fully jitted, no host round
+trips per step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.models.common import Params, dense_init, normalize_windows
+
+
+@dataclass(frozen=True)
+class DeepArConfig:
+    context: int = 128     # conditioning window length
+    horizon: int = 24      # forecast steps
+    hidden: int = 64
+    num_samples: int = 64  # sample paths per series for quantiles
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init(key, cfg: DeepArConfig) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    h = cfg.hidden
+    return {
+        "wx": dense_init(k1, 1, 3 * h),           # GRU input → gates (fused)
+        "wh": dense_init(k2, h, 3 * h, scale=1.0 / jnp.sqrt(h)),
+        "mu": dense_init(k3, h, 1),
+        "sigma": dense_init(k4, h, 1),
+    }
+
+
+def _gru_step(params: Params, h: jnp.ndarray, x_t: jnp.ndarray, dtype):
+    """x_t: [B] → new hidden [B, H]."""
+    wx = params["wx"]["w"].astype(dtype)
+    wh = params["wh"]["w"].astype(dtype)
+    bx = params["wx"]["b"].astype(dtype)
+    bh = params["wh"]["b"].astype(dtype)
+    gx = x_t[:, None] @ wx + bx                  # [B, 3H]
+    gh = h @ wh + bh
+    hd = h.shape[-1]
+    rx, zx, nx = gx[:, :hd], gx[:, hd : 2 * hd], gx[:, 2 * hd :]
+    rh, zh, nh = gh[:, :hd], gh[:, hd : 2 * hd], gh[:, 2 * hd :]
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    n = jnp.tanh(nx + r * nh)
+    return (1 - z) * n + z * h
+
+
+def _emit(params: Params, h: jnp.ndarray, dtype):
+    mu = (h @ params["mu"]["w"].astype(dtype))[:, 0] + params["mu"]["b"].astype(dtype)[0]
+    raw = (h @ params["sigma"]["w"].astype(dtype))[:, 0] + params["sigma"]["b"].astype(
+        dtype
+    )[0]
+    sigma = jax.nn.softplus(raw.astype(jnp.float32)) + 1e-4
+    return mu.astype(jnp.float32), sigma
+
+
+def _encode(params: Params, normed: jnp.ndarray, dtype):
+    """Run the GRU over the context; return (final hidden, per-step (mu, sigma))."""
+    b, t = normed.shape
+
+    def step(h, x_t):
+        h = _gru_step(params, h, x_t, dtype)
+        return h, _emit(params, h, dtype)
+
+    h0 = jnp.zeros((b, params["wh"]["w"].shape[0]), dtype)
+    h_last, (mus, sigmas) = jax.lax.scan(step, h0, normed.T.astype(dtype))
+    return h_last, mus.T, sigmas.T  # [B, T]
+
+
+def loss(params: Params, cfg: DeepArConfig, windows: jnp.ndarray) -> jnp.ndarray:
+    """Gaussian NLL of each next step given the prefix (teacher forcing)."""
+    normed, _, _ = normalize_windows(windows)
+    _, mus, sigmas = _encode(params, normed[:, :-1], cfg.compute_dtype)
+    target = normed[:, 1:]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigmas**2) + (target - mus) ** 2 / (
+        2 * sigmas**2
+    )
+    return nll.mean()
+
+
+def forecast(
+    params: Params,
+    cfg: DeepArConfig,
+    windows: jnp.ndarray,   # f32[B, context] history (raw units)
+    key: jax.Array,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample ``num_samples`` paths per series over the horizon.
+
+    Returns (samples f32[S, B, H], mean f32[B, H]) in raw units.
+    """
+    dtype = cfg.compute_dtype
+    normed, mu_n, sigma_n = normalize_windows(windows)
+    h_ctx, _, _ = _encode(params, normed, dtype)
+    b = windows.shape[0]
+    s = cfg.num_samples
+    # replicate hidden state and last value per sample path
+    h0 = jnp.broadcast_to(h_ctx[None], (s, b, h_ctx.shape[-1])).reshape(s * b, -1)
+    x0 = jnp.broadcast_to(normed[:, -1][None], (s, b)).reshape(s * b)
+
+    def step(carry, k):
+        h, x = carry
+        h = _gru_step(params, h, x, dtype)
+        mu, sigma = _emit(params, h, dtype)
+        x_next = mu + sigma * jax.random.normal(k, mu.shape)
+        return (h, x_next.astype(dtype)), x_next
+
+    keys = jax.random.split(key, cfg.horizon)
+    _, path = jax.lax.scan(step, (h0, x0.astype(dtype)), keys)  # [H, S*B]
+    path = path.reshape(cfg.horizon, s, b).transpose(1, 2, 0)   # [S, B, H]
+    raw = path * sigma_n[None] + mu_n[None]
+    return raw.astype(jnp.float32), raw.mean(0).astype(jnp.float32)
+
+
+def quantiles(samples: jnp.ndarray, qs=(0.1, 0.5, 0.9)) -> jnp.ndarray:
+    """[S, B, H] sample paths → [Q, B, H] empirical quantiles."""
+    return jnp.quantile(samples, jnp.asarray(qs), axis=0)
+
+
+def score(
+    params: Params,
+    cfg: DeepArConfig,
+    windows: jnp.ndarray,
+    n_valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """Anomaly-score adapter (same signature as lstm_ad.score): negative
+    log-likelihood of the last observed step under the model, in nats —
+    lets forecaster tenants reuse the scoring pipeline."""
+    normed, _, _ = normalize_windows(windows)
+    _, mus, sigmas = _encode(params, normed[:, :-1], cfg.compute_dtype)
+    target = normed[:, -1]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigmas[:, -1] ** 2) + (
+        target - mus[:, -1]
+    ) ** 2 / (2 * sigmas[:, -1] ** 2)
+    return jnp.where(n_valid >= 4, nll, 0.0).astype(jnp.float32)
+
+
+def train_step(params, opt_state, windows, cfg: DeepArConfig, optimizer):
+    l, grads = jax.value_and_grad(loss)(params, cfg, windows)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return params, opt_state, l

@@ -1,0 +1,122 @@
+"""LSTM anomaly detector — the flagship hot-path scorer.
+
+North-star model #1 (BASELINE.json:8 "LSTM anomaly detector on
+single-tenant DeviceMeasurement stream"; no reference counterpart — the
+reference's rule engine is threshold/CEP only, SURVEY.md §2.3).
+
+Mechanism: an LSTM reads a normalized measurement window ``x[0..W-2]`` and
+predicts each next value; the anomaly score is the prediction error of the
+*last* step (the just-ingested sample) in normalized units — i.e. "how many
+sigmas off was this sample from what the series' own dynamics predicted".
+Score ≈ 0 for nominal data, grows unboundedly for anomalies; callers
+threshold (default ~3.0).
+
+TPU notes: the recurrence is a ``lax.scan`` over time with batched [B, H]
+matmuls per step — small W (32) keeps the scan cheap; all gate matmuls fuse
+into two einsums per step on the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.models.common import Params, dense_init, normalize_windows
+
+
+@dataclass(frozen=True)
+class LstmAdConfig:
+    window: int = 32
+    hidden: int = 64
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init(key, cfg: LstmAdConfig) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    h = cfg.hidden
+    return {
+        # input (1) + hidden → 4 gates, fused
+        "wx": dense_init(k1, 1, 4 * h),
+        "wh": dense_init(k2, h, 4 * h, scale=1.0 / jnp.sqrt(h)),
+        "head": dense_init(k3, h, 1),
+    }
+
+
+def _lstm_scan(params: Params, xs: jnp.ndarray, dtype) -> jnp.ndarray:
+    """xs: [B, T] normalized values → hidden states at each step [T, B, H]."""
+    b, t = xs.shape
+    h_dim = params["wh"]["w"].shape[0]
+    wx = params["wx"]["w"].astype(dtype)
+    wh = params["wh"]["w"].astype(dtype)
+    bias = params["wx"]["b"].astype(dtype) + params["wh"]["b"].astype(dtype)
+
+    def step(carry, x_t):
+        h, c = carry
+        gates = x_t[:, None] @ wx + h @ wh + bias  # [B, 4H]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    init_carry = (
+        jnp.zeros((b, h_dim), dtype),
+        jnp.zeros((b, h_dim), dtype),
+    )
+    _, hs = jax.lax.scan(step, init_carry, xs.T.astype(dtype))
+    return hs  # [T, B, H]
+
+
+def predict_next(params: Params, cfg: LstmAdConfig, windows: jnp.ndarray) -> jnp.ndarray:
+    """One-step-ahead predictions for steps 1..W-1 (normalized space).
+
+    windows: f32[B, W] → preds f32[B, W-1] where preds[:, t] predicts
+    windows[:, t+1].
+    """
+    dtype = cfg.compute_dtype
+    normed, _, _ = normalize_windows(windows)
+    hs = _lstm_scan(params, normed[:, :-1], dtype)  # [W-1, B, H]
+    w_head = params["head"]["w"].astype(dtype)
+    b_head = params["head"]["b"].astype(dtype)
+    preds = (hs @ w_head)[..., 0] + b_head  # [W-1, B]
+    return preds.T.astype(jnp.float32)
+
+
+def score(
+    params: Params,
+    cfg: LstmAdConfig,
+    windows: jnp.ndarray,   # f32[B, W]
+    n_valid: jnp.ndarray,   # i32[B] samples actually present per window
+) -> jnp.ndarray:
+    """Anomaly score per row: |last-step prediction error| in sigma units.
+
+    Rows whose series has fewer than 4 real samples score 0 (cold start —
+    nothing to predict from yet).
+    """
+    normed, _, _ = normalize_windows(windows)
+    preds = predict_next(params, cfg, windows)
+    err = jnp.abs(normed[:, -1] - preds[:, -1])
+    return jnp.where(n_valid >= 4, err, 0.0).astype(jnp.float32)
+
+
+def loss(params: Params, cfg: LstmAdConfig, windows: jnp.ndarray) -> jnp.ndarray:
+    """Teacher-forced next-step MSE over the whole window (training)."""
+    normed, _, _ = normalize_windows(windows)
+    preds = predict_next(params, cfg, windows)
+    return jnp.mean((preds - normed[:, 1:]) ** 2)
+
+
+def train_step(
+    params: Params, opt_state, windows: jnp.ndarray, cfg: LstmAdConfig, optimizer
+) -> Tuple[Params, object, jnp.ndarray]:
+    """One optimizer step; jit with optimizer/cfg static."""
+    l, grads = jax.value_and_grad(loss)(params, cfg, windows)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return params, opt_state, l

@@ -1,0 +1,141 @@
+"""Decoder-only transformer forecaster for multi-sensor telemetry.
+
+North-star model #2b (BASELINE.json:9 — "Transformer/DeepAR forecaster");
+the transformer variant handles long telemetry histories. For histories
+that exceed one chip's appetite, the attention call routes through
+``parallel.ring.ring_attention`` (sequence-parallel shard_map) — see
+SURVEY.md §5 "long-context".
+
+TPU notes: tokens are (value, Δt-bucket) pairs embedded to ``dim``; all
+attention/MLP matmuls are bf16 einsums on the MXU; generation is a
+``lax.scan`` re-encoding the (short) context per step — O(H·T²) but T here
+is telemetry-scale (≤512), not LLM-scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.models.common import (
+    Params,
+    dense,
+    dense_init,
+    layernorm,
+    layernorm_init,
+    normalize_windows,
+    transformer_block,
+    transformer_block_init,
+)
+
+
+@dataclass(frozen=True)
+class TransformerForecasterConfig:
+    context: int = 256
+    horizon: int = 24
+    dim: int = 128
+    depth: int = 4
+    heads: int = 4
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init(key, cfg: TransformerForecasterConfig) -> Params:
+    keys = jax.random.split(key, cfg.depth + 3)
+    return {
+        "embed": dense_init(keys[0], 1, cfg.dim),
+        "pos": jax.random.normal(keys[1], (cfg.context, cfg.dim), jnp.float32) * 0.02,
+        "blocks": [
+            transformer_block_init(keys[2 + i], cfg.dim, cfg.heads)
+            for i in range(cfg.depth)
+        ],
+        "ln_f": layernorm_init(cfg.dim),
+        "head": dense_init(keys[-1], cfg.dim, 2),  # (mu, raw_sigma)
+    }
+
+
+def _backbone(params: Params, normed: jnp.ndarray, cfg) -> jnp.ndarray:
+    """normed: f32[B, T] → features [B, T, D]. T must be ≤ cfg.context."""
+    dtype = cfg.compute_dtype
+    t = normed.shape[1]
+    x = dense(params["embed"], normed[..., None].astype(dtype), dtype)
+    x = x + params["pos"][:t].astype(dtype)[None]
+    for blk in params["blocks"]:
+        x = transformer_block(blk, x, cfg.heads, causal=True, dtype=dtype)
+    return layernorm(params["ln_f"], x)
+
+
+def _emit(params: Params, feats: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    out = dense(params["head"], feats, cfg.compute_dtype).astype(jnp.float32)
+    mu = out[..., 0]
+    sigma = jax.nn.softplus(out[..., 1]) + 1e-4
+    return mu, sigma
+
+
+def loss(params: Params, cfg: TransformerForecasterConfig, windows: jnp.ndarray):
+    """Causal next-step Gaussian NLL over the window."""
+    normed, _, _ = normalize_windows(windows)
+    feats = _backbone(params, normed[:, :-1], cfg)
+    mu, sigma = _emit(params, feats, cfg)
+    target = normed[:, 1:]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigma**2) + (target - mu) ** 2 / (2 * sigma**2)
+    return nll.mean()
+
+
+def forecast(
+    params: Params,
+    cfg: TransformerForecasterConfig,
+    windows: jnp.ndarray,   # f32[B, T] raw history
+    key: jax.Array,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Autoregressive mean forecast (+1 sampled path) over the horizon.
+
+    Keeps a fixed-size rolling context (static shapes for XLA): each step
+    shifts the context left and appends the new sample.
+    Returns (samples f32[B, H], means f32[B, H]) in raw units.
+    """
+    normed, mu_n, sigma_n = normalize_windows(windows)
+    ctx = normed[:, -cfg.context :]
+    if ctx.shape[1] < cfg.context:
+        pad = cfg.context - ctx.shape[1]
+        ctx = jnp.concatenate([jnp.repeat(ctx[:, :1], pad, axis=1), ctx], axis=1)
+
+    def step(carry, k):
+        c = carry
+        feats = _backbone(params, c, cfg)
+        mu, sigma = _emit(params, feats, cfg)
+        mu_t, sigma_t = mu[:, -1], sigma[:, -1]
+        x_next = mu_t + sigma_t * jax.random.normal(k, mu_t.shape)
+        c = jnp.concatenate([c[:, 1:], x_next[:, None]], axis=1)
+        return c, (x_next, mu_t)
+
+    keys = jax.random.split(key, cfg.horizon)
+    _, (samples, means) = jax.lax.scan(step, ctx, keys)
+    samples = samples.T * sigma_n + mu_n   # [B, H] raw
+    means = means.T * sigma_n + mu_n
+    return samples.astype(jnp.float32), means.astype(jnp.float32)
+
+
+def score(params, cfg: TransformerForecasterConfig, windows, n_valid):
+    """Anomaly-score adapter: last-step NLL (same contract as lstm_ad.score)."""
+    normed, _, _ = normalize_windows(windows)
+    feats = _backbone(params, normed[:, :-1], cfg)
+    mu, sigma = _emit(params, feats, cfg)
+    target = normed[:, -1]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigma[:, -1] ** 2) + (
+        target - mu[:, -1]
+    ) ** 2 / (2 * sigma[:, -1] ** 2)
+    return jnp.where(n_valid >= 4, nll, 0.0).astype(jnp.float32)
+
+
+def train_step(params, opt_state, windows, cfg, optimizer):
+    l, grads = jax.value_and_grad(loss)(params, cfg, windows)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return params, opt_state, l

@@ -1,0 +1,105 @@
+"""ViT-B/16 frame classifier for the streaming-media path.
+
+North-star model #3 (BASELINE.json:11 "ViT-B/16 frame classification on
+streaming-media camera feed"; the reference's streaming-media service only
+stores/plays chunks — SURVEY.md §2.2 [U] — classification is rebuild-only).
+
+Standard ViT (patch embed → [CLS] + learned pos → pre-LN transformer →
+head), pure-JAX pytree params. TPU notes: patchify is a reshape+einsum (one
+big MXU matmul, no conv needed for non-overlapping patches); everything runs
+bf16; the default config is the real B/16 (86M params — fits a single v5e
+chip in bf16 with room to spare); tests use a tiny config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.models.common import (
+    Params,
+    dense,
+    dense_init,
+    layernorm,
+    layernorm_init,
+    transformer_block,
+    transformer_block_init,
+)
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    num_classes: int = 1000
+    channels: int = 3
+    dtype: str = "bfloat16"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+VIT_B16 = ViTConfig()
+VIT_TINY_TEST = ViTConfig(image_size=32, patch_size=8, dim=64, depth=2, heads=2, num_classes=10)
+
+
+def init(key, cfg: ViTConfig = VIT_B16) -> Params:
+    keys = jax.random.split(key, cfg.depth + 4)
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    return {
+        "patch": dense_init(keys[0], patch_dim, cfg.dim),
+        "cls": jax.random.normal(keys[1], (1, 1, cfg.dim), jnp.float32) * 0.02,
+        "pos": jax.random.normal(keys[2], (cfg.num_patches + 1, cfg.dim), jnp.float32)
+        * 0.02,
+        "blocks": [
+            transformer_block_init(keys[3 + i], cfg.dim, cfg.heads)
+            for i in range(cfg.depth)
+        ],
+        "ln_f": layernorm_init(cfg.dim),
+        "head": dense_init(keys[-1], cfg.dim, cfg.num_classes),
+    }
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, C] → [B, N, patch*patch*C] non-overlapping patches."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, gh * gw, patch * patch * c)
+
+
+def apply(params: Params, cfg: ViTConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """images f32[B, H, W, C] (pre-normalized) → logits f32[B, classes]."""
+    dtype = cfg.compute_dtype
+    x = dense(params["patch"], patchify(images, cfg.patch_size).astype(dtype), dtype)
+    b = x.shape[0]
+    cls = jnp.broadcast_to(params["cls"].astype(dtype), (b, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(dtype)[None]
+    for blk in params["blocks"]:
+        x = transformer_block(blk, x, cfg.heads, causal=False, dtype=dtype)
+    x = layernorm(params["ln_f"], x)
+    return dense(params["head"], x[:, 0], dtype).astype(jnp.float32)
+
+
+def loss(params: Params, cfg: ViTConfig, images: jnp.ndarray, labels: jnp.ndarray):
+    logits = apply(params, cfg, images)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+
+def train_step(params, opt_state, batch, cfg: ViTConfig, optimizer):
+    images, labels = batch
+    l, grads = jax.value_and_grad(loss)(params, cfg, images, labels)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return params, opt_state, l

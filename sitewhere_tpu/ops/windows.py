@@ -1,0 +1,165 @@
+"""On-device per-stream ring-buffer windows — the scatter/gather core.
+
+The reference has no analog (its CEP sliding windows live in Siddhi on the
+JVM — SURVEY.md §5 "long-context" [U]; reference mount empty, see provenance
+banner). This module is the TPU-native replacement: every (device,
+measurement-name) series gets a fixed-length ring buffer that lives in device
+memory, so the steady-state hot loop never ships history back and forth —
+only the new micro-batch crosses host→device each step.
+
+Design constraints (why it looks the way it does):
+
+- **Static shapes.** State is ``[S, W]`` for a fixed stream capacity ``S``
+  and window ``W``; micro-batches are padded to bucketed sizes. XLA compiles
+  each bucket once.
+- **Duplicate streams per batch.** One micro-batch routinely carries several
+  samples of the same series. A plain scatter would be order-ambiguous, so
+  we compute each row's *rank among same-stream rows* (sort + segment rank,
+  all O(B log B) inside jit) and write to ``(pos[s] + rank) % W``.
+- **Branchless padding.** Invalid rows get an out-of-range scatter index and
+  are dropped by XLA's scatter ``mode='drop'`` — no ``cond`` in the hot loop.
+- **Functional state.** ``WindowState`` is a pytree; update returns a new
+  state (donate the old one under jit for in-place HBM reuse).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class WindowState(NamedTuple):
+    """Per-stream ring buffers. All leaves live on device.
+
+    values: f32[S, W]   ring storage (raw measurement values)
+    pos:    i32[S]      next write slot per stream
+    count:  i32[S]      total samples ever written per stream (saturating add
+                        not needed: int32 @ 1M ev/s/stream ≈ 35 min to wrap is
+                        fine because only ``min(count, W)`` is ever used)
+    """
+
+    values: jnp.ndarray
+    pos: jnp.ndarray
+    count: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def window(self) -> int:
+        return self.values.shape[1]
+
+
+def init_window_state(
+    max_streams: int, window: int, dtype=jnp.float32
+) -> WindowState:
+    return WindowState(
+        values=jnp.zeros((max_streams, window), dtype),
+        pos=jnp.zeros((max_streams,), jnp.int32),
+        count=jnp.zeros((max_streams,), jnp.int32),
+    )
+
+
+def _segment_ranks(stream_ids: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rank of each row among rows sharing its stream id, plus per-row
+    total count of rows with that id. Works on padded ids too.
+
+    Returns (ranks i32[B], totals i32[B]) in the *original* row order.
+    """
+    b = stream_ids.shape[0]
+    order = jnp.argsort(stream_ids, stable=True)
+    sorted_ids = stream_ids[order]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]]
+    )
+    # index of the start of each run, broadcast along the run via cummax
+    start_idx = jax.lax.cummax(jnp.where(is_start, idx, -1))
+    ranks_sorted = idx - start_idx
+    # per-run totals: rank of the last row of the run + 1, broadcast backwards
+    is_end = jnp.concatenate(
+        [sorted_ids[1:] != sorted_ids[:-1], jnp.ones((1,), bool)]
+    )
+    last_rank = jax.lax.cummax(
+        jnp.where(is_end, ranks_sorted, -1)[::-1]
+    )[::-1]
+    totals_sorted = last_rank + 1
+    inv = jnp.argsort(order, stable=True)
+    return ranks_sorted[inv].astype(jnp.int32), totals_sorted[inv].astype(jnp.int32)
+
+
+def update_windows(
+    state: WindowState,
+    stream_ids: jnp.ndarray,  # i32[B]
+    values: jnp.ndarray,      # f32[B]
+    valid: jnp.ndarray,       # bool[B]
+) -> WindowState:
+    """Append a micro-batch into the ring buffers (order-preserving within
+    a stream). Pure, jit-friendly, static-shaped."""
+    s, w = state.values.shape
+    ranks, totals = _segment_ranks(jnp.where(valid, stream_ids, -1))
+    write_slot = (state.pos[stream_ids] + ranks) % w
+    flat_idx = stream_ids * w + write_slot
+    # invalid rows → out-of-range index → dropped by scatter mode='drop'.
+    # Bursts of > W same-stream rows in one batch: only the newest W rows
+    # write (older ones would be overwritten in sequential order anyway;
+    # without this, duplicate scatter indices pick an unspecified winner).
+    newest_w = ranks >= (totals - w)
+    flat_idx = jnp.where(valid & newest_w, flat_idx, s * w)
+    new_values = (
+        state.values.reshape(-1)
+        .at[flat_idx]
+        .set(values.astype(state.values.dtype), mode="drop")
+        .reshape(s, w)
+    )
+    ones = jnp.where(valid, 1, 0).astype(jnp.int32)
+    safe_ids = jnp.where(valid, stream_ids, s)  # drop row for invalid
+    per_stream = jnp.zeros((s,), jnp.int32).at[safe_ids].add(ones, mode="drop")
+    return WindowState(
+        values=new_values,
+        pos=(state.pos + per_stream) % w,
+        count=state.count + per_stream,
+    )
+
+
+def gather_windows(
+    state: WindowState,
+    stream_ids: jnp.ndarray,  # i32[B]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize time-ordered windows for each requested stream.
+
+    Returns (windows f32[B, W] oldest→newest, n_valid i32[B] clamped to W).
+    Streams with fewer than W samples are left-padded with their oldest
+    value (constant padding keeps models shift-robust without NaNs).
+    """
+    s, w = state.values.shape
+    raw = state.values[stream_ids]            # [B, W] ring order
+    pos = state.pos[stream_ids]               # [B]
+    # roll each row so oldest..newest; slot (pos) is the oldest entry
+    col = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = (pos[:, None] + col) % w
+    ordered = jnp.take_along_axis(raw, src, axis=1)
+    n = jnp.minimum(state.count[stream_ids], w)  # [B]
+    # left-pad short windows with their first valid sample
+    first_valid_col = w - n
+    first_val = jnp.take_along_axis(
+        ordered, jnp.minimum(first_valid_col, w - 1)[:, None], axis=1
+    )
+    windows = jnp.where(col < first_valid_col[:, None], first_val, ordered)
+    return windows, n
+
+
+def update_and_gather(
+    state: WindowState,
+    stream_ids: jnp.ndarray,
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> Tuple[WindowState, jnp.ndarray, jnp.ndarray]:
+    """Fused hot-path step: append batch, then gather each row's window
+    *including* the row itself as the newest element."""
+    new_state = update_windows(state, stream_ids, values, valid)
+    windows, n = gather_windows(new_state, stream_ids)
+    return new_state, windows, n

@@ -1,0 +1,25 @@
+"""Parallelism layer: mesh management, tenant routing, sharded scoring.
+
+The reference scales by Kafka partitions + k8s replicas and has no ML
+parallelism (SURVEY.md §2 parallelism census [U]). The rebuild's distributed
+story is jax.sharding over a device Mesh:
+
+- ``mesh``          Mesh construction (real TPU or virtual CPU devices),
+                    axis conventions (tenant/data/model).
+- ``tenant_router`` tenant → mesh-shard placement (the north star's
+                    "tenant-engine router maps tenants onto TPU mesh axes").
+- ``sharded``       stacked per-tenant params + shard_map scoring across the
+                    tenant axis; dp/tp helpers for the bigger models.
+- ``ring``          ring attention (sequence parallelism) for long-history
+                    forecasting.
+"""
+
+from sitewhere_tpu.parallel.mesh import MeshManager, default_mesh
+from sitewhere_tpu.parallel.tenant_router import TenantRouter, TenantPlacement
+
+__all__ = [
+    "MeshManager",
+    "default_mesh",
+    "TenantRouter",
+    "TenantPlacement",
+]

@@ -1,0 +1,112 @@
+"""Device-mesh management: axis conventions + construction.
+
+Axis conventions for the whole framework (SURVEY.md §1 "TPU-rebuild layer
+correspondence"):
+
+- ``tenant``  — shards of the multitenant axis; per-tenant model params are
+  stacked along it and never cross it (no collectives on this axis in the
+  scoring hot path → pure SPMD fan-out, ICI silent).
+- ``data``    — data parallelism inside a tenant shard (batch split; psum
+  for training grads).
+- ``model``   — tensor parallelism for the big models (ViT/transformer
+  heads/mlp split; all_gather/reduce_scatter ride ICI).
+
+A v5e-8 defaults to (tenant=4, data=2, model=1) for the 32-tenant config
+[BASELINE.json:10]; tests use 8 virtual CPU devices via
+``--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("sitewhere.mesh")
+
+AXIS_TENANT = "tenant"
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+
+def default_mesh(
+    tenant: int = 0,
+    data: int = 0,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the canonical 3-axis mesh over available devices.
+
+    Zero for ``tenant``/``data`` means "infer": model axis is honored first,
+    then tenants get as many shards as possible (the north-star metric is
+    tenants/chip), data parallelism absorbs the remainder.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if model < 1 or n % model:
+        raise ValueError(f"model axis {model} does not divide {n} devices")
+    rest = n // model
+    if tenant == 0 and data == 0:
+        tenant, data = rest, 1
+    elif tenant == 0:
+        tenant = rest // data
+    elif data == 0:
+        data = rest // tenant
+    if tenant * data * model != n:
+        raise ValueError(
+            f"mesh axes tenant={tenant} data={data} model={model} "
+            f"!= {n} devices"
+        )
+    arr = np.asarray(devs).reshape(tenant, data, model)
+    return Mesh(arr, (AXIS_TENANT, AXIS_DATA, AXIS_MODEL))
+
+
+class MeshManager:
+    """Owns the instance's Mesh and hands out shardings.
+
+    Lifecycle-wise this sits in the instance (one mesh per process);
+    tenant engines get their shard index from the ``TenantRouter``.
+    """
+
+    def __init__(
+        self,
+        tenant: int = 0,
+        data: int = 0,
+        model: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> None:
+        self.mesh = default_mesh(tenant, data, model, devices)
+
+    @property
+    def n_tenant_shards(self) -> int:
+        return self.mesh.shape[AXIS_TENANT]
+
+    @property
+    def n_data_shards(self) -> int:
+        return self.mesh.shape[AXIS_DATA]
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.mesh.shape.values())
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def tenant_stacked(self) -> NamedSharding:
+        """Sharding for arrays with a leading stacked-tenant dim: shard dim 0
+        across the tenant axis, replicate across data/model."""
+        return self.sharding(AXIS_TENANT)
+
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    def describe(self) -> dict:
+        return {
+            "devices": self.n_devices,
+            "platform": jax.devices()[0].platform,
+            "axes": dict(self.mesh.shape),
+        }

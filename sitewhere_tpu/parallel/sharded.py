@@ -1,0 +1,193 @@
+"""Stacked multi-tenant scoring under ``shard_map`` — the SPMD hot path.
+
+The 32-tenant concurrent-scoring config (BASELINE.json:10) runs here. Layout
+(one model family per stack; SURVEY.md §7 "tenants-on-mesh"):
+
+- params:  every leaf gains a leading stacked-tenant dim ``[T, ...]``,
+  sharded along the mesh ``tenant`` axis (T = n_tenant_shards ×
+  slots_per_shard).
+- window state: ``[T, S, W]`` — T over ``tenant``, stream capacity S over
+  ``data`` (each data shard owns a disjoint set of streams, so window
+  updates never race across shards and the hot path needs **zero
+  collectives**: pure SPMD fan-out, ICI stays free for training traffic).
+- batches: ``[T, B]`` with B over ``data``; the micro-batcher routes each
+  stream to its owning (tenant-slot, data-shard) lane and uses *local*
+  stream ids, so device code never translates indices.
+- active mask ``[T]``: tenants start/stop by flipping a mask bit — no
+  recompile (SURVEY.md §7 hard parts: "handle tenant start/stop without
+  recompiling the world").
+
+``shard_map`` + vmap-over-slots is the whole trick: each device scores its
+resident tenants' events against its resident window state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from sitewhere_tpu.models import ModelSpec
+from sitewhere_tpu.ops.windows import WindowState, init_window_state, update_and_gather
+from sitewhere_tpu.parallel.mesh import AXIS_DATA, AXIS_TENANT, MeshManager
+
+Params = Any
+
+
+def stack_params(params_list: List[Params]) -> Params:
+    """[pytree, ...] → pytree with leading stacked-tenant dim."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def unstack_slot(stacked: Params, idx: int) -> Params:
+    return jax.tree_util.tree_map(lambda x: x[idx], stacked)
+
+
+def set_slot(stacked: Params, idx: int, params: Params) -> Params:
+    """Write one tenant's params into its slot (donate under jit for
+    in-place HBM update — how tenant hot-swap avoids recompiles)."""
+    return jax.tree_util.tree_map(
+        lambda s, p: s.at[idx].set(p.astype(s.dtype)), stacked, params
+    )
+
+
+def init_stacked_state(
+    n_slots: int, max_streams: int, window: int
+) -> WindowState:
+    """Stacked window state [T, S, W]; S is the *global* stream capacity
+    (split across data shards inside shard_map)."""
+    st = init_window_state(max_streams, window)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (n_slots,) + x.shape).copy(), st
+    )
+
+
+class ShardedScorer:
+    """Compiled multi-tenant scoring step over the mesh.
+
+    One instance per model family. Host-side state (params, windows) lives
+    as sharded jax.Arrays owned by this object; ``step`` is the only device
+    round-trip on the hot path.
+    """
+
+    def __init__(
+        self,
+        mm: MeshManager,
+        spec: ModelSpec,
+        cfg,
+        slots_per_shard: int = 8,
+        max_streams: int = 4096,
+        window: int = 32,
+        seed: int = 0,
+    ) -> None:
+        if spec.score is None:
+            raise ValueError(f"model '{spec.name}' has no scorer contract")
+        self.mm = mm
+        self.spec = spec
+        self.cfg = cfg
+        self.slots_per_shard = slots_per_shard
+        self.n_slots = mm.n_tenant_shards * slots_per_shard
+        if max_streams % mm.n_data_shards:
+            raise ValueError(
+                f"max_streams {max_streams} must divide across "
+                f"{mm.n_data_shards} data shards"
+            )
+        self.max_streams = max_streams
+        self.window = window
+
+        # identical init per slot; per-tenant training diverges them later
+        key = jax.random.PRNGKey(seed)
+        base = spec.init(key, cfg)
+        self._base_params = base  # pristine copy for slot recycling
+        stacked = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (self.n_slots,) + x.shape).copy(),
+            base,
+        )
+        t_shard = mm.tenant_stacked()
+        self.params = jax.device_put(stacked, t_shard)
+        state = init_stacked_state(self.n_slots, max_streams, window)
+        st_sharding = mm.sharding(AXIS_TENANT, AXIS_DATA)
+        self.state = WindowState(
+            values=jax.device_put(state.values, st_sharding),
+            pos=jax.device_put(state.pos, st_sharding),
+            count=jax.device_put(state.count, st_sharding),
+        )
+        self.active = jax.device_put(
+            jnp.zeros((self.n_slots,), bool), t_shard
+        )
+        self._step = self._build_step()
+
+    # -- compiled step ---------------------------------------------------
+    def _build_step(self) -> Callable:
+        mesh = self.mm.mesh
+        spec, cfg = self.spec, self.cfg
+
+        def local_step(params, state, active, ids, vals, valid):
+            # local shapes: params [T_loc, ...], state [T_loc, S_loc, W],
+            # ids/vals/valid [T_loc, B_loc]
+            def one(p, st, act, i, v, m):
+                st2, w, n = update_and_gather(st, i, v, m)
+                s = spec.score(p, cfg, w, n)
+                return st2, jnp.where(act & m, s, 0.0)
+
+            return jax.vmap(one)(params, state, active, ids, vals, valid)
+
+        smapped = jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(
+                P(AXIS_TENANT),              # params
+                P(AXIS_TENANT, AXIS_DATA),   # window state (S over data)
+                P(AXIS_TENANT),              # active mask
+                P(AXIS_TENANT, AXIS_DATA),   # stream ids (B over data)
+                P(AXIS_TENANT, AXIS_DATA),   # values
+                P(AXIS_TENANT, AXIS_DATA),   # valid
+            ),
+            out_specs=(
+                P(AXIS_TENANT, AXIS_DATA),   # new state
+                P(AXIS_TENANT, AXIS_DATA),   # scores
+            ),
+            # scan carries are zeros-initialized inside the mapped body;
+            # the varying-axis checker would demand pcasts on every carry
+            check_vma=False,
+        )
+        return jax.jit(smapped, donate_argnums=(1,))
+
+    def step(
+        self,
+        stream_ids: jnp.ndarray,  # i32[T, B] LOCAL ids per data shard lane
+        values: jnp.ndarray,      # f32[T, B]
+        valid: jnp.ndarray,       # bool[T, B]
+    ) -> jnp.ndarray:
+        """Score one stacked micro-batch; returns f32[T, B] scores."""
+        self.state, scores = self._step(
+            self.params, self.state, self.active, stream_ids, values, valid
+        )
+        return scores
+
+    # -- slot management -------------------------------------------------
+    def activate(self, global_slot: int, params: Params = None) -> None:
+        if params is not None:
+            self.params = jax.jit(set_slot, static_argnums=1, donate_argnums=0)(
+                self.params, global_slot, params
+            )
+        self.active = self.active.at[global_slot].set(True)
+
+    def deactivate(self, global_slot: int) -> None:
+        self.active = self.active.at[global_slot].set(False)
+
+    def reset_slot(self, global_slot: int) -> None:
+        """Wipe a slot's window state + params back to pristine — a recycled
+        slot must not leak the previous tenant's history or trained weights."""
+        self.deactivate(global_slot)
+        self.params = set_slot(self.params, global_slot, self._base_params)
+        self.state = WindowState(
+            values=self.state.values.at[global_slot].set(0.0),
+            pos=self.state.pos.at[global_slot].set(0),
+            count=self.state.count.at[global_slot].set(0),
+        )
+
+    def slot_params(self, global_slot: int) -> Params:
+        return unstack_slot(self.params, global_slot)

@@ -1,0 +1,141 @@
+"""Tenant → mesh-shard router.
+
+The north star's centerpiece: "the multitenant gRPC tenant-engine router
+maps tenants onto TPU mesh axes so per-tenant models co-reside on chip"
+(BASELINE.json north_star; no reference counterpart — the reference routes
+tenants to JVM tenant engines, SURVEY.md §2.3).
+
+Placement model: the mesh's ``tenant`` axis has N shards; each shard hosts a
+fixed number of *slots* per model family (XLA's static-shape world: stacked
+params are [slots, ...] per shard, so slot count is a compile-time constant
+— SURVEY.md §7 "tenants-on-mesh"). A tenant is placed at (family, shard,
+slot); heterogeneous families never mix in one stack. Start/stop of a tenant
+flips a slot's active mask — no recompile.
+
+Failover: ``failover(tenant)`` re-places a tenant on a different shard
+(SURVEY.md §5 "tenant-engine failover to a different mesh shard").
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+logger = logging.getLogger("sitewhere.tenant_router")
+
+
+@dataclass(frozen=True)
+class TenantPlacement:
+    tenant: str
+    family: str     # model-zoo key; tenants stack only with their own family
+    shard: int      # index along the mesh tenant axis
+    slot: int       # index within the shard's stacked params
+    generation: int = 0  # bumped on failover/re-place
+
+
+class PlacementError(RuntimeError):
+    pass
+
+
+class TenantRouter:
+    """Allocates (shard, slot) per tenant, balancing tenants across shards."""
+
+    def __init__(self, n_shards: int, slots_per_shard: int = 8) -> None:
+        if n_shards < 1 or slots_per_shard < 1:
+            raise ValueError("n_shards and slots_per_shard must be >= 1")
+        self.n_shards = n_shards
+        self.slots_per_shard = slots_per_shard
+        self._placements: Dict[str, TenantPlacement] = {}
+        # family → shard → set of used slots
+        self._used: Dict[str, List[Set[int]]] = {}
+
+    # -- capacity --------------------------------------------------------
+    @property
+    def capacity_per_family(self) -> int:
+        return self.n_shards * self.slots_per_shard
+
+    def shard_load(self, family: str) -> List[int]:
+        used = self._used.get(family)
+        if used is None:
+            return [0] * self.n_shards
+        return [len(s) for s in used]
+
+    def tenants_on(self, shard: int, family: Optional[str] = None) -> List[str]:
+        return sorted(
+            t
+            for t, p in self._placements.items()
+            if p.shard == shard and (family is None or p.family == family)
+        )
+
+    def global_slot(self, p: TenantPlacement) -> int:
+        return p.shard * self.slots_per_shard + p.slot
+
+    # -- placement -------------------------------------------------------
+    def place(
+        self, tenant: str, family: str = "lstm_ad", prefer_shard: Optional[int] = None
+    ) -> TenantPlacement:
+        if tenant in self._placements:
+            return self._placements[tenant]
+        used = self._used.setdefault(
+            family, [set() for _ in range(self.n_shards)]
+        )
+        order = sorted(range(self.n_shards), key=lambda s: (len(used[s]), s))
+        if prefer_shard is not None:
+            order = [prefer_shard] + [s for s in order if s != prefer_shard]
+        for shard in order:
+            if len(used[shard]) < self.slots_per_shard:
+                slot = min(set(range(self.slots_per_shard)) - used[shard])
+                used[shard].add(slot)
+                p = TenantPlacement(tenant, family, shard, slot)
+                self._placements[tenant] = p
+                logger.info("placed tenant %s → %s/%d.%d", tenant, family, shard, slot)
+                return p
+        raise PlacementError(
+            f"no capacity for tenant '{tenant}' (family={family}, "
+            f"{self.capacity_per_family} slots all used)"
+        )
+
+    def remove(self, tenant: str) -> None:
+        p = self._placements.pop(tenant, None)
+        if p is not None:
+            self._used[p.family][p.shard].discard(p.slot)
+
+    def placement(self, tenant: str) -> Optional[TenantPlacement]:
+        return self._placements.get(tenant)
+
+    def failover(self, tenant: str) -> TenantPlacement:
+        """Move a tenant off its current shard (e.g. shard marked unhealthy)."""
+        old = self._placements.get(tenant)
+        if old is None:
+            raise PlacementError(f"tenant '{tenant}' is not placed")
+        used = self._used[old.family]
+        candidates = sorted(
+            (s for s in range(self.n_shards) if s != old.shard),
+            key=lambda s: (len(used[s]), s),
+        )
+        for shard in candidates:
+            if len(used[shard]) < self.slots_per_shard:
+                used[old.shard].discard(old.slot)
+                slot = min(set(range(self.slots_per_shard)) - used[shard])
+                used[shard].add(slot)
+                p = TenantPlacement(
+                    tenant, old.family, shard, slot, generation=old.generation + 1
+                )
+                self._placements[tenant] = p
+                logger.warning(
+                    "failover tenant %s: shard %d → %d", tenant, old.shard, shard
+                )
+                return p
+        raise PlacementError(f"no shard available for failover of '{tenant}'")
+
+    # -- introspection ---------------------------------------------------
+    def describe(self) -> dict:
+        return {
+            "n_shards": self.n_shards,
+            "slots_per_shard": self.slots_per_shard,
+            "placements": {
+                t: {"family": p.family, "shard": p.shard, "slot": p.slot}
+                for t, p in sorted(self._placements.items())
+            },
+        }

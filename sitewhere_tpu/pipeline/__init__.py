@@ -1,0 +1,7 @@
+"""L4 event pipeline: ingest → decode → inbound → tpu-inference → persist
+→ rules → outbound, plus command delivery (SURVEY.md §3.1/§3.2).
+
+Each stage is a lifecycle component consuming/producing bus topics; the
+whole pipeline runs in one process over the in-proc bus (prod: Kafka shim
+behind the same interface).
+"""

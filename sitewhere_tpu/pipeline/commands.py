@@ -1,0 +1,208 @@
+"""Command delivery: cloud → device command invocations.
+
+Capability parity with the reference's service-command-delivery
+(``ICommandDestination`` MQTT/CoAP/SMS destinations, command encoders
+(protobuf/JSON), routing by device type, parameter extractors — SURVEY.md
+§2.2/§3.2 [U]; reference mount empty, see provenance banner).
+
+Flow (§3.2): command-invocations topic → look up device/type/command →
+validate+encode → destination.deliver to the per-device topic; undeliverable
+invocations go to the undelivered topic for inspection/retry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Dict, List, Optional, Protocol
+
+from sitewhere_tpu.core.events import DeviceCommandInvocation
+from sitewhere_tpu.core.model import Device, DeviceCommand
+from sitewhere_tpu.pipeline.decoders import MAGIC
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.device_management import DeviceManagement
+
+
+class CommandEncodeError(ValueError):
+    pass
+
+
+def _coerce(value: str, ptype: str):
+    try:
+        if ptype == "double":
+            return float(value)
+        if ptype == "int64":
+            return int(value)
+        if ptype == "bool":
+            return value.lower() in ("1", "true", "yes")
+        return value
+    except ValueError as exc:
+        raise CommandEncodeError(f"parameter not a {ptype}: {value!r}") from exc
+
+
+def validate_parameters(cmd: DeviceCommand, params: Dict[str, str]) -> Dict[str, object]:
+    """Check required params + coerce types per the command signature."""
+    out: Dict[str, object] = {}
+    for p in cmd.parameters:
+        name, ptype = p.get("name", ""), p.get("type", "string")
+        required = str(p.get("required", "false")).lower() == "true"
+        if name in params:
+            out[name] = _coerce(params[name], ptype)
+        elif required:
+            raise CommandEncodeError(f"missing required parameter '{name}'")
+    return out
+
+
+class JsonCommandEncoder:
+    """Canonical JSON command frame."""
+
+    name = "json"
+
+    def encode(
+        self, inv: DeviceCommandInvocation, cmd: DeviceCommand, params: Dict[str, object]
+    ) -> bytes:
+        return json.dumps(
+            {
+                "command": cmd.name,
+                "namespace": cmd.namespace,
+                "invocation_id": inv.id,
+                "parameters": params,
+            },
+            separators=(",", ":"),
+        ).encode()
+
+
+class BinaryCommandEncoder:
+    """Compact binary frame matching the device binary spec family
+    (``pipeline.decoders`` binary format; msg_type 0x10 = command)."""
+
+    name = "binary"
+    MSG_COMMAND = 0x10
+
+    def encode(self, inv, cmd, params) -> bytes:
+        body = json.dumps(params, separators=(",", ":")).encode()
+        out = struct.pack("<HBB", MAGIC, 1, self.MSG_COMMAND)
+        for s in (inv.device_token, cmd.name, inv.id):
+            b = s.encode()
+            out += struct.pack("<B", len(b)) + b
+        out += struct.pack("<H", len(body)) + body
+        return out
+
+
+class CommandDestination(Protocol):
+    async def deliver(self, device: Device, payload: bytes, inv: DeviceCommandInvocation) -> None: ...
+
+
+class BrokerCommandDestination:
+    """Publishes encoded commands to the per-device topic on the sim/MQTT
+    broker (the reference's MQTT parameter-extractor destination [U])."""
+
+    def __init__(self, broker, topic_pattern: str = "sitewhere/command/{device}") -> None:
+        self.broker = broker
+        self.topic_pattern = topic_pattern
+
+    async def deliver(self, device: Device, payload: bytes, inv) -> None:
+        await self.broker.publish(
+            self.topic_pattern.format(device=device.token), payload
+        )
+
+
+class CollectingDestination:
+    """Test/dev destination: collects (device_token, payload) pairs."""
+
+    def __init__(self) -> None:
+        self.deliveries: List[tuple] = []
+
+    async def deliver(self, device: Device, payload: bytes, inv) -> None:
+        self.deliveries.append((device.token, payload, inv.id))
+
+
+class CommandDelivery(LifecycleComponent):
+    """Per-tenant command-delivery stage."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        device_management: DeviceManagement,
+        destination: CommandDestination,
+        encoder: str = "json",
+        metrics: Optional[MetricsRegistry] = None,
+        poll_batch: int = 1024,
+    ) -> None:
+        super().__init__(f"command-delivery[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.dm = device_management
+        self.destination = destination
+        self.encoder = (
+            JsonCommandEncoder() if encoder == "json" else BinaryCommandEncoder()
+        )
+        self.metrics = metrics or MetricsRegistry()
+        self.poll_batch = poll_batch
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def group(self) -> str:
+        return f"command-delivery[{self.tenant}]"
+
+    async def on_start(self) -> None:
+        self.bus.subscribe(
+            self.bus.naming.command_invocations(self.tenant), self.group
+        )
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        src = self.bus.naming.command_invocations(self.tenant)
+        while True:
+            invocations = await self.bus.consume(src, self.group, self.poll_batch)
+            for inv in invocations:
+                await self.deliver_invocation(inv)
+
+    async def deliver_invocation(self, inv: DeviceCommandInvocation) -> bool:
+        delivered = self.metrics.counter("command_delivery.delivered")
+        undelivered = self.metrics.counter("command_delivery.undelivered")
+
+        async def fail(reason: str) -> bool:
+            undelivered.inc()
+            await self.bus.publish(
+                self.bus.naming.undelivered_commands(self.tenant),
+                {"invocation": inv.to_dict(), "reason": reason},
+            )
+            return False
+
+        device = self.dm.get_device(inv.device_token)
+        if device is None:
+            return await fail(f"unknown device '{inv.device_token}'")
+        dtype = self.dm.get_device_type(device.device_type_token)
+        if dtype is None:
+            return await fail(f"unknown device type '{device.device_type_token}'")
+        cmd = dtype.command_by_token(inv.command_token) or next(
+            (c for c in dtype.commands if c.name == inv.command_token), None
+        )
+        if cmd is None:
+            return await fail(f"unknown command '{inv.command_token}'")
+        try:
+            params = validate_parameters(cmd, inv.parameters)
+            payload = self.encoder.encode(inv, cmd, params)
+        except CommandEncodeError as exc:
+            return await fail(str(exc))
+        try:
+            await self.destination.deliver(device, payload, inv)
+        except Exception as exc:  # noqa: BLE001
+            self._record_error("deliver", exc)
+            return await fail(f"destination error: {exc!r}")
+        delivered.inc()
+        return True

@@ -1,0 +1,122 @@
+"""Inbound processing: validate, enrich, re-emit for scoring/persistence.
+
+Capability parity with the reference's service-inbound-processing (consume
+decoded events; look up device + active assignment via device-management;
+route unregistered devices to the registration topic; re-emit enriched
+events — SURVEY.md §2.2/§3.1 [U]; reference mount empty, see provenance
+banner).
+
+Redesign: the lookup is an in-proc call into the tenant's
+``DeviceManagement`` store (the reference pays a cached gRPC hop here);
+enriched requests are materialized into typed events
+(``core.events``) with the assignment/area/asset context attached, and
+published to the inbound-events topic that the tpu-inference stage consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from sitewhere_tpu.core.events import (
+    DeviceEvent,
+    event_from_dict,
+    now_ms,
+)
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.device_management import DeviceManagement
+
+
+class InboundProcessor(LifecycleComponent):
+    """Per-tenant inbound stage: decoded-events → inbound-events."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        device_management: DeviceManagement,
+        metrics: Optional[MetricsRegistry] = None,
+        poll_batch: int = 1024,
+    ) -> None:
+        super().__init__(f"inbound-processing[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.dm = device_management
+        self.metrics = metrics or MetricsRegistry()
+        self.poll_batch = poll_batch
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def group(self) -> str:
+        return f"inbound-processing[{self.tenant}]"
+
+    async def on_start(self) -> None:
+        self.bus.subscribe(self.bus.naming.decoded_events(self.tenant), self.group)
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        src = self.bus.naming.decoded_events(self.tenant)
+        while True:
+            requests = await self.bus.consume(src, self.group, self.poll_batch)
+            for req in requests:
+                await self.process_request(req)
+
+    async def process_request(self, req: Dict) -> Optional[DeviceEvent]:
+        """Process one decoded request; returns the enriched event if one
+        was emitted (None for registrations / rejects)."""
+        processed = self.metrics.counter("inbound.processed")
+        unregistered = self.metrics.counter("inbound.unregistered")
+        rejected = self.metrics.counter("inbound.rejected")
+
+        rtype = req.get("type", "measurement")
+        if rtype == "register":
+            await self.bus.publish(
+                self.bus.naming.unregistered_devices(self.tenant), req
+            )
+            unregistered.inc()
+            return None
+
+        device_token = req.get("device_token", "")
+        device = self.dm.get_device(device_token)
+        if device is None:
+            # unknown device → registration pipeline decides (SURVEY.md §3.1)
+            await self.bus.publish(
+                self.bus.naming.unregistered_devices(self.tenant), dict(req)
+            )
+            unregistered.inc()
+            return None
+        assignment = self.dm.active_assignment_for(device_token)
+        if assignment is None:
+            rejected.inc()
+            return None
+
+        enriched = dict(req)
+        enriched.pop("_source", None)
+        enriched["tenant"] = self.tenant
+        enriched["assignment_token"] = assignment.token
+        enriched["area_token"] = assignment.area_token
+        enriched["asset_token"] = assignment.asset_token
+        enriched["customer_token"] = assignment.customer_token
+        enriched.setdefault("received_ts", now_ms())
+        try:
+            event = event_from_dict(enriched)
+        except (ValueError, KeyError):
+            rejected.inc()
+            return None
+        event.mark("inbound")
+        await self.bus.publish(
+            self.bus.naming.inbound_events(self.tenant), event
+        )
+        processed.inc()
+        return event

@@ -1,0 +1,316 @@
+"""tpu-inference: the rebuild's new pipeline stage (the north star).
+
+"A new tpu-inference tenant-engine microservice sits between
+inbound-processing and event-management on the bus, micro-batching
+DeviceMeasurement events into JAX/XLA pjit calls on a TPU pod"
+(BASELINE.json north_star; no reference counterpart — SURVEY.md §2.3).
+
+Dataflow per scoring cycle:
+
+  inbound-events[tenant_i] ─┐   (async poll, all active tenants)
+  inbound-events[tenant_j] ─┼→ lanes[(slot, data_shard)] pending queues
+          ...              ─┘        │ flush on deadline_ms OR full bucket
+                                     ▼
+              stacked arrays i32/f32[T, D·B] (bucketed static shapes)
+                                     ▼
+              ShardedScorer.step  — ONE jit call scores every tenant
+                                     ▼
+              scores → events (score field) → tpu-scored-events[tenant]
+
+Latency accounting is first-class (the p99 < 50 ms budget, BASELINE.json:5):
+each event carries trace marks; the ``tpu_inference.latency`` histogram
+records received→scored wall time.
+
+Tenant start/stop flips the scorer's active mask — no recompile; batch-size
+buckets keep XLA at a handful of compiled shapes (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sitewhere_tpu.core.events import DeviceMeasurement
+from sitewhere_tpu.models import get_model, make_config
+from sitewhere_tpu.parallel.mesh import MeshManager
+from sitewhere_tpu.parallel.sharded import ShardedScorer
+from sitewhere_tpu.parallel.tenant_router import TenantRouter
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.config import TenantEngineConfig
+from sitewhere_tpu.runtime.lifecycle import LifecycleState
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
+
+
+class StreamRegistry:
+    """Per-tenant map (device_token, name) → (data_shard, local_id).
+
+    Streams are pinned to a data shard at first sight (least-loaded wins),
+    so window updates for a stream always land on the same device and the
+    scoring step needs no collectives (see ``parallel.sharded``).
+    """
+
+    def __init__(self, n_data_shards: int, local_capacity: int) -> None:
+        self.n_data_shards = n_data_shards
+        self.local_capacity = local_capacity
+        self._map: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        self._next: List[int] = [0] * n_data_shards
+
+    def lookup_or_assign(
+        self, device_token: str, name: str
+    ) -> Optional[Tuple[int, int]]:
+        key = (device_token, name)
+        hit = self._map.get(key)
+        if hit is not None:
+            return hit
+        shard = min(range(self.n_data_shards), key=lambda d: self._next[d])
+        if self._next[shard] >= self.local_capacity:
+            return None  # capacity exhausted; caller passes event through unscored
+        local_id = self._next[shard]
+        self._next[shard] += 1
+        self._map[key] = (shard, local_id)
+        return shard, local_id
+
+    @property
+    def n_streams(self) -> int:
+        return len(self._map)
+
+
+class TpuInferenceEngine(TenantEngine):
+    """Per-tenant engine: placement on the mesh + stream registry."""
+
+    def __init__(self, config: TenantEngineConfig, service: "TpuInferenceService") -> None:
+        super().__init__("tpu-inference", config)
+        self.service = service
+        self.placement = None
+        self.streams: Optional[StreamRegistry] = None
+
+    async def on_start(self) -> None:
+        svc = self.service
+        self.placement = svc.router.place(self.tenant, family=self.config.model)
+        scorer = svc.scorer_for_family(self.config.model, self.config)
+        self.streams = StreamRegistry(
+            svc.mm.n_data_shards, scorer.max_streams // svc.mm.n_data_shards
+        )
+        svc.bus.subscribe(svc.bus.naming.inbound_events(self.tenant), svc.group)
+        scorer.activate(svc.router.global_slot(self.placement))
+
+    async def on_stop(self) -> None:
+        svc = self.service
+        if self.placement is not None:
+            scorer = svc.scorers.get(self.config.model)
+            if scorer is not None:
+                # full wipe: a recycled slot must not leak this tenant's
+                # window history or params to the next occupant
+                scorer.reset_slot(svc.router.global_slot(self.placement))
+            svc.router.remove(self.tenant)
+            self.placement = None
+
+
+class TpuInferenceService(MultitenantService):
+    """Hosts the scorers + the scoring loop across all tenant engines."""
+
+    def __init__(
+        self,
+        bus: EventBus,
+        mm: Optional[MeshManager] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        slots_per_shard: int = 8,
+        poll_batch: int = 8192,
+    ) -> None:
+        super().__init__("tpu-inference", bus, self._make_engine)
+        self.mm = mm or MeshManager()
+        self.metrics = metrics or MetricsRegistry()
+        self.slots_per_shard = slots_per_shard
+        self.poll_batch = poll_batch
+        self.router = TenantRouter(self.mm.n_tenant_shards, slots_per_shard)
+        self.scorers: Dict[str, ShardedScorer] = {}
+        # pending measurement lanes: family → (slot, dshard) → deque of
+        # (local_id, value, event)
+        self._lanes: Dict[str, Dict[Tuple[int, int], Deque]] = {}
+        self._first_pending_ts: Dict[str, float] = {}
+        self._loop_task: Optional[asyncio.Task] = None
+
+    @property
+    def group(self) -> str:
+        return "tpu-inference"
+
+    def _make_engine(self, cfg: TenantEngineConfig) -> TpuInferenceEngine:
+        return TpuInferenceEngine(cfg, self)
+
+    def scorer_for_family(self, family: str, cfg: TenantEngineConfig) -> ShardedScorer:
+        scorer = self.scorers.get(family)
+        if scorer is None:
+            spec = get_model(family)
+            mcfg = make_config(family, {
+                **cfg.model_config, "window": cfg.microbatch.window,
+            })
+            scorer = ShardedScorer(
+                self.mm,
+                spec,
+                mcfg,
+                slots_per_shard=self.slots_per_shard,
+                max_streams=cfg.max_streams,
+                window=cfg.microbatch.window,
+            )
+            self.scorers[family] = scorer
+            self._lanes[family] = {}
+        return scorer
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_start(self) -> None:
+        await super().on_start()
+        self._loop_task = asyncio.create_task(
+            self._scoring_loop(), name="tpu-inference-loop"
+        )
+
+    async def on_stop(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    # -- ingestion → lanes ----------------------------------------------
+    def _enqueue(self, engine: TpuInferenceEngine, events: List) -> List:
+        """Route a tenant's polled events into scoring lanes; returns the
+        pass-through events (non-measurements / over-capacity streams)."""
+        family = engine.config.model
+        lanes = self._lanes[family]
+        slot = self.router.global_slot(engine.placement)
+        passthrough = []
+        skipped = self.metrics.counter("tpu_inference.skipped_capacity")
+        for ev in events:
+            if not isinstance(ev, DeviceMeasurement):
+                passthrough.append(ev)
+                continue
+            assigned = engine.streams.lookup_or_assign(ev.device_token, ev.name)
+            if assigned is None:
+                skipped.inc()
+                passthrough.append(ev)
+                continue
+            dshard, local_id = assigned
+            lane = lanes.setdefault((slot, dshard), deque())
+            lane.append((local_id, ev.value, ev))
+            if family not in self._first_pending_ts:
+                self._first_pending_ts[family] = time.monotonic()
+        return passthrough
+
+    # -- flush -----------------------------------------------------------
+    def _pick_bucket(self, need: int, buckets: Tuple[int, ...], max_batch: int) -> int:
+        for b in buckets:
+            if need <= b:
+                return min(b, max_batch)
+        return max_batch
+
+    async def _flush_family(self, engine_cfgs: Dict[int, TenantEngineConfig], family: str) -> int:
+        """Build the stacked batch for one family and run the jit step."""
+        scorer = self.scorers[family]
+        lanes = self._lanes[family]
+        pending_max = max((len(q) for q in lanes.values()), default=0)
+        if pending_max == 0:
+            self._first_pending_ts.pop(family, None)
+            return 0
+        # all engines of one family share microbatch config by construction
+        any_cfg = next(iter(engine_cfgs.values()))
+        mb = any_cfg.microbatch
+        b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
+        t, d = scorer.n_slots, self.mm.n_data_shards
+        ids = np.zeros((t, d * b_lane), np.int32)
+        vals = np.zeros((t, d * b_lane), np.float32)
+        valid = np.zeros((t, d * b_lane), bool)
+        taken: List[Tuple[int, int, object]] = []  # (slot, col, event)
+        for (slot, dshard), q in lanes.items():
+            base = dshard * b_lane
+            for i in range(min(len(q), b_lane)):
+                local_id, value, ev = q.popleft()
+                col = base + i
+                ids[slot, col] = local_id
+                vals[slot, col] = value
+                valid[slot, col] = True
+                taken.append((slot, col, ev))
+        if any(q for q in lanes.values()):
+            self._first_pending_ts[family] = time.monotonic()
+        else:
+            self._first_pending_ts.pop(family, None)
+
+        scores = scorer.step(ids, vals, valid)
+        # device→host sync off the event loop (jax dispatch is async until
+        # materialization; don't stall other tenants' polling on it)
+        scores_np = await asyncio.get_running_loop().run_in_executor(
+            None, np.asarray, scores
+        )
+
+        latency = self.metrics.histogram("tpu_inference.latency", unit="s")
+        meter = self.metrics.meter("tpu_inference.scored")
+        now = time.time() * 1000.0
+        scored_ctr = self.metrics.counter("tpu_inference.scored_total")
+        by_tenant: Dict[str, List] = {}
+        for slot, col, ev in taken:
+            ev.score = float(scores_np[slot, col])
+            ev.mark("scored")
+            latency.record(max(now - ev.received_ts, 0.0) / 1000.0)
+            by_tenant.setdefault(ev.tenant, []).append(ev)
+        for tenant, evs in by_tenant.items():
+            topic = self.bus.naming.scored_events(tenant)
+            for ev in evs:
+                await self.bus.publish(topic, ev)
+        meter.mark(len(taken))
+        scored_ctr.inc(len(taken))
+        return len(taken)
+
+    def _deadline_reached(self, family: str, deadline_ms: float) -> bool:
+        first = self._first_pending_ts.get(family)
+        return first is not None and (time.monotonic() - first) * 1000.0 >= deadline_ms
+
+    # -- main loop -------------------------------------------------------
+    async def _scoring_loop(self) -> None:
+        while True:
+            moved = 0
+            fam_cfgs: Dict[str, Dict[int, TenantEngineConfig]] = {}
+            for tenant, engine in list(self.engines.items()):
+                if engine.state is not LifecycleState.STARTED:
+                    continue
+                assert isinstance(engine, TpuInferenceEngine)
+                events = await self.bus.consume(
+                    self.bus.naming.inbound_events(tenant),
+                    self.group,
+                    self.poll_batch,
+                    timeout_s=0,
+                )
+                fam_cfgs.setdefault(engine.config.model, {})[
+                    self.router.global_slot(engine.placement)
+                ] = engine.config
+                if events:
+                    passthrough = self._enqueue(engine, events)
+                    topic = self.bus.naming.scored_events(tenant)
+                    for ev in passthrough:
+                        await self.bus.publish(topic, ev)
+                    moved += len(events)
+            for family, cfgs in fam_cfgs.items():
+                if family not in self.scorers:
+                    continue
+                mb = next(iter(cfgs.values())).microbatch
+                lanes = self._lanes[family]
+                full = any(len(q) >= mb.max_batch for q in lanes.values())
+                if full or self._deadline_reached(family, mb.deadline_ms):
+                    moved += await self._flush_family(cfgs, family)
+            if moved == 0:
+                await asyncio.sleep(0.001)
+
+    # -- introspection ---------------------------------------------------
+    def describe(self) -> dict:
+        return {
+            "mesh": self.mm.describe(),
+            "router": self.router.describe(),
+            "families": {
+                f: {"n_slots": s.n_slots, "max_streams": s.max_streams}
+                for f, s in self.scorers.items()
+            },
+        }

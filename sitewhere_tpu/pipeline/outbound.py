@@ -1,0 +1,237 @@
+"""Outbound connectors: fan-out of enriched events to external systems.
+
+Capability parity with the reference's service-outbound-connectors
+(``IOutboundConnector`` impls — MQTT publisher, Solr indexer, EventHub/SQS/
+RabbitMQ, webhook, Groovy-scripted — each with filter chains and bounded
+processing — SURVEY.md §2.2 [U]; reference mount empty, see provenance
+banner).
+
+Redesign: connectors are lifecycle components with a filter chain and an
+async ``deliver``; network-less equivalents ship in-image (log, file/JSONL,
+in-proc MQTT-topic publisher backed by the sim broker, callback) and the
+network ones (webhook via aiohttp, real MQTT) activate when their transport
+is reachable. Per-connector supervised delivery with bounded concurrency
+mirrors the reference's bounded thread pools.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+from typing import Awaitable, Callable, Dict, List, Optional, Sequence
+
+from sitewhere_tpu.core.events import DeviceEvent, EventType
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+EventFilter = Callable[[DeviceEvent], bool]
+
+
+def type_filter(*types: EventType) -> EventFilter:
+    allowed = set(types)
+    return lambda e: e.EVENT_TYPE in allowed
+
+
+def area_filter(*area_tokens: str) -> EventFilter:
+    allowed = set(area_tokens)
+    return lambda e: e.area_token in allowed
+
+
+def device_filter(*device_tokens: str) -> EventFilter:
+    allowed = set(device_tokens)
+    return lambda e: e.device_token in allowed
+
+
+class OutboundConnector(LifecycleComponent):
+    """Base connector: filter chain + async deliver with bounded concurrency."""
+
+    def __init__(
+        self,
+        name: str,
+        filters: Optional[Sequence[EventFilter]] = None,
+        concurrency: int = 8,
+    ) -> None:
+        super().__init__(f"connector[{name}]")
+        self.connector_id = name
+        self.filters: List[EventFilter] = list(filters or [])
+        self._sem = asyncio.Semaphore(concurrency)
+        self.delivered = 0
+        self.failed = 0
+
+    def accepts(self, e: DeviceEvent) -> bool:
+        return all(f(e) for f in self.filters)
+
+    async def deliver(self, e: DeviceEvent) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    async def process(self, e: DeviceEvent) -> bool:
+        if not self.accepts(e):
+            return False
+        async with self._sem:
+            try:
+                await self.deliver(e)
+                self.delivered += 1
+                return True
+            except Exception as exc:  # noqa: BLE001 - connector errors are isolated
+                self.failed += 1
+                self._record_error("deliver", exc)
+                return False
+
+
+class LogConnector(OutboundConnector):
+    """Collects events in memory / logs them — the dev default."""
+
+    def __init__(self, name: str = "log", capacity: int = 10000, **kw) -> None:
+        super().__init__(name, **kw)
+        self.capacity = capacity
+        self.events: List[DeviceEvent] = []
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        self.events.append(e)
+        if len(self.events) > self.capacity:
+            del self.events[: len(self.events) // 2]
+
+
+class JsonlFileConnector(OutboundConnector):
+    """Appends events as JSON lines to a file (the Solr-indexer stand-in)."""
+
+    def __init__(self, name: str, path: str | Path, **kw) -> None:
+        super().__init__(name, **kw)
+        self.path = Path(path)
+        self._fh = None
+
+    async def on_start(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = self.path.open("a")
+
+    async def on_stop(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        assert self._fh is not None, "connector not started"
+        self._fh.write(e.to_json() + "\n")
+
+
+class MqttTopicConnector(OutboundConnector):
+    """Publishes events to per-device topics on the in-proc sim broker
+    (``sim.broker.SimBroker``) — the reference's MQTT outbound analog.
+    Topic pattern supports {device}, {type}, {tenant} placeholders."""
+
+    def __init__(
+        self,
+        name: str,
+        broker,
+        topic_pattern: str = "sitewhere/output/{device}/{type}",
+        **kw,
+    ) -> None:
+        super().__init__(name, **kw)
+        self.broker = broker
+        self.topic_pattern = topic_pattern
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        topic = self.topic_pattern.format(
+            device=e.device_token, type=e.EVENT_TYPE.value, tenant=e.tenant
+        )
+        await self.broker.publish(topic, e.to_json().encode())
+
+
+class WebhookConnector(OutboundConnector):
+    """HTTP POST per event via aiohttp (gated on a reachable endpoint)."""
+
+    def __init__(self, name: str, url: str, timeout_s: float = 5.0, **kw) -> None:
+        super().__init__(name, **kw)
+        self.url = url
+        self.timeout_s = timeout_s
+        self._session = None
+
+    async def on_start(self) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+        )
+
+    async def on_stop(self) -> None:
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        assert self._session is not None, "connector not started"
+        async with self._session.post(self.url, json=e.to_dict()) as resp:
+            resp.raise_for_status()
+
+
+class CallbackConnector(OutboundConnector):
+    """Invokes a user coroutine per event (the Groovy-scripted analog)."""
+
+    def __init__(
+        self, name: str, fn: Callable[[DeviceEvent], Awaitable[None]], **kw
+    ) -> None:
+        super().__init__(name, **kw)
+        self._fn = fn
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        await self._fn(e)
+
+
+class OutboundDispatcher(LifecycleComponent):
+    """Per-tenant stage: persisted-events → every registered connector."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        connectors: Optional[Sequence[OutboundConnector]] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        poll_batch: int = 4096,
+    ) -> None:
+        super().__init__(f"outbound-connectors[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.metrics = metrics or MetricsRegistry()
+        self.poll_batch = poll_batch
+        self._task: Optional[asyncio.Task] = None
+        for c in connectors or []:
+            self.add_child(c)
+
+    @property
+    def connectors(self) -> List[OutboundConnector]:
+        return [c for c in self.children if isinstance(c, OutboundConnector)]
+
+    def add_connector(self, c: OutboundConnector) -> None:
+        self.add_child(c)
+
+    @property
+    def group(self) -> str:
+        return f"outbound-connectors[{self.tenant}]"
+
+    async def on_start(self) -> None:
+        self.bus.subscribe(
+            self.bus.naming.persisted_events(self.tenant), self.group
+        )
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        src = self.bus.naming.persisted_events(self.tenant)
+        delivered = self.metrics.counter("outbound.delivered")
+        while True:
+            events = await self.bus.consume(src, self.group, self.poll_batch)
+            for e in events:
+                results = await asyncio.gather(
+                    *(c.process(e) for c in self.connectors)
+                )
+                delivered.inc(sum(bool(r) for r in results))

@@ -1,0 +1,65 @@
+"""Event persistence stage: scored-events → EventStore → outbound-events.
+
+Capability parity with the reference's event-persistence pipeline inside
+service-event-management (batch insert loop → TSDB → re-emit enriched
+events to the outbound topic for rules/connectors — SURVEY.md §3.1 [U];
+reference mount empty, see provenance banner).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.event_store import EventStore
+
+
+class EventPersistence(LifecycleComponent):
+    """Per-tenant persistence stage."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        store: EventStore,
+        metrics: Optional[MetricsRegistry] = None,
+        poll_batch: int = 4096,
+    ) -> None:
+        super().__init__(f"event-persistence[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.store = store
+        self.metrics = metrics or MetricsRegistry()
+        self.poll_batch = poll_batch
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def group(self) -> str:
+        return f"event-persistence[{self.tenant}]"
+
+    async def on_start(self) -> None:
+        self.bus.subscribe(self.bus.naming.scored_events(self.tenant), self.group)
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        src = self.bus.naming.scored_events(self.tenant)
+        out = self.bus.naming.persisted_events(self.tenant)
+        persisted = self.metrics.counter("event_management.persisted")
+        while True:
+            events = await self.bus.consume(src, self.group, self.poll_batch)
+            self.store.add_events(events)
+            persisted.inc(len(events))
+            for e in events:
+                await self.bus.publish(out, e)

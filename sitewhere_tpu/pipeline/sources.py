@@ -1,0 +1,177 @@
+"""Event sources: protocol termination → decode → decoded-events topic.
+
+Capability parity with the reference's service-event-sources
+(``IInboundEventSource``/``IInboundEventReceiver`` + decoder chain; MQTT/
+AMQP/CoAP/WebSocket receivers — SURVEY.md §2.2/§3.1 [U]; reference mount
+empty, see provenance banner).
+
+Redesign: receivers push raw payloads into an asyncio queue; an
+``EventSource`` drains the queue, decodes, dedups, and publishes request
+dicts to the tenant's decoded-events topic (failed decodes go to the
+failed-decode topic with the raw payload attached). Network receivers are
+pluggable; in this image the canonical receiver is the in-proc queue the
+MQTT simulator (``sim.devices``) feeds — a real paho-mqtt receiver slots in
+behind the same 3-method interface when a broker exists.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+from typing import Any, Dict, List, Optional
+
+from sitewhere_tpu.core.events import now_ms
+from sitewhere_tpu.pipeline.decoders import (
+    Deduplicator,
+    EventDecoder,
+    get_decoder,
+)
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+
+class InboundReceiver(LifecycleComponent):
+    """Base receiver: produces (payload: bytes, context: dict) pairs."""
+
+    def __init__(self, name: str) -> None:
+        super().__init__(name)
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=65536)
+
+    async def submit(self, payload: bytes, **context: Any) -> None:
+        await self.queue.put((payload, context))
+
+    def submit_nowait(self, payload: bytes, **context: Any) -> None:
+        self.queue.put_nowait((payload, context))
+
+
+class QueueReceiver(InboundReceiver):
+    """In-proc receiver — the broker-less MQTT stand-in the simulator and
+    tests feed directly. ``topic`` context mimics an MQTT topic string."""
+
+
+class MqttReceiver(InboundReceiver):
+    """MQTT receiver shell: connects via paho-mqtt when available; parked
+    in INITIALIZATION_ERROR otherwise (no broker/paho in this image)."""
+
+    def __init__(self, name: str, host: str = "localhost", port: int = 1883,
+                 topics: Optional[List[str]] = None) -> None:
+        super().__init__(name)
+        self.host, self.port = host, port
+        self.topics = topics or ["sitewhere/input/#"]
+        self._client = None
+
+    async def on_initialize(self) -> None:
+        try:
+            import paho.mqtt.client as mqtt  # type: ignore
+        except ImportError as exc:  # gated: not in this image
+            raise RuntimeError(
+                "paho-mqtt not installed; use QueueReceiver or the simulator"
+            ) from exc
+        loop = asyncio.get_running_loop()
+        client = mqtt.Client()
+
+        def on_message(_client, _userdata, msg):
+            loop.call_soon_threadsafe(
+                self.submit_nowait, msg.payload, topic=msg.topic
+            )
+
+        client.on_message = on_message
+        client.connect(self.host, self.port)
+        for t in self.topics:
+            client.subscribe(t)
+        client.loop_start()
+        self._client = client
+
+    async def on_stop(self) -> None:
+        if self._client is not None:
+            self._client.loop_stop()
+            self._client.disconnect()
+            self._client = None
+
+
+class EventSource(LifecycleComponent):
+    """One (receiver, decoder) pair publishing decoded event requests."""
+
+    def __init__(
+        self,
+        source_id: str,
+        tenant: str,
+        bus: EventBus,
+        receiver: InboundReceiver,
+        decoder: EventDecoder | str = "json",
+        metrics: Optional[MetricsRegistry] = None,
+        dedup: bool = True,
+    ) -> None:
+        super().__init__(f"event-source[{source_id}]")
+        self.source_id = source_id
+        self.tenant = tenant
+        self.bus = bus
+        self.receiver = receiver
+        self.decoder = get_decoder(decoder) if isinstance(decoder, str) else decoder
+        self.metrics = metrics or MetricsRegistry()
+        self.dedup = Deduplicator() if dedup else None
+        self._pump: Optional[asyncio.Task] = None
+        self.add_child(receiver)
+
+    async def on_start(self) -> None:
+        self._pump = asyncio.create_task(
+            self._run(), name=f"pump:{self.name}"
+        )
+
+    async def on_stop(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except asyncio.CancelledError:
+                pass
+            self._pump = None
+
+    async def _run(self) -> None:
+        decoded_topic = self.bus.naming.decoded_events(self.tenant)
+        failed_topic = self.bus.naming.failed_decode(self.tenant)
+        received = self.metrics.counter("event_sources.received")
+        decoded_ctr = self.metrics.counter("event_sources.decoded")
+        failed = self.metrics.counter("event_sources.failed_decode")
+        duped = self.metrics.counter("event_sources.deduplicated")
+        while True:
+            payload, context = await self.receiver.queue.get()
+            received.inc()
+            try:
+                requests = self.decoder.decode(payload, context)
+            except Exception as exc:  # noqa: BLE001 - any bad payload (incl.
+                # UnicodeDecodeError from garbled bytes) must not kill the pump
+                failed.inc()
+                await self.bus.publish(
+                    failed_topic,
+                    {
+                        "source": self.source_id,
+                        "error": str(exc),
+                        "payload_b64": base64.b64encode(payload).decode(),
+                        "context": {k: str(v) for k, v in context.items()},
+                        "ts": now_ms(),
+                    },
+                )
+                continue
+            for req in requests:
+                if self.dedup and self.dedup.seen(str(req.get("id", ""))):
+                    duped.inc()
+                    continue
+                req.setdefault("received_ts", now_ms())
+                req["_source"] = self.source_id
+                decoded_ctr.inc()
+                await self.bus.publish(decoded_topic, req)
+
+
+def make_source(
+    source_id: str,
+    tenant: str,
+    bus: EventBus,
+    decoder: str = "json",
+    metrics: Optional[MetricsRegistry] = None,
+) -> EventSource:
+    """Convenience: an EventSource over a fresh QueueReceiver."""
+    return EventSource(
+        source_id, tenant, bus, QueueReceiver(f"recv[{source_id}]"), decoder, metrics
+    )

@@ -1,0 +1,18 @@
+"""L5 domain services: capability parity with the reference's microservice
+fleet (SURVEY.md §2.2), hosted as tenant-engine services over the in-proc
+runtime instead of one Spring Boot app per service.
+
+- ``device_management``   devices, types, assignments, areas, customers,
+                          zones, groups (CRUD + caches)
+- ``asset_management``    assets + asset types
+- ``event_store``         event persistence + paged queries + replay
+- ``device_state``        last-known state + presence detection
+- ``registration``        auto-registration of unknown devices
+- ``batch_operations``    bulk command invocation with throttling
+- ``schedule_management`` scheduled/recurring command invocations
+- ``label_generation``    QR-style label rendering
+- ``user_management``     users, authorities, token issuance
+- ``tenant_management``   tenant CRUD + fleet-wide engine lifecycle
+- ``instance_management`` instance bootstrap from templates
+- ``streaming_media``     device media streams (chunk store + ViT scoring)
+"""

@@ -1,0 +1,369 @@
+"""Device management: CRUD for the device model, per tenant.
+
+Capability parity with the reference's device-management microservice
+(``IDeviceManagement`` per tenant engine: devices, device types, assignments,
+areas, customers, zones, device groups — SURVEY.md §2.2 service-device-
+management [U]; reference mount empty, see provenance banner).
+
+Redesign: a per-tenant in-memory store with token + secondary indexes and a
+read-through lookup cache for the hot ingest path (the reference fronts its
+DB with caches for the same reason). Persistence is snapshot-based (JSON)
+rather than MongoDB — swap-in stores can implement ``save``/``load``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from sitewhere_tpu.core.model import (
+    Area,
+    Asset,
+    AssignmentStatus,
+    Customer,
+    Device,
+    DeviceAssignment,
+    DeviceCommand,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceStatus,
+    DeviceType,
+    Zone,
+    new_token,
+)
+
+
+class EntityExists(ValueError):
+    pass
+
+
+class EntityNotFound(KeyError):
+    pass
+
+
+class _Collection:
+    """Token-indexed collection with paged listing."""
+
+    def __init__(self) -> None:
+        self._by_token: Dict[str, object] = {}
+
+    def add(self, entity) -> object:
+        if entity.token in self._by_token:
+            raise EntityExists(f"token '{entity.token}' already exists")
+        self._by_token[entity.token] = entity
+        return entity
+
+    def get(self, token: str):
+        return self._by_token.get(token)
+
+    def require(self, token: str):
+        e = self._by_token.get(token)
+        if e is None:
+            raise EntityNotFound(token)
+        return e
+
+    def delete(self, token: str):
+        return self._by_token.pop(token, None)
+
+    def page(self, page: int = 1, page_size: int = 100, pred=None) -> Tuple[List, int]:
+        items = [
+            e for e in self._by_token.values() if pred is None or pred(e)
+        ]
+        items.sort(key=lambda e: getattr(e, "created_ts", 0))
+        total = len(items)
+        lo = (page - 1) * page_size
+        return items[lo : lo + page_size], total
+
+    def __len__(self) -> int:
+        return len(self._by_token)
+
+    def values(self) -> Iterable:
+        return self._by_token.values()
+
+
+class DeviceManagement:
+    """Per-tenant device model store (the IDeviceManagement SPI surface)."""
+
+    def __init__(self, tenant: str = "default") -> None:
+        self.tenant = tenant
+        self.device_types = _Collection()
+        self.devices = _Collection()
+        self.assignments = _Collection()
+        self.areas = _Collection()
+        self.zones = _Collection()
+        self.customers = _Collection()
+        self.groups = _Collection()
+        # hot-path index: device token → active assignment token
+        self._active_assignment: Dict[str, str] = {}
+
+    # -- device types ----------------------------------------------------
+    def create_device_type(self, dt: DeviceType) -> DeviceType:
+        return self.device_types.add(dt)
+
+    def get_device_type(self, token: str) -> Optional[DeviceType]:
+        return self.device_types.get(token)
+
+    def update_device_type(self, token: str, **fields) -> DeviceType:
+        dt = self.device_types.require(token)
+        for k, v in fields.items():
+            setattr(dt, k, v)
+        dt.touch()
+        return dt
+
+    def delete_device_type(self, token: str) -> None:
+        used_by, _ = self.devices.page(
+            pred=lambda d: d.device_type_token == token, page_size=1
+        )
+        if used_by:
+            raise ValueError(f"device type '{token}' still in use")
+        self.device_types.delete(token)
+
+    def add_command(self, device_type_token: str, cmd: DeviceCommand) -> DeviceCommand:
+        dt = self.device_types.require(device_type_token)
+        dt.commands.append(cmd)
+        dt.touch()
+        return cmd
+
+    # -- devices ---------------------------------------------------------
+    def create_device(self, device: Device) -> Device:
+        if self.device_types.get(device.device_type_token) is None:
+            raise EntityNotFound(
+                f"device type '{device.device_type_token}' not found"
+            )
+        return self.devices.add(device)
+
+    def get_device(self, token: str) -> Optional[Device]:
+        return self.devices.get(token)
+
+    def update_device(self, token: str, **fields) -> Device:
+        d = self.devices.require(token)
+        for k, v in fields.items():
+            setattr(d, k, v)
+        d.touch()
+        return d
+
+    def delete_device(self, token: str) -> None:
+        if token in self._active_assignment:
+            raise ValueError(f"device '{token}' has an active assignment")
+        self.devices.delete(token)
+
+    def list_devices(self, page: int = 1, page_size: int = 100, device_type: str = ""):
+        pred = (
+            (lambda d: d.device_type_token == device_type) if device_type else None
+        )
+        return self.devices.page(page, page_size, pred)
+
+    # -- assignments -----------------------------------------------------
+    def create_assignment(self, a: DeviceAssignment) -> DeviceAssignment:
+        device = self.devices.require(a.device_token)
+        if device.token in self._active_assignment:
+            raise ValueError(
+                f"device '{device.token}' already has an active assignment"
+            )
+        self.assignments.add(a)
+        self._active_assignment[device.token] = a.token
+        return a
+
+    def get_assignment(self, token: str) -> Optional[DeviceAssignment]:
+        return self.assignments.get(token)
+
+    def active_assignment_for(self, device_token: str) -> Optional[DeviceAssignment]:
+        """The hot-path lookup: ingest calls this per decoded event."""
+        t = self._active_assignment.get(device_token)
+        return self.assignments.get(t) if t else None
+
+    def release_assignment(self, token: str) -> DeviceAssignment:
+        a = self.assignments.require(token)
+        a.release()
+        if self._active_assignment.get(a.device_token) == token:
+            del self._active_assignment[a.device_token]
+        return a
+
+    def list_assignments(self, page: int = 1, page_size: int = 100, device_token: str = "", status: Optional[AssignmentStatus] = None):
+        def pred(a):
+            if device_token and a.device_token != device_token:
+                return False
+            if status is not None and a.status is not status:
+                return False
+            return True
+
+        return self.assignments.page(page, page_size, pred)
+
+    # -- areas / zones / customers --------------------------------------
+    def create_area(self, area: Area) -> Area:
+        return self.areas.add(area)
+
+    def get_area(self, token: str) -> Optional[Area]:
+        return self.areas.get(token)
+
+    def list_areas(self, page: int = 1, page_size: int = 100):
+        return self.areas.page(page, page_size)
+
+    def create_zone(self, zone: Zone) -> Zone:
+        self.areas.require(zone.area_token)
+        return self.zones.add(zone)
+
+    def get_zone(self, token: str) -> Optional[Zone]:
+        return self.zones.get(token)
+
+    def list_zones(self, area_token: str = "", page: int = 1, page_size: int = 100):
+        pred = (lambda z: z.area_token == area_token) if area_token else None
+        return self.zones.page(page, page_size, pred)
+
+    def create_customer(self, c: Customer) -> Customer:
+        return self.customers.add(c)
+
+    def get_customer(self, token: str) -> Optional[Customer]:
+        return self.customers.get(token)
+
+    def list_customers(self, page: int = 1, page_size: int = 100):
+        return self.customers.page(page, page_size)
+
+    # -- device groups ---------------------------------------------------
+    def create_group(self, g: DeviceGroup) -> DeviceGroup:
+        return self.groups.add(g)
+
+    def get_group(self, token: str) -> Optional[DeviceGroup]:
+        return self.groups.get(token)
+
+    def group_device_tokens(self, token: str, role: str = "") -> List[str]:
+        """Flatten a group (incl. nested groups) to device tokens."""
+        g = self.groups.require(token)
+        out: List[str] = []
+        seen = {token}
+
+        def walk(group: DeviceGroup) -> None:
+            for el in group.elements:
+                if role and role not in el.roles:
+                    continue
+                if el.device_token:
+                    out.append(el.device_token)
+                elif el.nested_group_token and el.nested_group_token not in seen:
+                    seen.add(el.nested_group_token)
+                    nested = self.groups.get(el.nested_group_token)
+                    if nested:
+                        walk(nested)
+
+        walk(g)
+        return out
+
+    # -- bootstrap helpers (tenant templates / sim) ----------------------
+    def bootstrap_fleet(
+        self,
+        n_devices: int,
+        device_type_name: str = "sensor",
+        area_name: str = "default-area",
+        token_prefix: str = "dev",
+    ) -> List[Device]:
+        """Create a device type + area + N devices with active assignments —
+        the dataset-template analog used by the simulator configs [B:7]."""
+        dt = DeviceType(token=new_token("dt"), name=device_type_name)
+        self.create_device_type(dt)
+        area = Area(token=new_token("area"), name=area_name)
+        self.create_area(area)
+        devices = []
+        for i in range(n_devices):
+            d = Device(
+                token=f"{token_prefix}-{i:05d}",
+                name=f"{device_type_name}-{i}",
+                device_type_token=dt.token,
+            )
+            self.create_device(d)
+            self.create_assignment(
+                DeviceAssignment(
+                    token=new_token("asn"),
+                    device_token=d.token,
+                    area_token=area.token,
+                )
+            )
+            devices.append(d)
+        return devices
+
+    # -- snapshot persistence -------------------------------------------
+    def save(self, path: str | Path) -> None:
+        def dt_dict(dt: DeviceType) -> dict:
+            d = dt.to_dict()
+            d["commands"] = [c.to_dict() for c in dt.commands]
+            return d
+
+        def group_dict(g: DeviceGroup) -> dict:
+            d = g.to_dict()
+            d["elements"] = [
+                {
+                    "group_token": el.group_token,
+                    "device_token": el.device_token,
+                    "nested_group_token": el.nested_group_token,
+                    "roles": list(el.roles),
+                }
+                for el in g.elements
+            ]
+            return d
+
+        data = {
+            "tenant": self.tenant,
+            "device_types": [dt_dict(e) for e in self.device_types.values()],
+            "devices": [e.to_dict() for e in self.devices.values()],
+            "assignments": [e.to_dict() for e in self.assignments.values()],
+            "areas": [e.to_dict() for e in self.areas.values()],
+            "zones": [e.to_dict() for e in self.zones.values()],
+            "customers": [e.to_dict() for e in self.customers.values()],
+            "groups": [group_dict(e) for e in self.groups.values()],
+        }
+        Path(path).write_text(json.dumps(data, default=str))
+
+    @classmethod
+    def load(cls, path: str | Path) -> "DeviceManagement":
+        data = json.loads(Path(path).read_text())
+        dm = cls(data["tenant"])
+
+        def build(cls_, d, drop=()):
+            fields = {
+                k: v
+                for k, v in d.items()
+                if k in cls_.__dataclass_fields__ and k not in drop
+            }
+            return cls_(**fields)
+
+        for d in data["device_types"]:
+            d = dict(d)
+            cmds = [build(DeviceCommand, c) for c in d.pop("commands", [])]
+            dt = build(DeviceType, d)
+            dt.commands = cmds
+            dm.device_types.add(dt)
+        for d in data["devices"]:
+            d = dict(d)
+            d["status"] = DeviceStatus(d.get("status", "active"))
+            dm.devices.add(build(Device, d))
+        for d in data["areas"]:
+            d = dict(d)
+            d["bounds"] = [tuple(b) for b in d.get("bounds", [])]
+            dm.areas.add(build(Area, d))
+        for d in data["zones"]:
+            d = dict(d)
+            d["bounds"] = [tuple(b) for b in d.get("bounds", [])]
+            dm.zones.add(build(Zone, d))
+        for d in data["customers"]:
+            dm.customers.add(build(Customer, d))
+        for d in data["assignments"]:
+            d = dict(d)
+            d["status"] = AssignmentStatus(d.get("status", "active"))
+            a = build(DeviceAssignment, d)
+            dm.assignments.add(a)
+            if a.status is AssignmentStatus.ACTIVE:
+                dm._active_assignment[a.device_token] = a.token
+        for d in data.get("groups", []):
+            d = dict(d)
+            elements = [
+                DeviceGroupElement(
+                    group_token=el.get("group_token", ""),
+                    device_token=el.get("device_token", ""),
+                    nested_group_token=el.get("nested_group_token", ""),
+                    roles=list(el.get("roles", [])),
+                )
+                for el in d.pop("elements", [])
+            ]
+            g = build(DeviceGroup, d)
+            g.elements = elements
+            dm.groups.add(g)
+        return dm

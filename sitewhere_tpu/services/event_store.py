@@ -1,0 +1,341 @@
+"""Event persistence: columnar store + paged queries + replay.
+
+Capability parity with the reference's service-event-management
+(``IDeviceEventManagement`` per tenant: persist each event type, paged
+queries by assignment/time, re-emit enriched events — SURVEY.md §2.2/§3.1/
+§3.4 [U]; reference mount empty, see provenance banner). The reference
+persists to InfluxDB/Cassandra; the rebuild persists to in-memory column
+chunks spillable to **Parquet** (pyarrow) — the same columnar layout the
+TPU batcher wants, so replay into the DeepAR/forecast configs
+(BASELINE.json:9) is a zero-copy array slice, not a row scan.
+
+Replay contract: ``replay_measurements`` yields windows of raw values per
+stream in event-time order — the feed for forecaster training/backtesting.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sitewhere_tpu.core.events import (
+    DeviceAlert,
+    DeviceEvent,
+    DeviceMeasurement,
+    EventType,
+    event_from_dict,
+)
+
+
+@dataclass
+class EventQuery:
+    """Paged event query criteria (REST surface mirrors this)."""
+
+    assignment_token: str = ""
+    device_token: str = ""
+    area_token: str = ""
+    event_type: Optional[EventType] = None
+    name: str = ""               # measurement name filter
+    start_ts: int = 0            # event_ts range, epoch ms
+    end_ts: int = 0              # 0 = open-ended
+    page: int = 1
+    page_size: int = 100
+
+
+class _MeasurementColumns:
+    """Append-only struct-of-arrays chunk store for measurements."""
+
+    CHUNK = 65536
+
+    def __init__(self) -> None:
+        self._chunks: List[Dict[str, np.ndarray]] = []
+        self._cur: Dict[str, list] = self._fresh()
+        self._materialized: Optional[Dict[str, np.ndarray]] = None
+
+    @staticmethod
+    def _fresh() -> Dict[str, list]:
+        return {
+            "event_id": [], "device_token": [], "assignment_token": [],
+            "area_token": [], "name": [], "value": [], "score": [],
+            "event_ts": [], "received_ts": [],
+        }
+
+    def append(self, e: DeviceMeasurement) -> None:
+        c = self._cur
+        c["event_id"].append(e.id)
+        c["device_token"].append(e.device_token)
+        c["assignment_token"].append(e.assignment_token)
+        c["area_token"].append(e.area_token)
+        c["name"].append(e.name)
+        c["value"].append(e.value)
+        c["score"].append(e.score if e.score is not None else np.nan)
+        c["event_ts"].append(e.event_ts)
+        c["received_ts"].append(e.received_ts)
+        self._materialized = None  # invalidate read cache
+        if len(c["value"]) >= self.CHUNK:
+            self._seal()
+
+    def _seal(self) -> None:
+        if not self._cur["value"]:
+            return
+        self._chunks.append(
+            {
+                "event_id": np.asarray(self._cur["event_id"], object),
+                "device_token": np.asarray(self._cur["device_token"], object),
+                "assignment_token": np.asarray(self._cur["assignment_token"], object),
+                "area_token": np.asarray(self._cur["area_token"], object),
+                "name": np.asarray(self._cur["name"], object),
+                "value": np.asarray(self._cur["value"], np.float32),
+                "score": np.asarray(self._cur["score"], np.float32),
+                "event_ts": np.asarray(self._cur["event_ts"], np.int64),
+                "received_ts": np.asarray(self._cur["received_ts"], np.int64),
+            }
+        )
+        self._cur = self._fresh()
+
+    def columns(self) -> Dict[str, np.ndarray]:
+        """Materialize all rows as one struct-of-arrays dict (cached until
+        the next append — reads are much more frequent than writes on the
+        query path, and an O(n) copy per REST call would dominate)."""
+        if self._materialized is not None:
+            return self._materialized
+        self._seal()
+        if not self._chunks:
+            out = {k: np.asarray([], object if k in (
+                "event_id", "device_token", "assignment_token", "area_token", "name"
+            ) else np.float32) for k in self._fresh()}
+        else:
+            out = {
+                k: np.concatenate([ch[k] for ch in self._chunks])
+                for k in self._chunks[0]
+            }
+        self._materialized = out
+        return out
+
+    def __len__(self) -> int:
+        return sum(len(ch["value"]) for ch in self._chunks) + len(self._cur["value"])
+
+
+class EventStore:
+    """Per-tenant event persistence (the IDeviceEventManagement surface)."""
+
+    def __init__(self, tenant: str = "default") -> None:
+        self.tenant = tenant
+        self.measurements = _MeasurementColumns()
+        # non-measurement events are object-shaped (low volume)
+        self._other: Dict[EventType, List[DeviceEvent]] = {
+            t: [] for t in EventType if t is not EventType.MEASUREMENT
+        }
+        self._by_id: Dict[str, DeviceEvent] = {}
+
+    # -- writes ----------------------------------------------------------
+    def add_event(self, e: DeviceEvent) -> DeviceEvent:
+        e.mark("persisted")
+        if isinstance(e, DeviceMeasurement):
+            self.measurements.append(e)
+        else:
+            self._other[e.EVENT_TYPE].append(e)
+            self._by_id[e.id] = e
+        return e
+
+    def add_events(self, events: Sequence[DeviceEvent]) -> int:
+        for e in events:
+            self.add_event(e)
+        return len(events)
+
+    # -- reads -----------------------------------------------------------
+    def get_event(self, event_id: str) -> Optional[DeviceEvent]:
+        hit = self._by_id.get(event_id)
+        if hit is not None:
+            return hit
+        cols = self.measurements.columns()
+        idx = np.nonzero(cols["event_id"] == event_id)[0]
+        if idx.size == 0:
+            return None
+        return self._row_to_event(cols, int(idx[0]))
+
+    def _row_to_event(self, cols: Dict[str, np.ndarray], i: int) -> DeviceMeasurement:
+        score = float(cols["score"][i])
+        return DeviceMeasurement(
+            id=str(cols["event_id"][i]),
+            device_token=str(cols["device_token"][i]),
+            assignment_token=str(cols["assignment_token"][i]),
+            area_token=str(cols["area_token"][i]),
+            tenant=self.tenant,
+            name=str(cols["name"][i]),
+            value=float(cols["value"][i]),
+            score=None if np.isnan(score) else score,
+            event_ts=int(cols["event_ts"][i]),
+            received_ts=int(cols["received_ts"][i]),
+        )
+
+    def _matching_measurement_rows(self, q: EventQuery) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """All matching measurement row indices, event-time ordered (unpaged)."""
+        cols = self.measurements.columns()
+        mask = np.ones(len(cols["value"]), bool)
+        if q.assignment_token:
+            mask &= cols["assignment_token"] == q.assignment_token
+        if q.device_token:
+            mask &= cols["device_token"] == q.device_token
+        if q.area_token:
+            mask &= cols["area_token"] == q.area_token
+        if q.name:
+            mask &= cols["name"] == q.name
+        if q.start_ts:
+            mask &= cols["event_ts"] >= q.start_ts
+        if q.end_ts:
+            mask &= cols["event_ts"] <= q.end_ts
+        idx = np.nonzero(mask)[0]
+        idx = idx[np.argsort(cols["event_ts"][idx], kind="stable")]
+        return cols, idx
+
+    def list_measurements(self, q: EventQuery) -> Tuple[List[DeviceMeasurement], int]:
+        cols, idx = self._matching_measurement_rows(q)
+        total = int(idx.size)
+        lo = (q.page - 1) * q.page_size
+        sel = idx[lo : lo + q.page_size]
+        return [self._row_to_event(cols, int(i)) for i in sel], total
+
+    def _matching_others(self, q: EventQuery) -> List[DeviceEvent]:
+        others: List[DeviceEvent] = []
+        for t, lst in self._other.items():
+            if q.event_type is not None and t is not q.event_type:
+                continue
+            for e in lst:
+                if q.assignment_token and e.assignment_token != q.assignment_token:
+                    continue
+                if q.device_token and e.device_token != q.device_token:
+                    continue
+                if q.area_token and e.area_token != q.area_token:
+                    continue
+                if q.start_ts and e.event_ts < q.start_ts:
+                    continue
+                if q.end_ts and e.event_ts > q.end_ts:
+                    continue
+                others.append(e)
+        others.sort(key=lambda e: e.event_ts)
+        return others
+
+    def list_events(self, q: EventQuery) -> Tuple[List[DeviceEvent], int]:
+        if q.event_type is EventType.MEASUREMENT:
+            return self.list_measurements(q)
+        others = self._matching_others(q)
+        if q.event_type is not None:
+            total = len(others)
+            lo = (q.page - 1) * q.page_size
+            return others[lo : lo + q.page_size], total
+        # mixed query: merge measurement row refs with object events by
+        # event time, paginate ONCE, materialize only the returned page
+        cols, idx = self._matching_measurement_rows(q)
+        merged: List[Tuple[int, int, object]] = [
+            (int(cols["event_ts"][i]), 0, int(i)) for i in idx
+        ] + [(e.event_ts, 1, e) for e in others]
+        merged.sort(key=lambda t: t[0])
+        total = len(merged)
+        lo = (q.page - 1) * q.page_size
+        page = merged[lo : lo + q.page_size]
+        out: List[DeviceEvent] = [
+            self._row_to_event(cols, ref) if kind == 0 else ref  # type: ignore[arg-type]
+            for _, kind, ref in page
+        ]
+        return out, total
+
+    def alerts(self) -> List[DeviceAlert]:
+        return list(self._other[EventType.ALERT])  # type: ignore[return-value]
+
+    # -- replay (forecaster feed, BASELINE.json:9) -----------------------
+    def replay_measurements(
+        self,
+        name: str = "",
+        device_token: str = "",
+        window: int = 128,
+        stride: int = 1,
+        min_series: int = 8,
+    ) -> Iterator[Tuple[str, str, np.ndarray]]:
+        """Yield (device_token, name, values[window]) training windows per
+        series in event-time order — zero-copy slices off the column store."""
+        cols = self.measurements.columns()
+        if len(cols["value"]) == 0:
+            return
+        mask = np.ones(len(cols["value"]), bool)
+        if name:
+            mask &= cols["name"] == name
+        if device_token:
+            mask &= cols["device_token"] == device_token
+        idx = np.nonzero(mask)[0]
+        keys = [
+            (str(cols["device_token"][i]), str(cols["name"][i])) for i in idx
+        ]
+        series: Dict[Tuple[str, str], List[int]] = {}
+        for row, key in zip(idx, keys):
+            series.setdefault(key, []).append(int(row))
+        for (dev, nm), rows in series.items():
+            if len(rows) < max(window, min_series):
+                continue
+            order = np.asarray(rows)[np.argsort(cols["event_ts"][rows], kind="stable")]
+            vals = cols["value"][order]
+            for lo in range(0, len(vals) - window + 1, stride):
+                yield dev, nm, vals[lo : lo + window]
+
+    # -- parquet spill ---------------------------------------------------
+    def save_parquet(self, directory: str | Path) -> Path:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        directory = Path(directory)
+        directory.mkdir(parents=True, exist_ok=True)
+        cols = self.measurements.columns()
+        table = pa.table(
+            {
+                k: pa.array(list(v) if v.dtype == object else v)
+                for k, v in cols.items()
+            }
+        )
+        path = directory / f"measurements-{self.tenant}-{int(time.time())}.parquet"
+        pq.write_table(table, path)
+        other = [e.to_dict() for lst in self._other.values() for e in lst]
+        if other:
+            import json
+
+            (directory / f"events-{self.tenant}.jsonl").write_text(
+                "\n".join(json.dumps(d) for d in other)
+            )
+        return path
+
+    @classmethod
+    def load_parquet(cls, path: str | Path, tenant: str = "default") -> "EventStore":
+        import pyarrow.parquet as pq
+
+        store = cls(tenant)
+        table = pq.read_table(path)
+        d = {name: table[name].to_numpy(zero_copy_only=False) for name in table.column_names}
+        for i in range(len(d["value"])):
+            score = float(d["score"][i])
+            store.add_event(
+                DeviceMeasurement(
+                    id=str(d["event_id"][i]),
+                    device_token=str(d["device_token"][i]),
+                    assignment_token=str(d["assignment_token"][i]),
+                    area_token=str(d["area_token"][i]),
+                    tenant=tenant,
+                    name=str(d["name"][i]),
+                    value=float(d["value"][i]),
+                    score=None if np.isnan(score) else score,
+                    event_ts=int(d["event_ts"][i]),
+                    received_ts=int(d["received_ts"][i]),
+                )
+            )
+        jsonl = Path(path).parent / f"events-{tenant}.jsonl"
+        if jsonl.exists():
+            import json
+
+            for line in jsonl.read_text().splitlines():
+                store.add_event(event_from_dict(json.loads(line)))
+        return store
+
+    def __len__(self) -> int:
+        return len(self.measurements) + sum(len(v) for v in self._other.values())

@@ -1,0 +1,11 @@
+"""Device simulation: in-proc MQTT-style broker + synthetic device fleets.
+
+The canonical E2E fixture (SURVEY.md §4) and the CPU-baseline benchmark
+config's "MQTT temperature-sensor simulator (100 devices)"
+(BASELINE.json:7).
+"""
+
+from sitewhere_tpu.sim.broker import SimBroker
+from sitewhere_tpu.sim.devices import DeviceSimulator, SimProfile
+
+__all__ = ["SimBroker", "DeviceSimulator", "SimProfile"]

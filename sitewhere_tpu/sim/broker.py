@@ -1,0 +1,54 @@
+"""In-proc MQTT-style broker: topic pub/sub with wildcard subscriptions.
+
+Stands in for the reference deployment's external MQTT broker (HiveMQ/
+ActiveMQ in recipes — SURVEY.md §2.2 event-sources [U]) so the full
+device→cloud→device loop runs in one process: simulated devices publish
+telemetry, the ingest receiver subscribes; command delivery publishes to
+per-device topics, devices subscribe back. Supports MQTT-ish ``+``/``#``
+wildcards.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Dict, List, Tuple
+
+Handler = Callable[[str, bytes], Awaitable[None]]
+
+
+def _topic_matches(pattern: str, topic: str) -> bool:
+    p_parts = pattern.split("/")
+    t_parts = topic.split("/")
+    for i, p in enumerate(p_parts):
+        if p == "#":
+            return True
+        if i >= len(t_parts):
+            return False
+        if p != "+" and p != t_parts[i]:
+            return False
+    return len(p_parts) == len(t_parts)
+
+
+class SimBroker:
+    """Async topic broker with wildcard subscriptions."""
+
+    def __init__(self) -> None:
+        self._subs: List[Tuple[str, Handler]] = []
+        self.published = 0
+        self.delivered = 0
+
+    def subscribe(self, pattern: str, handler: Handler) -> None:
+        self._subs.append((pattern, handler))
+
+    def unsubscribe(self, handler: Handler) -> None:
+        self._subs = [(p, h) for p, h in self._subs if h is not handler]
+
+    async def publish(self, topic: str, payload: bytes) -> int:
+        self.published += 1
+        n = 0
+        for pattern, handler in list(self._subs):
+            if _topic_matches(pattern, topic):
+                await handler(topic, payload)
+                n += 1
+        self.delivered += n
+        return n

@@ -1,0 +1,157 @@
+"""Synthetic device fleet: temperature sensors over the sim broker.
+
+The CPU-baseline config's generator — "MQTT temperature-sensor simulator
+(100 devices) → threshold rule → MQTT outbound" (BASELINE.json:7). Each
+device publishes JSON (or binary) measurements on its own topic with a
+sinusoidal daily profile + noise; anomaly injection spikes selected
+devices so the LSTM/threshold paths have something to catch. Devices also
+subscribe to their command topic and ack invocations back through ingest
+(the §3.2 loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.core.events import now_ms
+from sitewhere_tpu.pipeline.decoders import encode_measurement_binary
+from sitewhere_tpu.sim.broker import SimBroker
+
+
+@dataclass
+class SimProfile:
+    n_devices: int = 100
+    measurement: str = "temperature"
+    base: float = 21.0
+    daily_amplitude: float = 4.0
+    noise: float = 0.15
+    period_s: float = 60.0          # compressed "day" for fast tests
+    interval_s: float = 0.05        # per-device publish interval
+    anomaly_rate: float = 0.0       # probability per sample of a spike
+    anomaly_magnitude: float = 12.0
+    wire: str = "json"              # json | binary
+    token_prefix: str = "dev"
+    seed: int = 0
+
+
+class DeviceSimulator:
+    """Publishes synthetic telemetry for a fleet; tracks what it sent."""
+
+    def __init__(
+        self,
+        broker: SimBroker,
+        profile: Optional[SimProfile] = None,
+        topic_pattern: str = "sitewhere/input/{device}",
+    ) -> None:
+        self.broker = broker
+        self.profile = profile or SimProfile()
+        self.topic_pattern = topic_pattern
+        self.rng = random.Random(self.profile.seed)
+        self.sent = 0
+        self.anomalies_injected: List[Dict] = []
+        self.command_acks: List[Dict] = []
+        self._tasks: List[asyncio.Task] = []
+        self._phase: Dict[str, float] = {}
+
+    def device_tokens(self) -> List[str]:
+        return [
+            f"{self.profile.token_prefix}-{i:05d}"
+            for i in range(self.profile.n_devices)
+        ]
+
+    def _value(self, token: str, t: float, force_anomaly: bool = False) -> tuple:
+        p = self.profile
+        phase = self._phase.setdefault(token, self.rng.uniform(0, 2 * math.pi))
+        v = (
+            p.base
+            + p.daily_amplitude * math.sin(2 * math.pi * t / p.period_s + phase)
+            + self.rng.gauss(0, p.noise)
+        )
+        is_anomaly = force_anomaly or (
+            p.anomaly_rate > 0 and self.rng.random() < p.anomaly_rate
+        )
+        if is_anomaly:
+            v += p.anomaly_magnitude * (1 if self.rng.random() < 0.5 else -1)
+        return v, is_anomaly
+
+    def _payload(self, token: str, value: float) -> bytes:
+        p = self.profile
+        if p.wire == "binary":
+            return encode_measurement_binary(token, p.measurement, value)
+        return json.dumps(
+            {
+                "type": "measurement",
+                "device_token": token,
+                "name": p.measurement,
+                "value": value,
+                "event_ts": now_ms(),
+            }
+        ).encode()
+
+    async def publish_once(self, token: str, t: float, force_anomaly: bool = False) -> None:
+        value, is_anomaly = self._value(token, t, force_anomaly)
+        if is_anomaly:
+            self.anomalies_injected.append(
+                {"device": token, "value": value, "ts": now_ms()}
+            )
+        await self.broker.publish(
+            self.topic_pattern.format(device=token), self._payload(token, value)
+        )
+        self.sent += 1
+
+    async def publish_round(self, t: float) -> None:
+        """One sample from every device (deterministic batch mode for tests)."""
+        for token in self.device_tokens():
+            await self.publish_once(token, t)
+
+    async def run(self, duration_s: float) -> None:
+        """Free-running mode: every device publishes at its own interval."""
+
+        async def one_device(token: str) -> None:
+            p = self.profile
+            t0 = asyncio.get_running_loop().time()
+            while True:
+                t = asyncio.get_running_loop().time() - t0
+                if t >= duration_s:
+                    return
+                await self.publish_once(token, t)
+                await asyncio.sleep(p.interval_s)
+
+        self._tasks = [
+            asyncio.create_task(one_device(tok)) for tok in self.device_tokens()
+        ]
+        try:
+            await asyncio.gather(*self._tasks)
+        finally:
+            self._tasks = []
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    # -- device-side command loop (§3.2 ack path) ------------------------
+    def listen_for_commands(self, command_pattern: str = "sitewhere/command/+") -> None:
+        async def on_command(topic: str, payload: bytes) -> None:
+            device = topic.rsplit("/", 1)[-1]
+            try:
+                frame = json.loads(payload)
+            except (ValueError, UnicodeDecodeError):
+                frame = {"raw": True}
+            ack = {
+                "type": "command_response",
+                "device_token": device,
+                "originating_event_id": frame.get("invocation_id", ""),
+                "response": f"ack:{frame.get('command', 'unknown')}",
+            }
+            self.command_acks.append(ack)
+            await self.broker.publish(
+                self.topic_pattern.format(device=device),
+                json.dumps(ack).encode(),
+            )
+
+        self.broker.subscribe(command_pattern, on_command)

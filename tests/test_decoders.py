@@ -1,0 +1,103 @@
+"""Decoder round-trips: JSON, binary wire format, scripted, dedup."""
+
+import pytest
+
+from sitewhere_tpu.pipeline.decoders import (
+    BinaryDecoder,
+    DecodeError,
+    Deduplicator,
+    JsonDecoder,
+    ScriptedDecoder,
+    encode_location_binary,
+    encode_measurement_binary,
+    encode_register_binary,
+    get_decoder,
+)
+
+
+class TestJsonDecoder:
+    def test_single_event(self):
+        reqs = JsonDecoder().decode(
+            b'{"type":"measurement","device_token":"d1","name":"temp","value":21.5}'
+        )
+        assert len(reqs) == 1
+        assert reqs[0]["device_token"] == "d1"
+        assert reqs[0]["value"] == 21.5
+
+    def test_batched_events_inherit_device(self):
+        payload = b'{"device":"d9","events":[{"name":"t","value":1},{"name":"t","value":2}]}'
+        reqs = JsonDecoder().decode(payload)
+        assert len(reqs) == 2
+        assert all(r["device_token"] == "d9" for r in reqs)
+
+    def test_context_device_fallback(self):
+        reqs = JsonDecoder().decode(
+            b'{"name":"t","value":3}', {"device_token": "ctx-dev"}
+        )
+        assert reqs[0]["device_token"] == "ctx-dev"
+
+    def test_bad_json_raises(self):
+        with pytest.raises(DecodeError):
+            JsonDecoder().decode(b"not json{")
+
+
+class TestBinaryDecoder:
+    def test_measurement_roundtrip(self):
+        payload = encode_measurement_binary("dev-1", "temperature", 23.25, 1234567)
+        reqs = BinaryDecoder().decode(payload)
+        assert reqs == [
+            {
+                "type": "measurement",
+                "device_token": "dev-1",
+                "name": "temperature",
+                "value": 23.25,
+                "event_ts": 1234567,
+            }
+        ]
+
+    def test_concatenated_messages(self):
+        payload = encode_measurement_binary("a", "t", 1.0, 1) + encode_location_binary(
+            "a", 10.0, 20.0, 5.0, 2
+        )
+        reqs = BinaryDecoder().decode(payload)
+        assert [r["type"] for r in reqs] == ["measurement", "location"]
+        assert reqs[1]["latitude"] == 10.0
+
+    def test_register_roundtrip(self):
+        reqs = BinaryDecoder().decode(encode_register_binary("d", "dt-1", "area-1"))
+        assert reqs[0]["type"] == "register"
+        assert reqs[0]["device_type_token"] == "dt-1"
+
+    def test_truncated_raises(self):
+        payload = encode_measurement_binary("dev-1", "temp", 1.0)
+        with pytest.raises(DecodeError):
+            BinaryDecoder().decode(payload[:-3])
+
+    def test_bad_magic_raises(self):
+        with pytest.raises(DecodeError):
+            BinaryDecoder().decode(b"\x00\x00\x01\x00")
+
+
+def test_scripted_decoder_wraps_errors():
+    ok = ScriptedDecoder(lambda p, c: [{"type": "measurement", "value": 1.0}])
+    assert ok.decode(b"x")[0]["value"] == 1.0
+    bad = ScriptedDecoder(lambda p, c: 1 / 0)
+    with pytest.raises(DecodeError):
+        bad.decode(b"x")
+
+
+def test_get_decoder_registry():
+    assert get_decoder("json").name == "json"
+    assert get_decoder("binary").name == "binary"
+    with pytest.raises(KeyError):
+        get_decoder("nope")
+
+
+def test_deduplicator_window():
+    d = Deduplicator(capacity=2)
+    assert not d.seen("a")
+    assert d.seen("a")
+    assert not d.seen("b")
+    assert not d.seen("c")  # evicts "a"
+    assert not d.seen("a")
+    assert not d.seen("")   # empty ids never dedup

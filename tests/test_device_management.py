@@ -1,0 +1,133 @@
+"""Device-management CRUD, assignment lifecycle, groups, snapshots."""
+
+import pytest
+
+from sitewhere_tpu.core.model import (
+    Area,
+    Device,
+    DeviceAssignment,
+    DeviceCommand,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceType,
+    Zone,
+)
+from sitewhere_tpu.services.device_management import (
+    DeviceManagement,
+    EntityExists,
+    EntityNotFound,
+)
+
+
+@pytest.fixture
+def dm():
+    m = DeviceManagement("t1")
+    m.create_device_type(DeviceType(token="dt1", name="thermo"))
+    return m
+
+
+def test_device_requires_known_type(dm):
+    with pytest.raises(EntityNotFound):
+        dm.create_device(Device(token="d1", device_type_token="nope"))
+    dm.create_device(Device(token="d1", device_type_token="dt1"))
+    with pytest.raises(EntityExists):
+        dm.create_device(Device(token="d1", device_type_token="dt1"))
+
+
+def test_assignment_lifecycle(dm):
+    dm.create_device(Device(token="d1", device_type_token="dt1"))
+    a = dm.create_assignment(DeviceAssignment(token="a1", device_token="d1"))
+    assert dm.active_assignment_for("d1") is a
+    # second active assignment rejected
+    with pytest.raises(ValueError):
+        dm.create_assignment(DeviceAssignment(token="a2", device_token="d1"))
+    dm.release_assignment("a1")
+    assert dm.active_assignment_for("d1") is None
+    a2 = dm.create_assignment(DeviceAssignment(token="a2", device_token="d1"))
+    assert dm.active_assignment_for("d1") is a2
+
+
+def test_delete_guards(dm):
+    dm.create_device(Device(token="d1", device_type_token="dt1"))
+    with pytest.raises(ValueError):
+        dm.delete_device_type("dt1")  # in use
+    dm.create_assignment(DeviceAssignment(token="a1", device_token="d1"))
+    with pytest.raises(ValueError):
+        dm.delete_device("d1")  # active assignment
+
+
+def test_paged_listing(dm):
+    for i in range(25):
+        dm.create_device(Device(token=f"d{i}", device_type_token="dt1"))
+    page1, total = dm.list_devices(page=1, page_size=10)
+    page3, _ = dm.list_devices(page=3, page_size=10)
+    assert total == 25
+    assert len(page1) == 10 and len(page3) == 5
+
+
+def test_zone_requires_area(dm):
+    with pytest.raises(EntityNotFound):
+        dm.create_zone(Zone(token="z1", area_token="nope"))
+    dm.create_area(Area(token="ar1", name="plant"))
+    dm.create_zone(Zone(token="z1", area_token="ar1"))
+    zones, _ = dm.list_zones(area_token="ar1")
+    assert len(zones) == 1
+
+
+def test_group_flattening(dm):
+    for i in range(4):
+        dm.create_device(Device(token=f"d{i}", device_type_token="dt1"))
+    inner = DeviceGroup(
+        token="g-in",
+        elements=[DeviceGroupElement(device_token="d2", roles=["b"])],
+    )
+    outer = DeviceGroup(
+        token="g-out",
+        elements=[
+            DeviceGroupElement(device_token="d0", roles=["a"]),
+            DeviceGroupElement(device_token="d1", roles=["b"]),
+            DeviceGroupElement(nested_group_token="g-in", roles=["b"]),
+        ],
+    )
+    dm.create_group(inner)
+    dm.create_group(outer)
+    assert dm.group_device_tokens("g-out") == ["d0", "d1", "d2"]
+    assert dm.group_device_tokens("g-out", role="b") == ["d1", "d2"]
+
+
+def test_commands_on_type(dm):
+    cmd = DeviceCommand(token="c1", name="reboot", namespace="sys")
+    dm.add_command("dt1", cmd)
+    assert dm.get_device_type("dt1").command_by_token("c1") is cmd
+
+
+def test_bootstrap_fleet(dm):
+    devices = dm.bootstrap_fleet(10, token_prefix="sim")
+    assert len(devices) == 10
+    assert dm.active_assignment_for("sim-00003") is not None
+
+
+def test_snapshot_roundtrip(tmp_path, dm):
+    dm.create_device(Device(token="d1", device_type_token="dt1", name="n1"))
+    dm.create_assignment(DeviceAssignment(token="a1", device_token="d1"))
+    dm.create_area(Area(token="ar1", bounds=[(1.0, 2.0), (3.0, 4.0)]))
+    path = tmp_path / "dm.json"
+    dm.save(path)
+    loaded = DeviceManagement.load(path)
+    assert loaded.get_device("d1").name == "n1"
+    assert loaded.active_assignment_for("d1").token == "a1"
+    assert loaded.get_area("ar1").bounds == [(1.0, 2.0), (3.0, 4.0)]
+
+
+def test_snapshot_preserves_commands_and_groups(tmp_path, dm):
+    dm.add_command("dt1", DeviceCommand(token="c1", name="reboot"))
+    for i in range(2):
+        dm.create_device(Device(token=f"d{i}", device_type_token="dt1"))
+    dm.create_group(DeviceGroup(
+        token="g1", elements=[DeviceGroupElement(device_token="d0", roles=["r"])]
+    ))
+    path = tmp_path / "dm.json"
+    dm.save(path)
+    loaded = DeviceManagement.load(path)
+    assert loaded.get_device_type("dt1").command_by_token("c1").name == "reboot"
+    assert loaded.group_device_tokens("g1") == ["d0"]

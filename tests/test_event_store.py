@@ -1,0 +1,95 @@
+"""Event store: paged queries, filters, replay windows, parquet spill."""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.events import (
+    DeviceAlert,
+    DeviceLocation,
+    DeviceMeasurement,
+    EventType,
+)
+from sitewhere_tpu.services.event_store import EventQuery, EventStore
+
+
+def _m(dev, name, value, ts, score=None):
+    return DeviceMeasurement(
+        device_token=dev, assignment_token=f"asn-{dev}", name=name,
+        value=value, event_ts=ts, score=score,
+    )
+
+
+@pytest.fixture
+def store():
+    s = EventStore("t1")
+    for i in range(50):
+        s.add_event(_m("d1", "temp", 20.0 + i * 0.1, 1000 + i))
+        s.add_event(_m("d2", "temp", 30.0 + i * 0.1, 1000 + i))
+    s.add_event(DeviceAlert(device_token="d1", alert_type="x", event_ts=1500))
+    s.add_event(DeviceLocation(device_token="d1", latitude=1, event_ts=1501))
+    return s
+
+
+def test_paged_measurement_query(store):
+    evs, total = store.list_measurements(EventQuery(device_token="d1", page_size=20))
+    assert total == 50
+    assert len(evs) == 20
+    # event-time order
+    assert [e.event_ts for e in evs] == sorted(e.event_ts for e in evs)
+
+
+def test_time_range_and_name_filters(store):
+    evs, total = store.list_measurements(
+        EventQuery(start_ts=1010, end_ts=1019, name="temp")
+    )
+    assert total == 20  # both devices
+    evs, total = store.list_measurements(
+        EventQuery(start_ts=1010, end_ts=1019, device_token="d2")
+    )
+    assert total == 10
+    assert all(e.device_token == "d2" for e in evs)
+
+
+def test_typed_event_listing(store):
+    alerts, total = store.list_events(EventQuery(event_type=EventType.ALERT))
+    assert total == 1 and alerts[0].alert_type == "x"
+    all_evs, total = store.list_events(EventQuery(device_token="d1", page_size=200))
+    assert total == 52  # 50 measurements + alert + location
+
+
+def test_get_event_by_id(store):
+    m = _m("d3", "temp", 1.0, 2000, score=4.2)
+    store.add_event(m)
+    fetched = store.get_event(m.id)
+    assert fetched.value == 1.0
+    assert fetched.score == pytest.approx(4.2, rel=1e-6)  # f32 column storage
+
+
+def test_replay_windows(store):
+    wins = list(store.replay_measurements(name="temp", window=16, stride=8))
+    assert wins
+    devs = {d for d, _, _ in wins}
+    assert devs == {"d1", "d2"}
+    for _, _, vals in wins:
+        assert vals.shape == (16,)
+    # windows are time-ordered slices
+    d1_wins = [v for d, _, v in wins if d == "d1"]
+    np.testing.assert_allclose(d1_wins[0][:3], [20.0, 20.1, 20.2], rtol=1e-5)
+
+
+def test_parquet_roundtrip(tmp_path, store):
+    path = store.save_parquet(tmp_path)
+    loaded = EventStore.load_parquet(path, "t1")
+    evs, total = loaded.list_measurements(EventQuery(device_token="d1"))
+    assert total == 50
+    alerts, atot = loaded.list_events(EventQuery(event_type=EventType.ALERT))
+    assert atot == 1
+
+
+def test_mixed_query_pagination_counts_all(store):
+    """Mixed-type queries paginate once over the merged stream."""
+    evs, total = store.list_events(EventQuery(page=2, page_size=40))
+    assert total == 102  # 100 measurements + alert + location
+    assert len(evs) == 40
+    evs_last, _ = store.list_events(EventQuery(page=3, page_size=40))
+    assert len(evs_last) == 22

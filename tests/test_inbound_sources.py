@@ -1,0 +1,131 @@
+"""Event sources + inbound processing: decode → enrich → route."""
+
+import asyncio
+import json
+
+import pytest
+
+from sitewhere_tpu.core.events import DeviceMeasurement, EventType
+from sitewhere_tpu.core.model import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.pipeline.inbound import InboundProcessor
+from sitewhere_tpu.pipeline.sources import EventSource, QueueReceiver, make_source
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.services.device_management import DeviceManagement
+
+
+@pytest.fixture
+def dm():
+    m = DeviceManagement("t1")
+    m.create_device_type(DeviceType(token="dt1"))
+    m.create_device(Device(token="d1", device_type_token="dt1"))
+    m.create_assignment(
+        DeviceAssignment(token="a1", device_token="d1", area_token="ar1")
+    )
+    # d2 exists but has no assignment
+    m.create_device(Device(token="d2", device_type_token="dt1"))
+    return m
+
+
+async def test_source_decodes_and_publishes(bus: EventBus):
+    src = make_source("mqtt", "t1", bus)
+    await src.start()
+    try:
+        bus.subscribe(bus.naming.decoded_events("t1"), "probe")
+        await src.receiver.submit(
+            json.dumps({"device_token": "d1", "name": "t", "value": 5.0}).encode()
+        )
+        await asyncio.sleep(0.05)
+        reqs = await bus.consume(bus.naming.decoded_events("t1"), "probe", timeout_s=0)
+        assert len(reqs) == 1
+        assert reqs[0]["value"] == 5.0
+        assert reqs[0]["_source"] == "mqtt"
+    finally:
+        await src.stop()
+
+
+async def test_source_routes_bad_payloads_to_failed_topic(bus: EventBus):
+    src = make_source("mqtt", "t1", bus)
+    await src.start()
+    try:
+        bus.subscribe(bus.naming.failed_decode("t1"), "probe")
+        await src.receiver.submit(b"{broken json")
+        await asyncio.sleep(0.05)
+        fails = await bus.consume(bus.naming.failed_decode("t1"), "probe", timeout_s=0)
+        assert len(fails) == 1
+        assert "payload_b64" in fails[0]
+    finally:
+        await src.stop()
+
+
+async def test_inbound_enriches_with_assignment(bus: EventBus, dm):
+    proc = InboundProcessor("t1", bus, dm)
+    bus.subscribe(bus.naming.inbound_events("t1"), "probe")
+    ev = await proc.process_request(
+        {"type": "measurement", "device_token": "d1", "name": "t", "value": 1.0}
+    )
+    assert isinstance(ev, DeviceMeasurement)
+    assert ev.assignment_token == "a1"
+    assert ev.area_token == "ar1"
+    assert ev.tenant == "t1"
+    assert "inbound" in ev.trace
+    out = await bus.consume(bus.naming.inbound_events("t1"), "probe", timeout_s=0)
+    assert len(out) == 1
+
+
+async def test_inbound_routes_unknown_device_to_registration(bus: EventBus, dm):
+    proc = InboundProcessor("t1", bus, dm)
+    bus.subscribe(bus.naming.unregistered_devices("t1"), "probe")
+    ev = await proc.process_request(
+        {"type": "measurement", "device_token": "ghost", "value": 1.0}
+    )
+    assert ev is None
+    out = await bus.consume(bus.naming.unregistered_devices("t1"), "probe", timeout_s=0)
+    assert out[0]["device_token"] == "ghost"
+
+
+async def test_inbound_rejects_unassigned_device(bus: EventBus, dm):
+    proc = InboundProcessor("t1", bus, dm)
+    ev = await proc.process_request(
+        {"type": "measurement", "device_token": "d2", "value": 1.0}
+    )
+    assert ev is None
+    assert proc.metrics.counter("inbound.rejected").value == 1
+
+
+async def test_inbound_full_loop_via_bus(bus: EventBus, dm):
+    """decoded-events topic → InboundProcessor task → inbound-events topic."""
+    proc = InboundProcessor("t1", bus, dm)
+    await proc.start()
+    try:
+        bus.subscribe(bus.naming.inbound_events("t1"), "probe")
+        await bus.publish(
+            bus.naming.decoded_events("t1"),
+            {"type": "location", "device_token": "d1", "latitude": 3.0, "longitude": 4.0},
+        )
+        await asyncio.sleep(0.05)
+        out = await bus.consume(bus.naming.inbound_events("t1"), "probe", timeout_s=0)
+        assert len(out) == 1
+        assert out[0].EVENT_TYPE is EventType.LOCATION
+        assert out[0].latitude == 3.0
+    finally:
+        await proc.stop()
+
+
+async def test_source_survives_garbled_bytes(bus: EventBus):
+    """Non-DecodeError exceptions (e.g. garbled UTF-8) must not kill the pump."""
+    src = make_source("mqtt", "t1", bus)
+    await src.start()
+    try:
+        bus.subscribe(bus.naming.failed_decode("t1"), "probe")
+        bus.subscribe(bus.naming.decoded_events("t1"), "probe2")
+        await src.receiver.submit(b"\xff\xfe garbage \x00")
+        await src.receiver.submit(
+            json.dumps({"device_token": "d1", "name": "t", "value": 1.0}).encode()
+        )
+        await asyncio.sleep(0.05)
+        fails = await bus.consume(bus.naming.failed_decode("t1"), "probe", timeout_s=0)
+        ok = await bus.consume(bus.naming.decoded_events("t1"), "probe2", timeout_s=0)
+        assert len(fails) == 1
+        assert len(ok) == 1  # pump still alive after the bad payload
+    finally:
+        await src.stop()

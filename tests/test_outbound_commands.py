@@ -1,0 +1,217 @@
+"""Outbound connectors, command delivery, sim broker round-trips."""
+
+import json
+
+import pytest
+
+from sitewhere_tpu.core.events import (
+    DeviceAlert,
+    DeviceCommandInvocation,
+    DeviceMeasurement,
+    EventType,
+)
+from sitewhere_tpu.core.model import Device, DeviceCommand, DeviceType
+from sitewhere_tpu.pipeline.commands import (
+    BinaryCommandEncoder,
+    CollectingDestination,
+    CommandDelivery,
+    CommandEncodeError,
+    JsonCommandEncoder,
+    validate_parameters,
+)
+from sitewhere_tpu.pipeline.outbound import (
+    CallbackConnector,
+    JsonlFileConnector,
+    LogConnector,
+    MqttTopicConnector,
+    OutboundDispatcher,
+    area_filter,
+    type_filter,
+)
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.services.device_management import DeviceManagement
+from sitewhere_tpu.sim.broker import SimBroker, _topic_matches
+
+
+def _m(dev="d1", value=1.0, area=""):
+    return DeviceMeasurement(device_token=dev, value=value, name="t", area_token=area)
+
+
+class TestBrokerMatching:
+    def test_wildcards(self):
+        assert _topic_matches("a/+/c", "a/b/c")
+        assert not _topic_matches("a/+/c", "a/b/d")
+        assert _topic_matches("a/#", "a/b/c/d")
+        assert _topic_matches("#", "anything/at/all")
+        assert not _topic_matches("a/b", "a/b/c")
+
+    async def test_pub_sub(self):
+        broker = SimBroker()
+        got = []
+
+        async def h(topic, payload):
+            got.append((topic, payload))
+
+        broker.subscribe("sensors/+", h)
+        n = await broker.publish("sensors/x", b"1")
+        assert n == 1 and got == [("sensors/x", b"1")]
+        await broker.publish("other/x", b"2")
+        assert len(got) == 1
+
+
+class TestConnectors:
+    async def test_filters(self):
+        c = LogConnector(filters=[type_filter(EventType.ALERT), area_filter("a1")])
+        assert not await c.process(_m())  # wrong type
+        alert = DeviceAlert(device_token="d", area_token="a1")
+        assert await c.process(alert)
+        alert2 = DeviceAlert(device_token="d", area_token="a2")
+        assert not await c.process(alert2)  # wrong area
+        assert c.events == [alert]
+
+    async def test_jsonl_connector(self, tmp_path):
+        c = JsonlFileConnector("f", tmp_path / "out.jsonl")
+        await c.start()
+        await c.process(_m(value=42.0))
+        await c.stop()
+        lines = (tmp_path / "out.jsonl").read_text().splitlines()
+        assert json.loads(lines[0])["value"] == 42.0
+
+    async def test_mqtt_topic_connector(self):
+        broker = SimBroker()
+        got = []
+
+        async def h(topic, payload):
+            got.append(topic)
+
+        broker.subscribe("sitewhere/output/#", h)
+        c = MqttTopicConnector("m", broker)
+        await c.process(_m(dev="devX"))
+        assert got == ["sitewhere/output/devX/measurement"]
+
+    async def test_connector_errors_isolated(self):
+        async def boom(e):
+            raise RuntimeError("down")
+
+        c = CallbackConnector("cb", boom)
+        assert not await c.process(_m())
+        assert c.failed == 1
+        assert c.errors
+
+    async def test_dispatcher_fans_out(self, bus: EventBus):
+        c1, c2 = LogConnector("l1"), LogConnector("l2")
+        d = OutboundDispatcher("t1", bus, [c1, c2])
+        await d.start()
+        try:
+            await bus.publish(bus.naming.persisted_events("t1"), _m())
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            assert len(c1.events) == 1 and len(c2.events) == 1
+        finally:
+            await d.stop()
+
+
+class TestCommandDelivery:
+    @pytest.fixture
+    def dm(self):
+        m = DeviceManagement("t1")
+        dt = DeviceType(token="dt1", name="thermo")
+        dt.commands.append(
+            DeviceCommand(
+                token="c-reboot", name="reboot", namespace="sys",
+                parameters=[{"name": "delay", "type": "int64", "required": "true"}],
+            )
+        )
+        m.create_device_type(dt)
+        m.create_device(Device(token="d1", device_type_token="dt1"))
+        return m
+
+    def test_validate_parameters(self, dm):
+        cmd = dm.get_device_type("dt1").commands[0]
+        out = validate_parameters(cmd, {"delay": "5"})
+        assert out == {"delay": 5}
+        with pytest.raises(CommandEncodeError):
+            validate_parameters(cmd, {})
+        with pytest.raises(CommandEncodeError):
+            validate_parameters(cmd, {"delay": "xyz"})
+
+    def test_encoders(self, dm):
+        cmd = dm.get_device_type("dt1").commands[0]
+        inv = DeviceCommandInvocation(device_token="d1", command_token="c-reboot")
+        j = JsonCommandEncoder().encode(inv, cmd, {"delay": 5})
+        assert json.loads(j)["command"] == "reboot"
+        b = BinaryCommandEncoder().encode(inv, cmd, {"delay": 5})
+        assert b[:2] == b"TW"[::-1] or len(b) > 8  # magic LE framing
+
+    async def test_delivery_roundtrip(self, bus: EventBus, dm):
+        dest = CollectingDestination()
+        cd = CommandDelivery("t1", bus, dm, dest)
+        inv = DeviceCommandInvocation(
+            device_token="d1", command_token="c-reboot", parameters={"delay": "3"}
+        )
+        ok = await cd.deliver_invocation(inv)
+        assert ok
+        assert dest.deliveries[0][0] == "d1"
+        frame = json.loads(dest.deliveries[0][1])
+        assert frame["command"] == "reboot" and frame["parameters"] == {"delay": 3}
+
+    async def test_undeliverable_goes_to_topic(self, bus: EventBus, dm):
+        dest = CollectingDestination()
+        cd = CommandDelivery("t1", bus, dm, dest)
+        bus.subscribe(bus.naming.undelivered_commands("t1"), "probe")
+        ok = await cd.deliver_invocation(
+            DeviceCommandInvocation(device_token="ghost", command_token="c-reboot")
+        )
+        assert not ok
+        out = await bus.consume(bus.naming.undelivered_commands("t1"), "probe", timeout_s=0)
+        assert "unknown device" in out[0]["reason"]
+
+    async def test_missing_required_param_undelivered(self, bus: EventBus, dm):
+        dest = CollectingDestination()
+        cd = CommandDelivery("t1", bus, dm, dest)
+        ok = await cd.deliver_invocation(
+            DeviceCommandInvocation(device_token="d1", command_token="c-reboot")
+        )
+        assert not ok and not dest.deliveries
+
+
+class TestSimulator:
+    async def test_publish_round_and_anomaly(self):
+        from sitewhere_tpu.sim import DeviceSimulator, SimBroker, SimProfile
+
+        broker = SimBroker()
+        got = []
+
+        async def h(topic, payload):
+            got.append(json.loads(payload))
+
+        broker.subscribe("sitewhere/input/+", h)
+        sim = DeviceSimulator(
+            broker, SimProfile(n_devices=5, anomaly_rate=0.0, seed=1)
+        )
+        await sim.publish_round(0.0)
+        assert len(got) == 5
+        assert {g["device_token"] for g in got} == set(sim.device_tokens())
+        await sim.publish_once(sim.device_tokens()[0], 0.0, force_anomaly=True)
+        assert len(sim.anomalies_injected) == 1
+
+    async def test_command_ack_loop(self):
+        from sitewhere_tpu.sim import DeviceSimulator, SimBroker, SimProfile
+
+        broker = SimBroker()
+        sim = DeviceSimulator(broker, SimProfile(n_devices=1))
+        sim.listen_for_commands()
+        acks = []
+
+        async def h(topic, payload):
+            acks.append(json.loads(payload))
+
+        broker.subscribe("sitewhere/input/+", h)
+        await broker.publish(
+            "sitewhere/command/dev-00000",
+            json.dumps({"command": "reboot", "invocation_id": "inv1"}).encode(),
+        )
+        assert len(acks) == 1
+        assert acks[0]["type"] == "command_response"
+        assert acks[0]["originating_event_id"] == "inv1"

@@ -1,0 +1,118 @@
+"""Mesh, tenant router, and sharded multi-tenant scoring on the 8-device
+virtual CPU mesh (SURVEY.md §4 "TPU-without-TPU")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sitewhere_tpu.models import get_model, make_config
+from sitewhere_tpu.parallel.mesh import MeshManager, default_mesh
+from sitewhere_tpu.parallel.sharded import ShardedScorer, stack_params, unstack_slot
+from sitewhere_tpu.parallel.tenant_router import PlacementError, TenantRouter
+
+
+def test_default_mesh_inference():
+    m = default_mesh()  # 8 virtual devices → tenant=8
+    assert m.shape["tenant"] * m.shape["data"] * m.shape["model"] == 8
+    m2 = default_mesh(tenant=4, data=2)
+    assert m2.shape["tenant"] == 4 and m2.shape["data"] == 2
+
+
+def test_mesh_manager_axes():
+    mm = MeshManager(tenant=4, data=2)
+    assert mm.n_tenant_shards == 4
+    assert mm.n_data_shards == 2
+    assert mm.n_devices == 8
+
+
+class TestTenantRouter:
+    def test_balanced_placement_32_tenants(self):
+        """The 32-tenant concurrent-scoring config (BASELINE.json:10)."""
+        r = TenantRouter(n_shards=4, slots_per_shard=8)
+        placements = [r.place(f"t{i:02d}") for i in range(32)]
+        loads = r.shard_load("lstm_ad")
+        assert loads == [8, 8, 8, 8]
+        slots = {(p.shard, p.slot) for p in placements}
+        assert len(slots) == 32  # all distinct
+        with pytest.raises(PlacementError):
+            r.place("t32")
+
+    def test_remove_frees_slot(self):
+        r = TenantRouter(2, 1)
+        r.place("a")
+        r.place("b")
+        r.remove("a")
+        p = r.place("c")
+        assert p.shard in (0, 1)
+
+    def test_failover_moves_shard(self):
+        r = TenantRouter(4, 8)
+        p0 = r.place("t0")
+        p1 = r.failover("t0")
+        assert p1.shard != p0.shard
+        assert p1.generation == p0.generation + 1
+        assert r.placement("t0") == p1
+
+    def test_family_isolation(self):
+        r = TenantRouter(2, 1)
+        r.place("a", family="lstm_ad")
+        r.place("b", family="deepar")  # own stack → own slots
+        assert r.shard_load("lstm_ad") in ([1, 0], [0, 1])
+        assert r.shard_load("deepar") in ([1, 0], [0, 1])
+
+
+class TestShardedScorer:
+    @pytest.fixture(scope="class")
+    def scorer(self):
+        mm = MeshManager(tenant=4, data=2)
+        spec = get_model("lstm_ad")
+        cfg = make_config("lstm_ad", {"window": 8, "hidden": 8})
+        return ShardedScorer(
+            mm, spec, cfg, slots_per_shard=2, max_streams=16, window=8
+        )
+
+    def test_step_shapes_and_masking(self, scorer):
+        T, B = scorer.n_slots, 8
+        ids = jnp.zeros((T, B), jnp.int32)
+        vals = jnp.ones((T, B), jnp.float32)
+        valid = jnp.ones((T, B), bool)
+        scores = scorer.step(ids, vals, valid)
+        assert scores.shape == (T, B)
+        # no tenant active yet → all masked to 0
+        assert float(jnp.abs(scores).max()) == 0.0
+
+    def test_activate_scores_only_that_slot(self, scorer):
+        scorer.activate(3)
+        T, B = scorer.n_slots, 8
+        ids = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32) % 4, (T, B))
+        rng = np.random.default_rng(0)
+        # feed several batches so windows warm past the cold-start gate
+        for i in range(6):
+            vals = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+            scores = scorer.step(ids, vals, jnp.ones((T, B), bool))
+        assert scores.shape == (T, B)
+        scores_np = np.asarray(scores)
+        inactive = scores_np[[i for i in range(T) if i != 3]]
+        assert np.all(inactive == 0.0)
+        assert np.any(scores_np[3] != 0.0)
+        scorer.deactivate(3)
+
+    def test_sharding_layout(self, scorer):
+        """Params sharded over tenant axis; state over (tenant, data)."""
+        leaf = jax.tree_util.tree_leaves(scorer.params)[0]
+        assert len(leaf.sharding.device_set) >= 4
+        st = scorer.state.values
+        assert len(st.sharding.device_set) == 8
+
+
+def test_stack_unstack_roundtrip():
+    spec = get_model("lstm_ad")
+    cfg = make_config("lstm_ad", {"hidden": 4})
+    ps = [spec.init(jax.random.PRNGKey(i), cfg) for i in range(3)]
+    stacked = stack_params(ps)
+    back = unstack_slot(stacked, 1)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(back), jax.tree_util.tree_leaves(ps[1])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))

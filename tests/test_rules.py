@@ -1,0 +1,187 @@
+"""CEP rule engine: thresholds, windows, geofence, cooldown, TPU UDF."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.events import (
+    AlertLevel,
+    DeviceAlert,
+    DeviceLocation,
+    DeviceMeasurement,
+    EventType,
+)
+from sitewhere_tpu.pipeline.rules import (
+    AGGREGATES,
+    ModelUdf,
+    Rule,
+    RuleEngine,
+    SlidingWindow,
+    alert_action,
+    anomaly_score_rule,
+    command_action,
+    forecast_breach_rule,
+    geofence_rule,
+    threshold_rule,
+)
+from sitewhere_tpu.runtime.bus import EventBus
+
+
+def _m(value, dev="d1", name="temp", score=None, ts=1000):
+    return DeviceMeasurement(
+        device_token=dev, name=name, value=value, score=score, event_ts=ts
+    )
+
+
+@pytest.mark.asyncio
+class TestRules:
+    async def test_threshold_rule_fires(self):
+        r = threshold_rule("hot", "temp", ">", 30.0)
+        assert await r.evaluate(_m(25.0)) is None
+        derived = await r.evaluate(_m(31.0))
+        assert len(derived) == 1
+        assert isinstance(derived[0], DeviceAlert)
+        assert derived[0].alert_type == "threshold"
+        assert derived[0].source == "rule"
+
+    async def test_threshold_ignores_other_measurements(self):
+        r = threshold_rule("hot", "temp", ">", 30.0)
+        assert await r.evaluate(_m(99.0, name="pressure")) is None
+
+    async def test_windowed_aggregate_with_having(self):
+        r = Rule(
+            name="avg-high",
+            window=4,
+            min_window=4,
+            aggregate="avg",
+            having=lambda a: a > 10.0,
+            action=alert_action("avg-high"),
+        )
+        for v in (1.0, 2.0, 3.0):
+            assert await r.evaluate(_m(v)) is None  # window not full
+        assert await r.evaluate(_m(4.0)) is None    # avg=2.5
+        derived = await r.evaluate(_m(100.0))       # avg of (2,3,4,100) > 10
+        assert derived is not None
+
+    async def test_window_grouping_is_per_device(self):
+        r = Rule(name="g", window=2, min_window=2, aggregate="count",
+                 having=lambda a: a >= 2, action=alert_action("g"))
+        assert await r.evaluate(_m(1.0, dev="a")) is None
+        assert await r.evaluate(_m(1.0, dev="b")) is None  # separate window
+        assert await r.evaluate(_m(1.0, dev="a")) is not None
+
+    async def test_anomaly_score_rule(self):
+        r = anomaly_score_rule("anom", min_score=3.0)
+        assert await r.evaluate(_m(1.0, score=1.0)) is None
+        assert await r.evaluate(_m(1.0, score=None)) is None
+        derived = await r.evaluate(_m(1.0, score=4.5))
+        assert derived[0].level is AlertLevel.ERROR
+
+    async def test_geofence_rule_outside(self):
+        square = [(0.0, 0.0), (0.0, 10.0), (10.0, 10.0), (10.0, 0.0)]
+        r = geofence_rule("fence", square, inside=False)
+        inside = DeviceLocation(device_token="d", latitude=5.0, longitude=5.0)
+        outside = DeviceLocation(device_token="d", latitude=15.0, longitude=5.0)
+        assert await r.evaluate(inside) is None
+        assert (await r.evaluate(outside))[0].alert_type == "geofence"
+
+    async def test_cooldown_suppresses_refire(self):
+        r = threshold_rule("hot", "temp", ">", 0.0, cooldown_ms=60_000)
+        assert await r.evaluate(_m(1.0)) is not None
+        assert await r.evaluate(_m(1.0)) is None  # cooling down
+        assert await r.evaluate(_m(1.0, dev="other")) is not None  # per group
+
+    async def test_command_action(self):
+        r = Rule(
+            name="reboot-on-alert",
+            event_type=EventType.MEASUREMENT,
+            where=lambda e: e.value > 100,
+            action=command_action("cmd-reboot", {"delay": "5"}),
+        )
+        derived = await r.evaluate(_m(101.0))
+        assert derived[0].EVENT_TYPE is EventType.COMMAND_INVOCATION
+        assert derived[0].command_token == "cmd-reboot"
+        assert derived[0].initiator == "rule"
+
+
+def test_sliding_window_time_eviction():
+    w = SlidingWindow(time_ms=100)
+    w.push(1000, 1.0)
+    w.push(1050, 2.0)
+    w.push(1150, 3.0)  # cutoff 1050: evicts ts=1000, keeps ts=1050
+    assert list(w.values()) == [2.0, 3.0]
+
+
+def test_aggregates():
+    v = np.asarray([1.0, 2.0, 3.0], np.float32)
+    assert AGGREGATES["avg"](v) == 2.0
+    assert AGGREGATES["max"](v) == 3.0
+    assert AGGREGATES["count"](v) == 3.0
+    assert AGGREGATES["last"](v) == 3.0
+
+
+@pytest.mark.asyncio
+async def test_rule_engine_publishes_derived(bus: EventBus):
+    engine = RuleEngine("t1", bus, rules=[threshold_rule("hot", "temp", ">", 30.0)])
+    bus.subscribe(bus.naming.scored_events("t1"), "probe")
+    derived = await engine.process_event(_m(35.0))
+    assert len(derived) == 1
+    out = await bus.consume(bus.naming.scored_events("t1"), "probe", timeout_s=0)
+    assert len(out) == 1 and out[0].alert_type == "threshold"
+
+
+@pytest.mark.asyncio
+async def test_rule_engine_isolates_bad_rules(bus: EventBus):
+    def boom(e):
+        raise RuntimeError("bad rule")
+
+    engine = RuleEngine(
+        "t1", bus,
+        rules=[Rule(name="bad", where=boom),
+               threshold_rule("hot", "temp", ">", 30.0)],
+    )
+    derived = await engine.process_event(_m(35.0))
+    assert len(derived) == 1  # good rule still fired
+    assert any("bad" in err for err in engine.errors)
+
+
+@pytest.mark.asyncio
+async def test_command_invocations_route_to_command_topic(bus: EventBus):
+    engine = RuleEngine(
+        "t1", bus,
+        rules=[Rule(name="r", where=lambda e: True,
+                    action=command_action("cmd-x"))],
+    )
+    bus.subscribe(bus.naming.command_invocations("t1"), "probe")
+    await engine.process_event(_m(1.0))
+    out = await bus.consume(bus.naming.command_invocations("t1"), "probe", timeout_s=0)
+    assert len(out) == 1 and out[0].command_token == "cmd-x"
+
+
+class TestModelUdf:
+    def test_score_udf(self):
+        udf = ModelUdf("lstm_ad", {"window": 16, "hidden": 8})
+        vals = np.sin(np.linspace(0, 6, 40)).astype(np.float32)
+        s = udf.score(vals)
+        assert np.isfinite(s)
+
+    def test_forecast_udf_and_breach_rule(self):
+        udf = ModelUdf("deepar", {"context": 16, "horizon": 4, "hidden": 8, "num_samples": 4})
+        vals = np.linspace(0, 1, 32).astype(np.float32)
+        mean = udf.forecast(vals)
+        assert mean.shape == (4,)
+
+    @pytest.mark.asyncio
+    async def test_forecast_breach_rule_fires(self):
+        udf = ModelUdf("deepar", {"context": 8, "horizon": 4, "hidden": 8, "num_samples": 4})
+        r = forecast_breach_rule(
+            "breach", udf, "temp", ">", -1e9, window=8, cooldown_ms=0
+        )  # threshold below any value → always breaches once window fills
+        fired = []
+        for i in range(8):
+            derived = await r.evaluate(_m(float(i), ts=1000 + i))
+            if derived:
+                fired.extend(derived)
+        assert fired
+        assert fired[0].alert_type == "forecast-breach"
