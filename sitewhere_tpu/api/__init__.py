@@ -1,0 +1,6 @@
+"""L6 external APIs: REST gateway (aiohttp) + gRPC service surface.
+
+Capability parity with the reference's service-web-rest (Spring MVC
+controllers per resource + JWT auth filter + Swagger docs) and per-service
+gRPC endpoints (SURVEY.md §1 L6 / §2.2 [U]).
+"""

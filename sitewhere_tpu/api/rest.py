@@ -1,0 +1,593 @@
+"""REST API gateway over the instance (aiohttp).
+
+Capability parity with the reference's service-web-rest (SURVEY.md §2.2
+[U]: one Spring MVC controller per resource area — devices, device types,
+assignments, events, areas, zones, assets, users, tenants, schedules,
+batch, labels — behind a JWT auth filter, plus Swagger docs; reference
+mount empty, see provenance banner).
+
+Redesign: aiohttp handlers calling the in-proc services directly (the
+reference pays a gRPC hop per request here). Auth: ``Authorization:
+Bearer <jwt>`` validated by ``services.user_management``; ``/api/openapi.json``
+serves a generated OpenAPI sketch (the Swagger-docs analog);
+``/metrics`` is the Prometheus scrape endpoint (SURVEY.md §5).
+
+Tenant scoping: ``X-SiteWhere-Tenant`` header (default "default"), matching
+the reference's tenant auth headers [U].
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Optional
+
+from aiohttp import web
+
+from sitewhere_tpu.core.events import EventType
+from sitewhere_tpu.core.model import (
+    Area,
+    Asset,
+    AssetType,
+    Customer,
+    Device,
+    DeviceAssignment,
+    DeviceCommand,
+    DeviceType,
+    Zone,
+)
+from sitewhere_tpu.instance import SiteWhereInstance, TenantRuntime
+from sitewhere_tpu.services.batch_operations import BatchOpStatus
+from sitewhere_tpu.services.event_store import EventQuery
+from sitewhere_tpu.services.schedule_management import Schedule
+from sitewhere_tpu.services.user_management import (
+    AUTH_ADMIN,
+    AUTH_DEVICE_MANAGE,
+    AUTH_TENANT_ADMIN,
+    AuthError,
+)
+from sitewhere_tpu.core.events import DeviceCommandInvocation
+
+JSON = "application/json"
+
+
+def _entity(e) -> dict:
+    return e.to_dict() if hasattr(e, "to_dict") else dict(e)
+
+
+def _paged(items, total, page, page_size) -> dict:
+    return {
+        "results": [_entity(i) for i in items],
+        "total": total,
+        "page": page,
+        "page_size": page_size,
+    }
+
+
+class RestApi:
+    """aiohttp application exposing the platform."""
+
+    def __init__(self, instance: SiteWhereInstance) -> None:
+        self.instance = instance
+        self.app = web.Application(middlewares=[self._auth_middleware])
+        self._routes()
+
+    # -- auth ------------------------------------------------------------
+    PUBLIC = {("POST", "/api/authapi/jwt"), ("GET", "/api/health"),
+              ("GET", "/metrics"), ("GET", "/api/openapi.json")}
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        key = (request.method, request.path)
+        if key in self.PUBLIC:
+            return await handler(request)
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return web.json_response({"error": "missing bearer token"}, status=401)
+        try:
+            claims = self.instance.users.validate_token(auth[7:])
+        except AuthError as exc:
+            return web.json_response({"error": str(exc)}, status=401)
+        request["claims"] = claims
+        try:
+            return await handler(request)
+        except AuthError as exc:
+            return web.json_response({"error": str(exc)}, status=403)
+        except (KeyError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+
+    def _tenant(self, request: web.Request) -> TenantRuntime:
+        token = request.headers.get("X-SiteWhere-Tenant", "default")
+        rt = self.instance.tenants.get(token)
+        if rt is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"tenant '{token}' not found"}),
+                content_type=JSON,
+            )
+        return rt
+
+    @staticmethod
+    def _page(request: web.Request) -> tuple:
+        return (
+            int(request.query.get("page", 1)),
+            int(request.query.get("page_size", 100)),
+        )
+
+    # -- routes ----------------------------------------------------------
+    def _routes(self) -> None:
+        r = self.app.router
+        r.add_post("/api/authapi/jwt", self.login)
+        r.add_get("/api/health", self.health)
+        r.add_get("/metrics", self.metrics)
+        r.add_get("/api/openapi.json", self.openapi)
+        r.add_get("/api/instance/topology", self.topology)
+
+        r.add_get("/api/devicetypes", self.list_device_types)
+        r.add_post("/api/devicetypes", self.create_device_type)
+        r.add_get("/api/devicetypes/{token}", self.get_device_type)
+        r.add_post("/api/devicetypes/{token}/commands", self.add_command)
+
+        r.add_get("/api/devices", self.list_devices)
+        r.add_post("/api/devices", self.create_device)
+        r.add_get("/api/devices/{token}", self.get_device)
+        r.add_delete("/api/devices/{token}", self.delete_device)
+        r.add_get("/api/devices/{token}/state", self.device_state)
+        r.add_get("/api/devices/{token}/label", self.device_label)
+
+        r.add_get("/api/assignments", self.list_assignments)
+        r.add_post("/api/assignments", self.create_assignment)
+        r.add_get("/api/assignments/{token}/measurements", self.assignment_measurements)
+        r.add_post("/api/assignments/{token}/invocations", self.invoke_command)
+        r.add_delete("/api/assignments/{token}", self.release_assignment)
+
+        r.add_get("/api/events", self.list_events)
+        r.add_get("/api/areas", self.list_areas)
+        r.add_post("/api/areas", self.create_area)
+        r.add_get("/api/zones", self.list_zones)
+        r.add_post("/api/zones", self.create_zone)
+
+        r.add_get("/api/assets", self.list_assets)
+        r.add_post("/api/assets", self.create_asset)
+        r.add_post("/api/assettypes", self.create_asset_type)
+
+        r.add_get("/api/users", self.list_users)
+        r.add_post("/api/users", self.create_user)
+
+        r.add_get("/api/tenants", self.list_tenants)
+        r.add_post("/api/tenants", self.create_tenant)
+        r.add_post("/api/tenants/{token}/restart", self.restart_tenant)
+        r.add_delete("/api/tenants/{token}", self.delete_tenant)
+
+        r.add_get("/api/schedules", self.list_schedules)
+        r.add_post("/api/schedules", self.create_schedule)
+
+        r.add_post("/api/batch", self.create_batch)
+        r.add_get("/api/batch/{token}", self.get_batch)
+
+        r.add_post("/api/streams", self.create_stream)
+        r.add_put("/api/streams/{id}/chunks/{seq}", self.put_chunk)
+        r.add_get("/api/streams/{id}/chunks/{seq}", self.get_chunk)
+
+    # -- auth/infra handlers --------------------------------------------
+    async def login(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            token = self.instance.users.issue_token(
+                body.get("username", ""), body.get("password", "")
+            )
+        except AuthError as exc:
+            return web.json_response({"error": str(exc)}, status=401)
+        return web.json_response({"token": token})
+
+    async def health(self, request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "state": self.instance.state.value}
+        )
+
+    async def metrics(self, request) -> web.Response:
+        return web.Response(
+            text=self.instance.metrics.prometheus_text(),
+            content_type="text/plain",
+        )
+
+    async def topology(self, request) -> web.Response:
+        return web.json_response(self.instance.topology())
+
+    async def openapi(self, request) -> web.Response:
+        paths: dict = {}
+        for route in self.app.router.routes():
+            info = route.resource.get_info() if route.resource else {}
+            path = info.get("path") or info.get("formatter")
+            if not path:
+                continue
+            paths.setdefault(path, {})[route.method.lower()] = {
+                "summary": (route.handler.__doc__ or route.handler.__name__).strip()
+            }
+        return web.json_response(
+            {
+                "openapi": "3.0.0",
+                "info": {"title": "sitewhere-tpu", "version": "0.1.0"},
+                "paths": paths,
+            }
+        )
+
+    # -- device types ----------------------------------------------------
+    async def list_device_types(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.device_management.device_types.page(page, size)
+        return web.json_response(_paged(items, total, page, size))
+
+    async def create_device_type(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        b = await request.json()
+        dt = DeviceType(
+            token=b.get("token") or DeviceType().token,
+            name=b.get("name", ""),
+            description=b.get("description", ""),
+        )
+        rt.device_management.create_device_type(dt)
+        return web.json_response(_entity(dt), status=201)
+
+    async def get_device_type(self, request) -> web.Response:
+        rt = self._tenant(request)
+        dt = rt.device_management.get_device_type(request.match_info["token"])
+        if dt is None:
+            return web.json_response({"error": "not found"}, status=404)
+        d = _entity(dt)
+        d["commands"] = [_entity(c) for c in dt.commands]
+        return web.json_response(d)
+
+    async def add_command(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        b = await request.json()
+        cmd = DeviceCommand(
+            token=b.get("token") or DeviceCommand().token,
+            name=b.get("name", ""),
+            namespace=b.get("namespace", "default"),
+            parameters=b.get("parameters", []),
+        )
+        rt.device_management.add_command(request.match_info["token"], cmd)
+        return web.json_response(_entity(cmd), status=201)
+
+    # -- devices ---------------------------------------------------------
+    async def list_devices(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.device_management.list_devices(
+            page, size, request.query.get("device_type", "")
+        )
+        return web.json_response(_paged(items, total, page, size))
+
+    async def create_device(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        b = await request.json()
+        d = Device(
+            token=b.get("token") or Device().token,
+            name=b.get("name", ""),
+            device_type_token=b.get("device_type_token", ""),
+            comments=b.get("comments", ""),
+        )
+        rt.device_management.create_device(d)
+        if b.get("assign", True):
+            rt.device_management.create_assignment(
+                DeviceAssignment(
+                    device_token=d.token,
+                    area_token=b.get("area_token", ""),
+                    asset_token=b.get("asset_token", ""),
+                    customer_token=b.get("customer_token", ""),
+                )
+            )
+        return web.json_response(_entity(d), status=201)
+
+    async def get_device(self, request) -> web.Response:
+        rt = self._tenant(request)
+        d = rt.device_management.get_device(request.match_info["token"])
+        if d is None:
+            return web.json_response({"error": "not found"}, status=404)
+        out = _entity(d)
+        a = rt.device_management.active_assignment_for(d.token)
+        if a is not None:
+            out["active_assignment"] = _entity(a)
+        return web.json_response(out)
+
+    async def delete_device(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        rt.device_management.delete_device(request.match_info["token"])
+        return web.json_response({"deleted": request.match_info["token"]})
+
+    async def device_state(self, request) -> web.Response:
+        rt = self._tenant(request)
+        st = rt.state.get_state(request.match_info["token"])
+        if st is None:
+            return web.json_response({"error": "no state"}, status=404)
+        return web.json_response(st.to_dict())
+
+    async def device_label(self, request) -> web.Response:
+        rt = self._tenant(request)
+        png = rt.labels.qr_png("device", request.match_info["token"])
+        return web.Response(body=png, content_type="image/png")
+
+    # -- assignments + events -------------------------------------------
+    async def list_assignments(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.device_management.list_assignments(
+            page, size, request.query.get("device", "")
+        )
+        return web.json_response(_paged(items, total, page, size))
+
+    async def create_assignment(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        b = await request.json()
+        a = DeviceAssignment(
+            device_token=b["device_token"],
+            area_token=b.get("area_token", ""),
+            asset_token=b.get("asset_token", ""),
+            customer_token=b.get("customer_token", ""),
+        )
+        rt.device_management.create_assignment(a)
+        return web.json_response(_entity(a), status=201)
+
+    async def release_assignment(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        a = rt.device_management.release_assignment(request.match_info["token"])
+        return web.json_response(_entity(a))
+
+    def _event_query(self, request, **extra) -> EventQuery:
+        q = request.query
+        et = q.get("type", extra.pop("type", ""))
+        return EventQuery(
+            assignment_token=extra.get("assignment_token", q.get("assignment", "")),
+            device_token=q.get("device", ""),
+            area_token=q.get("area", ""),
+            name=q.get("name", ""),
+            event_type=EventType(et) if et else None,
+            start_ts=int(q.get("start", 0)),
+            end_ts=int(q.get("end", 0)),
+            page=int(q.get("page", 1)),
+            page_size=int(q.get("page_size", 100)),
+        )
+
+    async def assignment_measurements(self, request) -> web.Response:
+        """The §3.4 read path: paged measurements for an assignment."""
+        rt = self._tenant(request)
+        q = self._event_query(
+            request, assignment_token=request.match_info["token"]
+        )
+        evs, total = rt.event_store.list_measurements(q)
+        return web.json_response(_paged(evs, total, q.page, q.page_size))
+
+    async def list_events(self, request) -> web.Response:
+        rt = self._tenant(request)
+        q = self._event_query(request)
+        evs, total = rt.event_store.list_events(q)
+        return web.json_response(_paged(evs, total, q.page, q.page_size))
+
+    async def invoke_command(self, request) -> web.Response:
+        """The §3.2 write path: create + dispatch a command invocation."""
+        rt = self._tenant(request)
+        b = await request.json()
+        a = rt.device_management.get_assignment(request.match_info["token"])
+        if a is None:
+            return web.json_response({"error": "unknown assignment"}, status=404)
+        inv = DeviceCommandInvocation(
+            device_token=a.device_token,
+            assignment_token=a.token,
+            tenant=rt.tenant,
+            command_token=b["command_token"],
+            initiator="rest",
+            initiator_id=request["claims"].get("sub", ""),
+            parameters={k: str(v) for k, v in b.get("parameters", {}).items()},
+        )
+        rt.event_store.add_event(inv)
+        await self.instance.bus.publish(
+            self.instance.bus.naming.command_invocations(rt.tenant), inv
+        )
+        return web.json_response(inv.to_dict(), status=201)
+
+    # -- areas / zones ---------------------------------------------------
+    async def list_areas(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.device_management.list_areas(page, size)
+        return web.json_response(_paged(items, total, page, size))
+
+    async def create_area(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        area = Area(
+            token=b.get("token") or Area().token,
+            name=b.get("name", ""),
+            bounds=[tuple(p) for p in b.get("bounds", [])],
+        )
+        rt.device_management.create_area(area)
+        return web.json_response(_entity(area), status=201)
+
+    async def list_zones(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.device_management.list_zones(
+            request.query.get("area", ""), page, size
+        )
+        return web.json_response(_paged(items, total, page, size))
+
+    async def create_zone(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        z = Zone(
+            token=b.get("token") or Zone().token,
+            area_token=b["area_token"],
+            bounds=[tuple(p) for p in b.get("bounds", [])],
+        )
+        rt.device_management.create_zone(z)
+        return web.json_response(_entity(z), status=201)
+
+    # -- assets ----------------------------------------------------------
+    async def list_assets(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.asset_management.list_assets(page, size)
+        return web.json_response(_paged(items, total, page, size))
+
+    async def create_asset_type(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        at = AssetType(
+            token=b.get("token") or AssetType().token,
+            name=b.get("name", ""),
+            asset_category=b.get("asset_category", "device"),
+        )
+        rt.asset_management.create_asset_type(at)
+        return web.json_response(_entity(at), status=201)
+
+    async def create_asset(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        a = Asset(
+            token=b.get("token") or Asset().token,
+            name=b.get("name", ""),
+            asset_type_token=b["asset_type_token"],
+        )
+        rt.asset_management.create_asset(a)
+        return web.json_response(_entity(a), status=201)
+
+    # -- users -----------------------------------------------------------
+    async def list_users(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_ADMIN)
+        return web.json_response(
+            {"results": [u.to_dict() for u in self.instance.users.list_users()]}
+        )
+
+    async def create_user(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_ADMIN)
+        b = await request.json()
+        u = self.instance.users.create_user(
+            b["username"], b["password"], b.get("authorities"),
+            b.get("first_name", ""), b.get("last_name", ""),
+        )
+        return web.json_response(u.to_dict(), status=201)
+
+    # -- tenants ---------------------------------------------------------
+    async def list_tenants(self, request) -> web.Response:
+        return web.json_response(
+            {
+                "results": [
+                    _entity(t) for t in self.instance.tenant_management.list_tenants()
+                ],
+                "templates": self.instance.tenant_management.list_templates(),
+            }
+        )
+
+    async def create_tenant(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_TENANT_ADMIN)
+        b = await request.json()
+        t = await self.instance.tenant_management.create_tenant(
+            b["token"], b.get("name", ""), b.get("template", "default"),
+        )
+        await self.instance.drain_tenant_updates()
+        return web.json_response(_entity(t), status=201)
+
+    async def restart_tenant(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_TENANT_ADMIN)
+        await self.instance.tenant_management.restart_tenant(
+            request.match_info["token"]
+        )
+        return web.json_response({"restarting": request.match_info["token"]})
+
+    async def delete_tenant(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_TENANT_ADMIN)
+        await self.instance.tenant_management.delete_tenant(
+            request.match_info["token"]
+        )
+        return web.json_response({"deleted": request.match_info["token"]})
+
+    # -- schedules / batch ----------------------------------------------
+    async def list_schedules(self, request) -> web.Response:
+        rt = self._tenant(request)
+        return web.json_response(
+            {"results": [s.to_dict() for s in rt.schedules.list_schedules()]}
+        )
+
+    async def create_schedule(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        s = Schedule(
+            name=b.get("name", ""),
+            at_ts=float(b.get("at_ts", 0)),
+            every_s=float(b.get("every_s", 0)),
+            cron=b.get("cron", ""),
+            command_token=b.get("command_token", ""),
+            device_tokens=b.get("device_tokens", []),
+            parameters=b.get("parameters", {}),
+        )
+        rt.schedules.create_schedule(s)
+        return web.json_response(s.to_dict(), status=201)
+
+    async def create_batch(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        op = rt.batch.create_operation(
+            b["command_token"],
+            device_tokens=b.get("device_tokens"),
+            group_token=b.get("group_token", ""),
+            role=b.get("role", ""),
+            parameters=b.get("parameters", {}),
+        )
+        await rt.batch.submit(op.token)
+        return web.json_response(op.summary(), status=201)
+
+    async def get_batch(self, request) -> web.Response:
+        rt = self._tenant(request)
+        op = rt.batch.get_operation(request.match_info["token"])
+        if op is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(op.summary())
+
+    # -- streaming media -------------------------------------------------
+    async def create_stream(self, request) -> web.Response:
+        rt = self._tenant(request)
+        b = await request.json()
+        s = rt.media.create_stream(
+            b.get("assignment_token", ""),
+            b.get("stream_id"),
+            b.get("content_type", "application/octet-stream"),
+        )
+        return web.json_response(
+            {"stream_id": s.stream_id, "content_type": s.content_type}, status=201
+        )
+
+    async def put_chunk(self, request) -> web.Response:
+        rt = self._tenant(request)
+        data = await request.read()
+        rt.media.append_chunk(
+            request.match_info["id"], int(request.match_info["seq"]), data
+        )
+        return web.json_response({"ok": True})
+
+    async def get_chunk(self, request) -> web.Response:
+        rt = self._tenant(request)
+        data = rt.media.get_chunk(
+            request.match_info["id"], int(request.match_info["seq"])
+        )
+        if data is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=data)
+
+
+def make_app(instance: SiteWhereInstance) -> web.Application:
+    return RestApi(instance).app
+
+
+async def serve(instance: SiteWhereInstance, host: str = "127.0.0.1", port: int = 8080):
+    """Run the REST gateway (returns the aiohttp AppRunner)."""
+    runner = web.AppRunner(make_app(instance))
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

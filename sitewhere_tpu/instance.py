@@ -1,0 +1,323 @@
+"""SiteWhereInstance: one-process assembly of the whole platform.
+
+Capability parity with the reference's service-instance-management
+(instance bootstrap from templates: default tenant/users/datasets; instance
+topology/status — SURVEY.md §2.2 [U]; reference mount empty, see provenance
+banner) — plus the process-level redesign SURVEY.md §7 prescribes: instead
+of 18 Spring Boot apps, ONE process hosts every service as lifecycle
+components over the in-proc bus, with the TPU mesh shared by all tenants.
+
+Per tenant, the instance wires the full §3.1 pipeline:
+
+  sim/MQTT broker → EventSource → InboundProcessor → [tpu-inference] →
+  EventPersistence → RuleEngine → OutboundDispatcher
+                                → DeviceStateService
+  + RegistrationService, CommandDelivery, BatchOperationManager,
+    ScheduleManager, LabelGeneration, AssetManagement, StreamingMedia
+
+Tenant lifecycle changes arrive via the tenant-model-updates topic
+(TenantManagement.broadcast) and are applied by the instance's drain loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.parallel.mesh import MeshManager
+from sitewhere_tpu.pipeline.commands import (
+    BrokerCommandDestination,
+    CommandDelivery,
+)
+from sitewhere_tpu.pipeline.inbound import InboundProcessor
+from sitewhere_tpu.pipeline.inference import TpuInferenceService
+from sitewhere_tpu.pipeline.outbound import (
+    LogConnector,
+    MqttTopicConnector,
+    OutboundDispatcher,
+)
+from sitewhere_tpu.pipeline.persist import EventPersistence
+from sitewhere_tpu.pipeline.rules import (
+    RuleEngine,
+    anomaly_score_rule,
+    threshold_rule,
+)
+from sitewhere_tpu.pipeline.sources import EventSource, QueueReceiver
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.config import (
+    InstanceConfig,
+    TenantEngineConfig,
+    tenant_config_from_template,
+)
+from sitewhere_tpu.runtime.lifecycle import (
+    LifecycleComponent,
+    LifecycleState,
+    cancel_and_wait,
+)
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.asset_management import AssetManagement
+from sitewhere_tpu.services.batch_operations import BatchOperationManager
+from sitewhere_tpu.services.device_management import DeviceManagement
+from sitewhere_tpu.services.device_state import DeviceStateService
+from sitewhere_tpu.services.event_store import EventStore
+from sitewhere_tpu.services.label_generation import LabelGeneration
+from sitewhere_tpu.services.registration import RegistrationService
+from sitewhere_tpu.services.schedule_management import ScheduleManager
+from sitewhere_tpu.services.streaming_media import StreamingMedia
+from sitewhere_tpu.services.tenant_management import TenantManagement
+from sitewhere_tpu.services.user_management import (
+    AUTH_ADMIN,
+    UserManagement,
+)
+from sitewhere_tpu.sim.broker import SimBroker
+
+
+@dataclass
+class TenantRuntime:
+    """Everything one tenant owns inside the instance."""
+
+    tenant: str
+    config: TenantEngineConfig
+    device_management: DeviceManagement
+    event_store: EventStore
+    asset_management: AssetManagement
+    labels: LabelGeneration
+    media: StreamingMedia
+    source: EventSource
+    inbound: InboundProcessor
+    persistence: EventPersistence
+    rules: RuleEngine
+    outbound: OutboundDispatcher
+    state: DeviceStateService
+    registration: RegistrationService
+    commands: CommandDelivery
+    batch: BatchOperationManager
+    schedules: ScheduleManager
+
+    def components(self) -> List[LifecycleComponent]:
+        return [
+            self.source, self.inbound, self.persistence, self.rules,
+            self.outbound, self.state, self.registration, self.commands,
+            self.batch, self.schedules,
+        ]
+
+
+class SiteWhereInstance(LifecycleComponent):
+    """The whole platform in one lifecycle tree."""
+
+    def __init__(
+        self,
+        config: Optional[InstanceConfig] = None,
+        mesh: Optional[MeshManager] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        cfg = config or InstanceConfig()
+        super().__init__(f"instance[{cfg.instance_id}]")
+        self.config = cfg
+        self.metrics = metrics or MetricsRegistry()
+        self.bus = EventBus(TopicNaming(cfg.instance_id), cfg.bus_retention)
+        self.broker = SimBroker()  # in-proc MQTT; external broker swaps in
+        self.mesh = mesh or MeshManager(
+            tenant=cfg.mesh.tenant_axis if cfg.mesh.tenant_axis > 1 else 0,
+            data=cfg.mesh.data_axis if cfg.mesh.data_axis > 1 else 0,
+            model=cfg.mesh.model_axis,
+        )
+        self.users = UserManagement()
+        self.tenant_management = TenantManagement(self.bus)
+        self.inference = TpuInferenceService(
+            self.bus, self.mesh, self.metrics,
+            slots_per_shard=cfg.mesh.slots_per_shard,
+        )
+        self.add_child(self.inference)
+        self.tenants: Dict[str, TenantRuntime] = {}
+        self._updates_task: Optional[asyncio.Task] = None
+
+    # -- bootstrap (instance-management parity) --------------------------
+    async def bootstrap(
+        self,
+        default_tenant: str = "default",
+        template: str = "iot-temperature",
+        admin_user: str = "admin",
+        admin_password: str = "password",
+        dataset_devices: int = 0,
+    ) -> None:
+        """Apply the instance template: admin user + default tenant (+
+        optional synthetic device dataset), like the reference's instance
+        bootstrapper [U]."""
+        if self.users.get_user(admin_user) is None:
+            self.users.create_user(admin_user, admin_password, [AUTH_ADMIN])
+        if self.tenant_management.get_tenant(default_tenant) is None:
+            await self.tenant_management.create_tenant(
+                default_tenant, template=template
+            )
+            await self.drain_tenant_updates()
+        if dataset_devices and default_tenant in self.tenants:
+            self.tenants[default_tenant].device_management.bootstrap_fleet(
+                dataset_devices
+            )
+
+    # -- tenant runtime construction -------------------------------------
+    def _build_tenant(self, cfg: TenantEngineConfig) -> TenantRuntime:
+        tenant = cfg.tenant
+        dm = DeviceManagement(tenant)
+        store = EventStore(tenant)
+        receiver = QueueReceiver(f"recv[{tenant}]")
+        source = EventSource(
+            f"mqtt[{tenant}]", tenant, self.bus, receiver, cfg.decoder, self.metrics
+        )
+
+        async def on_broker_msg(topic: str, payload: bytes) -> None:
+            await receiver.submit(payload, topic=topic)
+
+        self.broker.subscribe(f"sitewhere/{tenant}/input/+", on_broker_msg)
+        # default shared-topic pattern for single-tenant setups
+        self.broker.subscribe("sitewhere/input/+", on_broker_msg)
+
+        rules = RuleEngine(tenant, self.bus, [
+            anomaly_score_rule(f"{tenant}-anomaly", min_score=3.0, cooldown_ms=5000),
+        ], self.metrics)
+        outbound = OutboundDispatcher(
+            tenant, self.bus,
+            [
+                LogConnector(f"log[{tenant}]"),
+                MqttTopicConnector(
+                    f"mqtt-out[{tenant}]", self.broker,
+                    topic_pattern=f"sitewhere/{tenant}/output/{{device}}/{{type}}",
+                ),
+            ],
+            self.metrics,
+        )
+        return TenantRuntime(
+            tenant=tenant,
+            config=cfg,
+            device_management=dm,
+            event_store=store,
+            asset_management=AssetManagement(tenant),
+            labels=LabelGeneration(tenant),
+            media=StreamingMedia(tenant),
+            source=source,
+            inbound=InboundProcessor(tenant, self.bus, dm, self.metrics),
+            persistence=EventPersistence(tenant, self.bus, store, self.metrics),
+            rules=rules,
+            outbound=outbound,
+            state=DeviceStateService(tenant, self.bus, self.metrics),
+            registration=RegistrationService(tenant, self.bus, dm, self.metrics),
+            commands=CommandDelivery(
+                tenant, self.bus, dm,
+                BrokerCommandDestination(
+                    self.broker, f"sitewhere/{tenant}/command/{{device}}"
+                ),
+                metrics=self.metrics,
+            ),
+            batch=BatchOperationManager(tenant, self.bus, dm, self.metrics),
+            schedules=ScheduleManager(tenant, self.bus, self.metrics),
+        )
+
+    async def add_tenant(self, cfg: TenantEngineConfig) -> TenantRuntime:
+        if cfg.tenant in self.tenants:
+            raise ValueError(f"tenant '{cfg.tenant}' already running")
+        rt = self._build_tenant(cfg)
+        self.tenants[cfg.tenant] = rt
+        for comp in rt.components():
+            self.add_child(comp)
+            if self.state is LifecycleState.STARTED:
+                await comp.start()
+        await self.inference.add_tenant(cfg)
+        return rt
+
+    async def remove_tenant(self, tenant: str) -> None:
+        rt = self.tenants.pop(tenant, None)
+        if rt is None:
+            return
+        await self.inference.remove_tenant(tenant)
+        for comp in reversed(rt.components()):
+            await comp.terminate()
+            self.remove_child(comp)
+
+    async def restart_tenant(self, tenant: str) -> None:
+        rt = self.tenants.get(tenant)
+        if rt is None:
+            return
+        for comp in rt.components():
+            await comp.restart()
+        await self.inference.restart_tenant(tenant)
+
+    def tenant(self, token: str) -> TenantRuntime:
+        return self.tenants[token]
+
+    # -- tenant-model-updates application --------------------------------
+    async def apply_tenant_update(self, update: dict) -> None:
+        op = update.get("op")
+        token = update.get("tenant", "")
+        if op == "add" and token not in self.tenants:
+            cfg = tenant_config_from_template(
+                token, update.get("template", "default"),
+                **update.get("overrides", {}),
+            )
+            await self.add_tenant(cfg)
+        elif op == "remove":
+            await self.remove_tenant(token)
+        elif op == "restart":
+            await self.restart_tenant(token)
+        elif op == "update" and token in self.tenants:
+            await self.remove_tenant(token)
+            cfg = tenant_config_from_template(
+                token, update.get("template", "default"),
+                **update.get("overrides", {}),
+            )
+            await self.add_tenant(cfg)
+
+    async def drain_tenant_updates(self, timeout_s: float = 0) -> int:
+        topic = self.bus.naming.tenant_model_updates()
+        updates = await self.bus.consume(
+            topic, group="instance", timeout_s=timeout_s
+        )
+        for u in updates:
+            try:
+                await self.apply_tenant_update(u)
+            except Exception as exc:  # noqa: BLE001
+                self._record_error("tenant-update", exc)
+        return len(updates)
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_start(self) -> None:
+        self.bus.subscribe(self.bus.naming.tenant_model_updates(), "instance")
+        self._updates_task = asyncio.create_task(
+            self._updates_loop(), name=f"{self.name}-tenant-updates"
+        )
+
+    async def stop(self) -> None:
+        # quiesce the updates loop FIRST: it mutates the child tree
+        # (add/remove tenant runtimes), so it must not race the cascade
+        await cancel_and_wait(self._updates_task)
+        self._updates_task = None
+        await super().stop()
+
+    async def on_stop(self) -> None:
+        await cancel_and_wait(self._updates_task)
+        self._updates_task = None
+
+    async def _updates_loop(self) -> None:
+        while True:
+            await self.drain_tenant_updates(timeout_s=None)
+
+    # -- introspection ---------------------------------------------------
+    def topology(self) -> dict:
+        """Instance topology/status (reference: instance topology updates [U])."""
+        return {
+            "instance_id": self.config.instance_id,
+            "mesh": self.mesh.describe(),
+            "tenants": {
+                t: {
+                    "template": rt.config.tenant,
+                    "model": rt.config.model,
+                    "components": {
+                        c.name: c.state.value for c in rt.components()
+                    },
+                }
+                for t, rt in self.tenants.items()
+            },
+            "inference": self.inference.describe(),
+            "status": self.status_tree(),
+        }

@@ -21,7 +21,7 @@ from sitewhere_tpu.core.events import DeviceCommandInvocation
 from sitewhere_tpu.core.model import Device, DeviceCommand
 from sitewhere_tpu.pipeline.decoders import MAGIC
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.services.device_management import DeviceManagement
 
@@ -156,13 +156,8 @@ class CommandDelivery(LifecycleComponent):
         self._task = asyncio.create_task(self._run(), name=self.name)
 
     async def on_stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        await cancel_and_wait(self._task)
+        self._task = None
 
     async def _run(self) -> None:
         src = self.bus.naming.command_invocations(self.tenant)

@@ -24,7 +24,7 @@ from sitewhere_tpu.core.events import (
     now_ms,
 )
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.services.device_management import DeviceManagement
 
@@ -57,13 +57,8 @@ class InboundProcessor(LifecycleComponent):
         self._task = asyncio.create_task(self._run(), name=self.name)
 
     async def on_stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        await cancel_and_wait(self._task)
+        self._task = None
 
     async def _run(self) -> None:
         src = self.bus.naming.decoded_events(self.tenant)

@@ -41,7 +41,7 @@ from sitewhere_tpu.parallel.sharded import ShardedScorer
 from sitewhere_tpu.parallel.tenant_router import TenantRouter
 from sitewhere_tpu.runtime.bus import EventBus
 from sitewhere_tpu.runtime.config import TenantEngineConfig
-from sitewhere_tpu.runtime.lifecycle import LifecycleState
+from sitewhere_tpu.runtime.lifecycle import LifecycleState, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
@@ -169,13 +169,8 @@ class TpuInferenceService(MultitenantService):
         )
 
     async def on_stop(self) -> None:
-        if self._loop_task is not None:
-            self._loop_task.cancel()
-            try:
-                await self._loop_task
-            except asyncio.CancelledError:
-                pass
-            self._loop_task = None
+        await cancel_and_wait(self._loop_task)
+        self._loop_task = None
 
     # -- ingestion → lanes ----------------------------------------------
     def _enqueue(self, engine: TpuInferenceEngine, events: List) -> List:

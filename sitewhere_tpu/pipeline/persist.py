@@ -12,7 +12,7 @@ import asyncio
 from typing import Optional
 
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.services.event_store import EventStore
 
@@ -45,13 +45,8 @@ class EventPersistence(LifecycleComponent):
         self._task = asyncio.create_task(self._run(), name=self.name)
 
     async def on_stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        await cancel_and_wait(self._task)
+        self._task = None
 
     async def _run(self) -> None:
         src = self.bus.naming.scored_events(self.tenant)

@@ -46,7 +46,7 @@ from sitewhere_tpu.core.events import (
     EventType,
 )
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 
 Predicate = Callable[[DeviceEvent], bool]
@@ -426,13 +426,8 @@ class RuleEngine(LifecycleComponent):
         self._task = asyncio.create_task(self._run(), name=self.name)
 
     async def on_stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        await cancel_and_wait(self._task)
+        self._task = None
 
     async def _run(self) -> None:
         src = self.bus.naming.persisted_events(self.tenant)

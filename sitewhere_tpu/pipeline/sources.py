@@ -27,7 +27,7 @@ from sitewhere_tpu.pipeline.decoders import (
     get_decoder,
 )
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 
 
@@ -120,13 +120,8 @@ class EventSource(LifecycleComponent):
         )
 
     async def on_stop(self) -> None:
-        if self._pump is not None:
-            self._pump.cancel()
-            try:
-                await self._pump
-            except asyncio.CancelledError:
-                pass
-            self._pump = None
+        await cancel_and_wait(self._pump)
+        self._pump = None
 
     async def _run(self) -> None:
         decoded_topic = self.bus.naming.decoded_events(self.tenant)

@@ -23,6 +23,7 @@ class MeshConfig:
     tenant_axis: int = 1      # shards along the tenant axis
     data_axis: int = 1        # data-parallel shards per tenant shard
     model_axis: int = 1       # tensor-parallel shards (large models)
+    slots_per_shard: int = 8  # stacked tenant slots per tenant shard
     dtype: str = "bfloat16"
 
 

@@ -60,6 +60,33 @@ class LifecycleException(RuntimeError):
     pass
 
 
+async def cancel_and_wait(task: Optional["asyncio.Task"]) -> None:
+    """Cancel ``task`` and await it, WITHOUT swallowing a concurrent
+    cancellation of the *current* task.
+
+    The naive ``task.cancel(); try: await task; except CancelledError:
+    pass`` deadlocks the component tree: if the awaiting task is itself
+    cancelled while inside ``await task``, the CancelledError it must
+    re-raise is indistinguishable from the child's and gets swallowed —
+    the outer cancel is lost and the task blocks forever on its next
+    await (observed: instance.terminate() racing the tenant-updates
+    loop). ``Task.cancelling()`` disambiguates.
+    """
+    if task is None or task.done():
+        return
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        cur = asyncio.current_task()
+        # Task.cancelling() is 3.11+; older interpreters fall back to the
+        # pre-fix behavior (swallow) rather than crashing shutdown
+        if cur is not None and getattr(cur, "cancelling", lambda: 0)():
+            raise  # the cancel was meant for US — propagate
+    except Exception:  # noqa: BLE001 - the task died before our cancel
+        logger.exception("task %r crashed before stop", task.get_name())
+
+
 class LifecycleComponent:
     """A named node in the component tree with lifecycle state."""
 
@@ -134,6 +161,8 @@ class LifecycleComponent:
                 if c.state is LifecycleState.INITIALIZATION_ERROR:
                     raise LifecycleException(f"child '{c.name}' failed to initialize")
             self._set_state(LifecycleState.INITIALIZED)
+        except asyncio.CancelledError:
+            raise  # cancellation must propagate, never park as an error
         except BaseException as exc:  # noqa: BLE001 - park in error state
             self._record_error("initialize", exc)
             self._set_state(LifecycleState.INITIALIZATION_ERROR)
@@ -157,6 +186,8 @@ class LifecycleComponent:
                 if c.state is LifecycleState.START_ERROR:
                     raise LifecycleException(f"child '{c.name}' failed to start")
             self._set_state(LifecycleState.STARTED)
+        except asyncio.CancelledError:
+            raise
         except BaseException as exc:  # noqa: BLE001
             self._record_error("start", exc)
             self._set_state(LifecycleState.START_ERROR)
@@ -175,6 +206,8 @@ class LifecycleComponent:
                 await c.stop()
             await self.on_stop()
             self._set_state(LifecycleState.STOPPED)
+        except asyncio.CancelledError:
+            raise
         except BaseException as exc:  # noqa: BLE001
             self._record_error("stop", exc)
             self._set_state(LifecycleState.STOP_ERROR)
@@ -277,10 +310,5 @@ class SupervisedTask(LifecycleComponent):
 
     async def on_stop(self) -> None:
         for t in (self._task, self._supervisor):
-            if t and not t.done():
-                t.cancel()
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                    pass
+            await cancel_and_wait(t)
         self._task = self._supervisor = None

@@ -1,0 +1,75 @@
+"""Asset management: assets + asset types referenced by assignments.
+
+Capability parity with the reference's service-asset-management
+(``IAssetManagement`` per tenant: asset types (person/device/hardware/
+location) and assets — SURVEY.md §2.2 [U]; reference mount empty, see
+provenance banner).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from sitewhere_tpu.core.model import Asset, AssetType
+from sitewhere_tpu.services.device_management import _Collection
+
+
+class AssetManagement:
+    """Per-tenant asset store (the IAssetManagement SPI surface)."""
+
+    def __init__(self, tenant: str = "default") -> None:
+        self.tenant = tenant
+        self.asset_types = _Collection()
+        self.assets = _Collection()
+
+    # -- asset types -----------------------------------------------------
+    def create_asset_type(self, at: AssetType) -> AssetType:
+        return self.asset_types.add(at)
+
+    def get_asset_type(self, token: str) -> Optional[AssetType]:
+        return self.asset_types.get(token)
+
+    def update_asset_type(self, token: str, **fields) -> AssetType:
+        at = self.asset_types.require(token)
+        for k, v in fields.items():
+            setattr(at, k, v)
+        at.touch()
+        return at
+
+    def delete_asset_type(self, token: str) -> None:
+        in_use, _ = self.assets.page(
+            pred=lambda a: a.asset_type_token == token, page_size=1
+        )
+        if in_use:
+            raise ValueError(f"asset type '{token}' still in use")
+        self.asset_types.delete(token)
+
+    def list_asset_types(self, page: int = 1, page_size: int = 100):
+        return self.asset_types.page(page, page_size)
+
+    # -- assets ----------------------------------------------------------
+    def create_asset(self, asset: Asset) -> Asset:
+        if self.asset_types.get(asset.asset_type_token) is None:
+            raise KeyError(f"asset type '{asset.asset_type_token}' not found")
+        return self.assets.add(asset)
+
+    def get_asset(self, token: str) -> Optional[Asset]:
+        return self.assets.get(token)
+
+    def update_asset(self, token: str, **fields) -> Asset:
+        a = self.assets.require(token)
+        for k, v in fields.items():
+            setattr(a, k, v)
+        a.touch()
+        return a
+
+    def delete_asset(self, token: str) -> None:
+        self.assets.delete(token)
+
+    def list_assets(
+        self, page: int = 1, page_size: int = 100, asset_type: str = ""
+    ) -> Tuple[List[Asset], int]:
+        pred = (
+            (lambda a: a.asset_type_token == asset_type) if asset_type else None
+        )
+        return self.assets.page(page, page_size, pred)

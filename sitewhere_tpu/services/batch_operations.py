@@ -1,0 +1,193 @@
+"""Batch operations: bulk command invocation with throttling + rollup.
+
+Capability parity with the reference's service-batch-operations (batch
+operation manager: create op + elements over a device list, element-wise
+processing with throttling, per-element status, op summary rollup —
+SURVEY.md §2.2/§3.5 [U]; reference mount empty, see provenance banner).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.core.events import DeviceCommandInvocation
+from sitewhere_tpu.core.model import new_token
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.device_management import DeviceManagement
+
+
+class BatchOpStatus(str, enum.Enum):
+    PENDING = "pending"
+    PROCESSING = "processing"
+    DONE = "done"
+    DONE_WITH_ERRORS = "done_with_errors"
+    CANCELED = "canceled"
+
+
+class ElementStatus(str, enum.Enum):
+    PENDING = "pending"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclass
+class BatchElement:
+    device_token: str
+    status: ElementStatus = ElementStatus.PENDING
+    error: str = ""
+    processed_ts: int = 0
+    invocation_id: str = ""
+
+
+@dataclass
+class BatchOperation:
+    token: str = field(default_factory=lambda: new_token("batch"))
+    command_token: str = ""
+    parameters: Dict[str, str] = field(default_factory=dict)
+    status: BatchOpStatus = BatchOpStatus.PENDING
+    elements: List[BatchElement] = field(default_factory=list)
+    created_ts: int = field(default_factory=lambda: int(time.time() * 1000))
+    finished_ts: int = 0
+
+    def summary(self) -> dict:
+        counts: Dict[str, int] = {}
+        for el in self.elements:
+            counts[el.status.value] = counts.get(el.status.value, 0) + 1
+        return {
+            "token": self.token,
+            "status": self.status.value,
+            "command_token": self.command_token,
+            "total": len(self.elements),
+            "counts": counts,
+        }
+
+
+class BatchOperationManager(LifecycleComponent):
+    """Per-tenant batch command execution (throttled element loop)."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        device_management: DeviceManagement,
+        metrics: Optional[MetricsRegistry] = None,
+        throttle_s: float = 0.0,
+        concurrency: int = 8,
+    ) -> None:
+        super().__init__(f"batch-operations[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.dm = device_management
+        self.metrics = metrics or MetricsRegistry()
+        self.throttle_s = throttle_s
+        self.concurrency = concurrency
+        self.operations: Dict[str, BatchOperation] = {}
+        self._workers: List[asyncio.Task] = []
+        self._queue: asyncio.Queue = asyncio.Queue()
+
+    # -- API -------------------------------------------------------------
+    def create_operation(
+        self,
+        command_token: str,
+        device_tokens: Optional[List[str]] = None,
+        group_token: str = "",
+        role: str = "",
+        parameters: Optional[Dict[str, str]] = None,
+    ) -> BatchOperation:
+        """Create a batch op over an explicit device list or a device group
+        (reference: batch ops target groups with role filters [U])."""
+        if group_token:
+            device_tokens = self.dm.group_device_tokens(group_token, role)
+        if not device_tokens:
+            raise ValueError("batch operation needs devices")
+        op = BatchOperation(
+            command_token=command_token,
+            parameters=dict(parameters or {}),
+            elements=[BatchElement(device_token=t) for t in device_tokens],
+        )
+        self.operations[op.token] = op
+        return op
+
+    def get_operation(self, token: str) -> Optional[BatchOperation]:
+        return self.operations.get(token)
+
+    async def submit(self, token: str) -> None:
+        op = self.operations[token]
+        op.status = BatchOpStatus.PROCESSING
+        await self._queue.put(token)
+
+    def cancel(self, token: str) -> None:
+        op = self.operations.get(token)
+        if op is not None and op.status in (
+            BatchOpStatus.PENDING, BatchOpStatus.PROCESSING
+        ):
+            op.status = BatchOpStatus.CANCELED
+
+    async def execute(self, op: BatchOperation) -> None:
+        """Element loop: emit one command invocation per device, throttled."""
+        processed = self.metrics.counter("batch_ops.elements_processed")
+        for el in op.elements:
+            if op.status is BatchOpStatus.CANCELED:
+                break
+            device = self.dm.get_device(el.device_token)
+            if device is None:
+                el.status = ElementStatus.FAILED
+                el.error = "unknown device"
+            else:
+                inv = DeviceCommandInvocation(
+                    device_token=el.device_token,
+                    tenant=self.tenant,
+                    command_token=op.command_token,
+                    initiator="batch",
+                    initiator_id=op.token,
+                    parameters=dict(op.parameters),
+                )
+                assignment = self.dm.active_assignment_for(el.device_token)
+                if assignment is not None:
+                    inv.assignment_token = assignment.token
+                await self.bus.publish(
+                    self.bus.naming.command_invocations(self.tenant), inv
+                )
+                el.status = ElementStatus.SUCCEEDED
+                el.invocation_id = inv.id
+            el.processed_ts = int(time.time() * 1000)
+            processed.inc()
+            if self.throttle_s:
+                await asyncio.sleep(self.throttle_s)
+        if op.status is not BatchOpStatus.CANCELED:
+            failed = any(el.status is ElementStatus.FAILED for el in op.elements)
+            op.status = (
+                BatchOpStatus.DONE_WITH_ERRORS if failed else BatchOpStatus.DONE
+            )
+        op.finished_ts = int(time.time() * 1000)
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_start(self) -> None:
+        self._workers = [
+            asyncio.create_task(self._worker(), name=f"{self.name}-w{i}")
+            for i in range(self.concurrency)
+        ]
+
+    async def on_stop(self) -> None:
+        for w in self._workers:
+            w.cancel()
+        for w in self._workers:
+            await cancel_and_wait(w)
+        self._workers = []
+
+    async def _worker(self) -> None:
+        while True:
+            token = await self._queue.get()
+            op = self.operations.get(token)
+            if op is not None and op.status is BatchOpStatus.PROCESSING:
+                try:
+                    await self.execute(op)
+                except Exception as exc:  # noqa: BLE001
+                    self._record_error("execute", exc)
+                    op.status = BatchOpStatus.DONE_WITH_ERRORS

@@ -1,0 +1,171 @@
+"""Device state: last-known state per assignment + presence detection.
+
+Capability parity with the reference's service-device-state (state store of
+latest measurements/location/alerts per assignment; presence manager marking
+devices non-present after a threshold — SURVEY.md §2.2 [U]; reference mount
+empty, see provenance banner).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.core.events import (
+    DeviceAlert,
+    DeviceEvent,
+    DeviceLocation,
+    DeviceMeasurement,
+    DeviceStateChange,
+    now_ms,
+)
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+
+@dataclass
+class DeviceState:
+    """Rolled-up last-known state for one device/assignment."""
+
+    device_token: str
+    assignment_token: str = ""
+    last_interaction_ts: int = 0
+    present: bool = True
+    presence_missing_ts: Optional[int] = None
+    # measurement name → (value, score, event_ts)
+    latest_measurements: Dict[str, tuple] = field(default_factory=dict)
+    latest_location: Optional[tuple] = None     # (lat, lon, elev, ts)
+    latest_alerts: List[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "device_token": self.device_token,
+            "assignment_token": self.assignment_token,
+            "last_interaction_ts": self.last_interaction_ts,
+            "present": self.present,
+            "latest_measurements": {
+                k: {"value": v[0], "score": v[1], "event_ts": v[2]}
+                for k, v in self.latest_measurements.items()
+            },
+            "latest_location": (
+                dict(zip(("latitude", "longitude", "elevation", "event_ts"),
+                         self.latest_location))
+                if self.latest_location
+                else None
+            ),
+            "latest_alerts": list(self.latest_alerts[-5:]),
+        }
+
+
+class DeviceStateService(LifecycleComponent):
+    """Per-tenant state rollup + presence manager over the scored stream."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        metrics: Optional[MetricsRegistry] = None,
+        presence_timeout_ms: int = 60_000,
+        presence_check_interval_s: float = 5.0,
+        poll_batch: int = 4096,
+    ) -> None:
+        super().__init__(f"device-state[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.metrics = metrics or MetricsRegistry()
+        self.presence_timeout_ms = presence_timeout_ms
+        self.presence_check_interval_s = presence_check_interval_s
+        self.poll_batch = poll_batch
+        self.states: Dict[str, DeviceState] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._presence_task: Optional[asyncio.Task] = None
+
+    @property
+    def group(self) -> str:
+        return f"device-state[{self.tenant}]"
+
+    # -- event application ----------------------------------------------
+    def apply_event(self, e: DeviceEvent) -> None:
+        st = self.states.get(e.device_token)
+        if st is None:
+            st = self.states[e.device_token] = DeviceState(e.device_token)
+        st.assignment_token = e.assignment_token or st.assignment_token
+        st.last_interaction_ts = max(st.last_interaction_ts, e.received_ts)
+        if not st.present:
+            # device came back: flip presence + emit a state change
+            st.present = True
+            st.presence_missing_ts = None
+            self.metrics.counter("device_state.returned").inc()
+        if isinstance(e, DeviceMeasurement):
+            st.latest_measurements[e.name] = (e.value, e.score, e.event_ts)
+        elif isinstance(e, DeviceLocation):
+            st.latest_location = (e.latitude, e.longitude, e.elevation, e.event_ts)
+        elif isinstance(e, DeviceAlert):
+            st.latest_alerts.append(
+                {"alert_type": e.alert_type, "level": e.level.value,
+                 "message": e.message, "event_ts": e.event_ts}
+            )
+            if len(st.latest_alerts) > 32:
+                del st.latest_alerts[:16]
+
+    def get_state(self, device_token: str) -> Optional[DeviceState]:
+        return self.states.get(device_token)
+
+    def non_present(self) -> List[str]:
+        return sorted(t for t, s in self.states.items() if not s.present)
+
+    # -- presence sweep --------------------------------------------------
+    async def check_presence(self) -> List[DeviceStateChange]:
+        """Mark devices non-present past the timeout; emit state changes
+        into the pipeline (reference parity: presence manager [U])."""
+        cutoff = now_ms() - self.presence_timeout_ms
+        changes: List[DeviceStateChange] = []
+        for st in self.states.values():
+            if st.present and st.last_interaction_ts < cutoff:
+                st.present = False
+                st.presence_missing_ts = now_ms()
+                self.metrics.counter("device_state.went_missing").inc()
+                changes.append(
+                    DeviceStateChange(
+                        device_token=st.device_token,
+                        assignment_token=st.assignment_token,
+                        tenant=self.tenant,
+                        attribute="presence",
+                        state_type="presence",
+                        previous_state="present",
+                        new_state="missing",
+                    )
+                )
+        for c in changes:
+            await self.bus.publish(self.bus.naming.scored_events(self.tenant), c)
+        return changes
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_start(self) -> None:
+        self.bus.subscribe(
+            self.bus.naming.persisted_events(self.tenant), self.group
+        )
+        self._task = asyncio.create_task(self._run(), name=self.name)
+        self._presence_task = asyncio.create_task(
+            self._presence_loop(), name=f"{self.name}-presence"
+        )
+
+    async def on_stop(self) -> None:
+        for t in (self._task, self._presence_task):
+            await cancel_and_wait(t)
+        self._task = self._presence_task = None
+
+    async def _run(self) -> None:
+        src = self.bus.naming.persisted_events(self.tenant)
+        while True:
+            events = await self.bus.consume(src, self.group, self.poll_batch)
+            for e in events:
+                self.apply_event(e)
+
+    async def _presence_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.presence_check_interval_s)
+            await self.check_presence()

@@ -1,0 +1,223 @@
+"""Label generation: QR label images for devices/assets.
+
+Capability parity with the reference's service-label-generation (label
+manager rendering QR codes — ZXing upstream — for device/asset tokens,
+served over REST — SURVEY.md §2.2 [U]; reference mount empty, see
+provenance banner).
+
+Redesign: a self-contained QR encoder (byte mode, ECC level L, versions
+1–5, mask 0) — no ZXing/qrcode dependency. Produces the module matrix
+directly; PIL (in-image) rasterizes PNGs. Reed–Solomon over GF(256) with
+the standard 0x11D polynomial; format info BCH-encoded programmatically
+rather than from a lookup table.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Tuple
+
+# (total codewords, ec codewords) per version for ECC level L, single block
+_VERSIONS = {1: (26, 7), 2: (44, 10), 3: (70, 15), 4: (100, 20), 5: (134, 26)}
+
+# -- GF(256) tables --------------------------------------------------------
+_EXP = [0] * 512
+_LOG = [0] * 256
+_x = 1
+for _i in range(255):
+    _EXP[_i] = _x
+    _LOG[_x] = _i
+    _x <<= 1
+    if _x & 0x100:
+        _x ^= 0x11D
+for _i in range(255, 512):
+    _EXP[_i] = _EXP[_i - 255]
+
+
+def _gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return _EXP[_LOG[a] + _LOG[b]]
+
+
+def _rs_generator(n: int) -> List[int]:
+    g = [1]
+    for i in range(n):
+        g2 = [0] * (len(g) + 1)
+        for j, c in enumerate(g):
+            g2[j] ^= _gf_mul(c, _EXP[i])
+            g2[j + 1] ^= c
+        g = g2
+    return g
+
+
+def _rs_encode(data: List[int], n_ec: int) -> List[int]:
+    gen = _rs_generator(n_ec)
+    rem = [0] * n_ec
+    for d in data:
+        factor = d ^ rem[0]
+        rem = rem[1:] + [0]
+        for i, g in enumerate(gen[1:]):
+            rem[i] ^= _gf_mul(factor, g)
+    return rem
+
+
+def _bch_format(ec_level_bits: int, mask: int) -> int:
+    """15-bit format info: 5 data bits + 10 BCH bits, XOR 0x5412."""
+    data = (ec_level_bits << 3) | mask
+    d = data << 10
+    g = 0b10100110111
+    for i in range(14, 9, -1):
+        if d & (1 << i):
+            d ^= g << (i - 10)
+    return ((data << 10) | d) ^ 0x5412
+
+
+def encode_qr(payload: bytes, mask: int = 0) -> List[List[bool]]:
+    """Encode bytes → QR module matrix (True = dark). ECC-L, versions 1–5."""
+    version = next(
+        (v for v, (tot, ec) in _VERSIONS.items() if len(payload) <= tot - ec - 2),
+        None,
+    )
+    if version is None:
+        raise ValueError(f"payload too long for v5-L QR ({len(payload)} bytes)")
+    total_cw, n_ec = _VERSIONS[version]
+    n_data = total_cw - n_ec
+    size = 17 + 4 * version
+
+    # -- bitstream: mode 0100, count(8), data, terminator, pads ----------
+    bits: List[int] = []
+
+    def put(val: int, n: int) -> None:
+        for i in range(n - 1, -1, -1):
+            bits.append((val >> i) & 1)
+
+    put(0b0100, 4)
+    put(len(payload), 8)
+    for b in payload:
+        put(b, 8)
+    put(0, min(4, n_data * 8 - len(bits)))          # terminator
+    while len(bits) % 8:
+        bits.append(0)
+    data_cw = [
+        int("".join(map(str, bits[i : i + 8])), 2) for i in range(0, len(bits), 8)
+    ]
+    pad = (0xEC, 0x11)
+    i = 0
+    while len(data_cw) < n_data:
+        data_cw.append(pad[i % 2])
+        i += 1
+    codewords = data_cw + _rs_encode(data_cw, n_ec)
+
+    # -- matrix skeleton -------------------------------------------------
+    M: List[List[Optional[bool]]] = [[None] * size for _ in range(size)]
+
+    def set_finder(r0: int, c0: int) -> None:
+        for r in range(-1, 8):
+            for c in range(-1, 8):
+                rr, cc = r0 + r, c0 + c
+                if 0 <= rr < size and 0 <= cc < size:
+                    inside = 0 <= r <= 6 and 0 <= c <= 6
+                    ring = r in (0, 6) or c in (0, 6)
+                    core = 2 <= r <= 4 and 2 <= c <= 4
+                    M[rr][cc] = bool(inside and (ring or core))
+
+    set_finder(0, 0)
+    set_finder(0, size - 7)
+    set_finder(size - 7, 0)
+    # timing patterns
+    for i in range(8, size - 8):
+        M[6][i] = i % 2 == 0
+        M[i][6] = i % 2 == 0
+    # alignment pattern (single for v2–5)
+    if version >= 2:
+        p = 4 * version + 10  # 18, 22, 26, 30
+        for r in range(-2, 3):
+            for c in range(-2, 3):
+                M[p + r][p + c] = max(abs(r), abs(c)) != 1
+    # dark module + reserve format areas
+    M[size - 8][8] = True
+    fmt_positions: List[Tuple[int, int]] = []
+    for i in range(9):
+        if i != 6:
+            fmt_positions.append((8, i))
+            fmt_positions.append((i, 8))
+    for i in range(8):
+        fmt_positions.append((8, size - 1 - i))
+        fmt_positions.append((size - 1 - i, 8))
+    for r, c in fmt_positions:
+        if M[r][c] is None:
+            M[r][c] = False
+
+    # -- place codeword bits (zigzag, skip col 6), apply mask ------------
+    all_bits = [int(b) for cw in codewords for b in format(cw, "08b")]
+    bit_i = 0
+    col = size - 1
+    upward = True
+    while col > 0:
+        if col == 6:
+            col -= 1
+        rows = range(size - 1, -1, -1) if upward else range(size)
+        for r in rows:
+            for c in (col, col - 1):
+                if M[r][c] is None:
+                    bit = all_bits[bit_i] if bit_i < len(all_bits) else 0
+                    bit_i += 1
+                    if mask == 0:
+                        flip = (r + c) % 2 == 0
+                    elif mask == 1:
+                        flip = r % 2 == 0
+                    elif mask == 2:
+                        flip = c % 3 == 0
+                    else:
+                        flip = (r + c) % 3 == 0
+                    M[r][c] = bool(bit ^ int(flip))
+        upward = not upward
+        col -= 2
+
+    # -- format info (ECC-L = 01) ---------------------------------------
+    fmt = _bch_format(0b01, mask)
+    fmt_bits = [(fmt >> (14 - i)) & 1 for i in range(15)]
+    # copy 1: around top-left finder
+    coords1 = [(8, 0), (8, 1), (8, 2), (8, 3), (8, 4), (8, 5), (8, 7), (8, 8),
+               (7, 8), (5, 8), (4, 8), (3, 8), (2, 8), (1, 8), (0, 8)]
+    # copy 2: split between bottom-left and top-right
+    coords2 = [(size - 1, 8), (size - 2, 8), (size - 3, 8), (size - 4, 8),
+               (size - 5, 8), (size - 6, 8), (size - 7, 8),
+               (8, size - 8), (8, size - 7), (8, size - 6), (8, size - 5),
+               (8, size - 4), (8, size - 3), (8, size - 2), (8, size - 1)]
+    for (r, c), b in zip(coords1, fmt_bits):
+        M[r][c] = bool(b)
+    for (r, c), b in zip(coords2, fmt_bits):
+        M[r][c] = bool(b)
+
+    return [[bool(v) for v in row] for row in M]
+
+
+class LabelGeneration:
+    """Per-tenant label manager: QR PNGs for entity tokens."""
+
+    def __init__(self, tenant: str = "default", base_url: str = "sitewhere://") -> None:
+        self.tenant = tenant
+        self.base_url = base_url
+
+    def qr_matrix(self, kind: str, token: str) -> List[List[bool]]:
+        return encode_qr(f"{self.base_url}{kind}/{token}".encode())
+
+    def qr_png(self, kind: str, token: str, scale: int = 8, border: int = 4) -> bytes:
+        """Render a QR label PNG for e.g. ('device', 'dev-00042')."""
+        from PIL import Image
+
+        m = self.qr_matrix(kind, token)
+        n = len(m)
+        img = Image.new("1", ((n + 2 * border) * scale,) * 2, 1)
+        px = img.load()
+        for r, row in enumerate(m):
+            for c, dark in enumerate(row):
+                if dark:
+                    for dr in range(scale):
+                        for dc in range(scale):
+                            px[(c + border) * scale + dc, (r + border) * scale + dr] = 0
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return buf.getvalue()

@@ -1,0 +1,119 @@
+"""Device registration: auto-registration of unknown devices.
+
+Capability parity with the reference's service-device-registration
+(registration manager per tenant: consume the unregistered-device topic,
+create device + assignment with a default device type, ack back to the
+device — SURVEY.md §2.2 [U]; reference mount empty, see provenance banner).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from sitewhere_tpu.core.model import Device, DeviceAssignment, DeviceType, new_token
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.device_management import DeviceManagement
+
+
+class RegistrationService(LifecycleComponent):
+    """Per-tenant auto-registration off the unregistered-devices topic."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        device_management: DeviceManagement,
+        metrics: Optional[MetricsRegistry] = None,
+        allow_auto_registration: bool = True,
+        default_device_type: str = "",   # token; "" = create/find a default
+        poll_batch: int = 1024,
+    ) -> None:
+        super().__init__(f"device-registration[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.dm = device_management
+        self.metrics = metrics or MetricsRegistry()
+        self.allow_auto_registration = allow_auto_registration
+        self.default_device_type = default_device_type
+        self.poll_batch = poll_batch
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def group(self) -> str:
+        return f"device-registration[{self.tenant}]"
+
+    def _default_type_token(self) -> str:
+        if self.default_device_type:
+            return self.default_device_type
+        existing = self.dm.get_device_type("dt-auto")
+        if existing is None:
+            self.dm.create_device_type(
+                DeviceType(token="dt-auto", name="auto-registered")
+            )
+        return "dt-auto"
+
+    async def process_request(self, req: Dict) -> Optional[Device]:
+        """Handle one unregistered-device message. Explicit 'register'
+        requests carry device_type/area; implicit ones (unknown device
+        sent telemetry) use defaults if auto-registration is on."""
+        registered = self.metrics.counter("registration.registered")
+        denied = self.metrics.counter("registration.denied")
+        token = req.get("device_token", "")
+        if not token:
+            denied.inc()
+            return None
+        if self.dm.get_device(token) is not None:
+            return self.dm.get_device(token)  # raced: already registered
+        explicit = req.get("type") == "register"
+        if not explicit and not self.allow_auto_registration:
+            denied.inc()
+            return None
+        type_token = req.get("device_type_token") or self._default_type_token()
+        if self.dm.get_device_type(type_token) is None:
+            # unknown requested type → fall back to default
+            type_token = self._default_type_token()
+        device = Device(
+            token=token,
+            name=req.get("name", token),
+            device_type_token=type_token,
+            metadata={"registration": "auto" if not explicit else "explicit"},
+        )
+        self.dm.create_device(device)
+        self.dm.create_assignment(
+            DeviceAssignment(
+                token=new_token("asn"),
+                device_token=token,
+                area_token=req.get("area_token", ""),
+            )
+        )
+        registered.inc()
+        # ack back toward the device (command-invocations path carries it
+        # to the destination the tenant wired up)
+        await self.bus.publish(
+            self.bus.naming.tenant_topic(self.tenant, "registration-acks"),
+            {"device_token": token, "status": "registered"},
+        )
+        return device
+
+    async def on_start(self) -> None:
+        self.bus.subscribe(
+            self.bus.naming.unregistered_devices(self.tenant), self.group
+        )
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        await cancel_and_wait(self._task)
+        self._task = None
+
+    async def _run(self) -> None:
+        src = self.bus.naming.unregistered_devices(self.tenant)
+        while True:
+            requests = await self.bus.consume(src, self.group, self.poll_batch)
+            for req in requests:
+                try:
+                    await self.process_request(req)
+                except Exception as exc:  # noqa: BLE001 - bad request must not kill loop
+                    self._record_error("register", exc)

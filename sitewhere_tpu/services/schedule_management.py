@@ -1,0 +1,204 @@
+"""Schedule management: scheduled/recurring command invocations.
+
+Capability parity with the reference's service-schedule-management
+(Quartz-backed schedules: simple + cron triggers firing command invocations
+— SURVEY.md §2.2 [U]; reference mount empty, see provenance banner).
+
+Redesign: an asyncio scheduler (no Quartz): ``Schedule`` supports one-shot
+(``at``), fixed-interval (``every_s`` with optional end), and a minimal
+5-field cron (minute hour dom month dow, ``*``, ``*/n``, lists, ranges).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.core.events import DeviceCommandInvocation
+from sitewhere_tpu.core.model import new_token
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> Optional[set]:
+    """One cron field → allowed set (None = any)."""
+    if spec == "*":
+        return None
+    out: set = set()
+    for part in spec.split(","):
+        if part.startswith("*/"):
+            step = int(part[2:])
+            out.update(range(lo, hi + 1, step))
+        elif "-" in part:
+            a, b = part.split("-")
+            out.update(range(int(a), int(b) + 1))
+        else:
+            out.add(int(part))
+    return out
+
+
+@dataclass
+class CronSpec:
+    minute: Optional[set]
+    hour: Optional[set]
+    dom: Optional[set]
+    month: Optional[set]
+    dow: Optional[set]
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSpec":
+        parts = expr.split()
+        if len(parts) != 5:
+            raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        return cls(
+            minute=_parse_field(parts[0], 0, 59),
+            hour=_parse_field(parts[1], 0, 23),
+            dom=_parse_field(parts[2], 1, 31),
+            month=_parse_field(parts[3], 1, 12),
+            dow=_parse_field(parts[4], 0, 6),
+        )
+
+    def matches(self, dt: datetime) -> bool:
+        # cron convention: dow 0 = Sunday; datetime.weekday(): 0 = Monday
+        cron_dow = (dt.weekday() + 1) % 7
+        return (
+            (self.minute is None or dt.minute in self.minute)
+            and (self.hour is None or dt.hour in self.hour)
+            and (self.dom is None or dt.day in self.dom)
+            and (self.month is None or dt.month in self.month)
+            and (self.dow is None or cron_dow in self.dow)
+        )
+
+
+@dataclass
+class Schedule:
+    token: str = field(default_factory=lambda: new_token("sched"))
+    name: str = ""
+    # exactly one of:
+    at_ts: float = 0.0          # one-shot epoch seconds
+    every_s: float = 0.0        # fixed interval
+    cron: str = ""              # 5-field cron
+    end_ts: float = 0.0         # stop firing after (0 = never)
+    # what to fire:
+    command_token: str = ""
+    device_tokens: List[str] = field(default_factory=list)
+    parameters: Dict[str, str] = field(default_factory=dict)
+    enabled: bool = True
+    fire_count: int = 0
+    last_fired: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "token": self.token, "name": self.name, "at_ts": self.at_ts,
+            "every_s": self.every_s, "cron": self.cron, "end_ts": self.end_ts,
+            "command_token": self.command_token,
+            "device_tokens": list(self.device_tokens),
+            "enabled": self.enabled, "fire_count": self.fire_count,
+        }
+
+
+class ScheduleManager(LifecycleComponent):
+    """Per-tenant scheduler firing command invocations onto the bus."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        metrics: Optional[MetricsRegistry] = None,
+        tick_s: float = 1.0,
+    ) -> None:
+        super().__init__(f"schedule-management[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.metrics = metrics or MetricsRegistry()
+        self.tick_s = tick_s
+        self.schedules: Dict[str, Schedule] = {}
+        self._crons: Dict[str, CronSpec] = {}
+        self._last_cron_minute: Dict[str, int] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    # -- CRUD ------------------------------------------------------------
+    def create_schedule(self, s: Schedule) -> Schedule:
+        if s.cron:
+            self._crons[s.token] = CronSpec.parse(s.cron)  # validate early
+        self.schedules[s.token] = s
+        return s
+
+    def delete_schedule(self, token: str) -> None:
+        self.schedules.pop(token, None)
+        self._crons.pop(token, None)
+
+    def get_schedule(self, token: str) -> Optional[Schedule]:
+        return self.schedules.get(token)
+
+    def list_schedules(self) -> List[Schedule]:
+        return sorted(self.schedules.values(), key=lambda s: s.token)
+
+    # -- firing ----------------------------------------------------------
+    async def fire(self, s: Schedule) -> int:
+        fired = self.metrics.counter("schedules.fired")
+        n = 0
+        for dev in s.device_tokens:
+            inv = DeviceCommandInvocation(
+                device_token=dev,
+                tenant=self.tenant,
+                command_token=s.command_token,
+                initiator="schedule",
+                initiator_id=s.token,
+                parameters=dict(s.parameters),
+            )
+            await self.bus.publish(
+                self.bus.naming.command_invocations(self.tenant), inv
+            )
+            n += 1
+        s.fire_count += 1
+        s.last_fired = time.time()
+        fired.inc(n)
+        return n
+
+    async def tick(self, now: Optional[float] = None) -> int:
+        """Evaluate all schedules once; returns invocations fired. Separated
+        from the loop for deterministic tests."""
+        now = now if now is not None else time.time()
+        total = 0
+        for s in list(self.schedules.values()):
+            if not s.enabled:
+                continue
+            if s.end_ts and now > s.end_ts:
+                continue
+            if s.at_ts:
+                if s.fire_count == 0 and now >= s.at_ts:
+                    total += await self.fire(s)
+            elif s.every_s:
+                if now - s.last_fired >= s.every_s:
+                    total += await self.fire(s)
+            elif s.cron:
+                spec = self._crons.get(s.token)
+                if spec is None:
+                    spec = self._crons[s.token] = CronSpec.parse(s.cron)
+                dt = datetime.fromtimestamp(now)
+                minute_key = int(now // 60)
+                if spec.matches(dt) and self._last_cron_minute.get(s.token) != minute_key:
+                    self._last_cron_minute[s.token] = minute_key
+                    total += await self.fire(s)
+        return total
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        await cancel_and_wait(self._task)
+        self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_s)
+            try:
+                await self.tick()
+            except Exception as exc:  # noqa: BLE001
+                self._record_error("tick", exc)

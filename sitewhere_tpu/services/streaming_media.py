@@ -1,0 +1,133 @@
+"""Streaming media: device media streams + TPU frame classification.
+
+Capability parity with the reference's service-streaming-media (device
+stream registration, ordered chunk append/playback — SURVEY.md §2.2 [U],
+the least mature upstream service; reference mount empty, see provenance
+banner). The rebuild adds the north-star extension: a ViT-B/16 frame
+classifier over camera streams (BASELINE.json:11) — frames batched through
+the model zoo under jit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from sitewhere_tpu.core.model import new_token
+
+
+@dataclass
+class MediaStream:
+    stream_id: str
+    assignment_token: str = ""
+    content_type: str = "application/octet-stream"
+    created_ts: int = field(default_factory=lambda: int(time.time() * 1000))
+    chunks: List[Tuple[int, bytes]] = field(default_factory=list)  # (seq, data)
+
+    @property
+    def size_bytes(self) -> int:
+        return sum(len(d) for _, d in self.chunks)
+
+
+class StreamingMedia:
+    """Per-tenant media chunk store + frame classification."""
+
+    def __init__(self, tenant: str = "default") -> None:
+        self.tenant = tenant
+        self._streams: Dict[str, MediaStream] = {}
+        self._classifier = None  # lazy (params are 86M for real B/16)
+
+    # -- stream CRUD (reference surface) ---------------------------------
+    def create_stream(
+        self,
+        assignment_token: str,
+        stream_id: Optional[str] = None,
+        content_type: str = "application/octet-stream",
+    ) -> MediaStream:
+        sid = stream_id or new_token("stream")
+        if sid in self._streams:
+            raise ValueError(f"stream '{sid}' exists")
+        s = MediaStream(sid, assignment_token, content_type)
+        self._streams[sid] = s
+        return s
+
+    def get_stream(self, stream_id: str) -> Optional[MediaStream]:
+        return self._streams.get(stream_id)
+
+    def list_streams(self, assignment_token: str = "") -> List[MediaStream]:
+        return [
+            s
+            for s in self._streams.values()
+            if not assignment_token or s.assignment_token == assignment_token
+        ]
+
+    def append_chunk(self, stream_id: str, seq: int, data: bytes) -> None:
+        s = self._streams[stream_id]
+        s.chunks.append((seq, data))
+
+    def iter_chunks(self, stream_id: str) -> Iterator[bytes]:
+        """Playback: chunks in sequence order (late arrivals sorted in)."""
+        s = self._streams[stream_id]
+        for _, data in sorted(s.chunks, key=lambda t: t[0]):
+            yield data
+
+    def get_chunk(self, stream_id: str, seq: int) -> Optional[bytes]:
+        s = self._streams.get(stream_id)
+        if s is None:
+            return None
+        for sq, data in s.chunks:
+            if sq == seq:
+                return data
+        return None
+
+    # -- frame classification (rebuild-only, BASELINE.json:11) -----------
+    def _get_classifier(self, tiny: bool):
+        if self._classifier is None:
+            import jax
+
+            from sitewhere_tpu.models import get_model
+            from sitewhere_tpu.models.vit import VIT_B16, VIT_TINY_TEST
+
+            spec = get_model("vit_b16")
+            cfg = VIT_TINY_TEST if tiny else VIT_B16
+            params = spec.init(jax.random.PRNGKey(0), cfg)
+            apply = jax.jit(spec.apply, static_argnums=1)
+            self._classifier = (spec, cfg, params, apply)
+        return self._classifier
+
+    def load_classifier_params(self, params, tiny: bool = False) -> None:
+        """Install trained ViT params (e.g. restored via runtime.checkpoint)."""
+        spec, cfg, _, apply = self._get_classifier(tiny)
+        self._classifier = (spec, cfg, params, apply)
+
+    def classify_frames(
+        self, frames: np.ndarray, top_k: int = 5, tiny: bool = False
+    ) -> List[List[Tuple[int, float]]]:
+        """frames f32[B, H, W, C] (pre-normalized) → per-frame top-k
+        (class_id, probability). One jit call per batch."""
+        import jax.numpy as jnp
+        import jax
+
+        _, cfg, params, apply = self._get_classifier(tiny)
+        logits = apply(params, cfg, jnp.asarray(frames, jnp.float32))
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        out: List[List[Tuple[int, float]]] = []
+        for p in probs:
+            idx = np.argsort(p)[::-1][:top_k]
+            out.append([(int(i), float(p[i])) for i in idx])
+        return out
+
+    def decode_frame(self, data: bytes, image_size: int) -> np.ndarray:
+        """JPEG/PNG chunk → normalized f32[H, W, 3] frame for the classifier."""
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data)).convert("RGB").resize(
+            (image_size, image_size)
+        )
+        arr = np.asarray(img, np.float32) / 255.0
+        return (arr - 0.5) / 0.5

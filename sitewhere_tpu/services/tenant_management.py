@@ -1,0 +1,77 @@
+"""Tenant management: tenant CRUD + fleet-wide engine lifecycle fan-out.
+
+Capability parity with the reference's service-tenant-management
+(``ITenantManagement``: tenant CRUD with template selection; publishing to
+the tenant-model-updates Kafka topic triggers tenant-engine lifecycle
+across every microservice — SURVEY.md §2.2 [U]; reference mount empty, see
+provenance banner).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.core.model import Tenant
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.config import TENANT_TEMPLATES
+from sitewhere_tpu.runtime.tenant import broadcast_tenant_update
+
+
+class TenantManagement:
+    """Instance-scoped tenant store; changes broadcast to all services."""
+
+    def __init__(self, bus: EventBus) -> None:
+        self.bus = bus
+        self._tenants: Dict[str, Tenant] = {}
+
+    def get_tenant(self, token: str) -> Optional[Tenant]:
+        return self._tenants.get(token)
+
+    def list_tenants(self) -> List[Tenant]:
+        return sorted(self._tenants.values(), key=lambda t: t.token)
+
+    def list_templates(self) -> List[str]:
+        return sorted(TENANT_TEMPLATES)
+
+    async def create_tenant(
+        self,
+        token: str,
+        name: str = "",
+        template: str = "default",
+        **overrides,
+    ) -> Tenant:
+        if token in self._tenants:
+            raise ValueError(f"tenant '{token}' exists")
+        if template not in TENANT_TEMPLATES:
+            raise KeyError(f"unknown template '{template}'")
+        t = Tenant(token=token, name=name or token, template=template)
+        self._tenants[token] = t
+        await broadcast_tenant_update(
+            self.bus,
+            {"op": "add", "tenant": token, "template": template,
+             "overrides": overrides},
+        )
+        return t
+
+    async def update_tenant(self, token: str, **overrides) -> Tenant:
+        t = self._tenants[token]
+        if "name" in overrides:
+            t.name = overrides.pop("name")
+        if "template" in overrides:
+            t.template = overrides.pop("template")
+        t.touch()
+        await broadcast_tenant_update(
+            self.bus,
+            {"op": "update", "tenant": token, "template": t.template,
+             "overrides": overrides},
+        )
+        return t
+
+    async def restart_tenant(self, token: str) -> None:
+        if token not in self._tenants:
+            raise KeyError(token)
+        await broadcast_tenant_update(self.bus, {"op": "restart", "tenant": token})
+
+    async def delete_tenant(self, token: str) -> None:
+        self._tenants.pop(token, None)
+        await broadcast_tenant_update(self.bus, {"op": "remove", "tenant": token})

@@ -1,0 +1,142 @@
+"""User management: users, granted authorities, signed API tokens.
+
+Capability parity with the reference's service-user-management
+(``IUserManagement`` + jjwt-based ``TokenManagement``: users with granted
+authorities, JWT issuance/validation feeding the REST auth filter —
+SURVEY.md §2.2/§3.4 [U]; reference mount empty, see provenance banner).
+
+Redesign: salted SHA-256 password hashes; tokens are compact JWTs (HS256
+via stdlib hmac — no external jwt dependency) carrying username +
+authorities + expiry.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.core.model import User
+
+# canonical authorities (reference: granted authorities [U])
+AUTH_ADMIN = "ROLE_ADMIN"
+AUTH_DEVICE_MANAGE = "ROLE_DEVICE_MANAGEMENT"
+AUTH_EVENT_VIEW = "ROLE_EVENT_VIEW"
+AUTH_TENANT_ADMIN = "ROLE_TENANT_ADMIN"
+ALL_AUTHORITIES = [AUTH_ADMIN, AUTH_DEVICE_MANAGE, AUTH_EVENT_VIEW, AUTH_TENANT_ADMIN]
+
+
+class AuthError(PermissionError):
+    pass
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_dec(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def hash_password(password: str, salt: str) -> str:
+    return hashlib.sha256((salt + password).encode()).hexdigest()
+
+
+class UserManagement:
+    """User store + token issuance/validation."""
+
+    def __init__(self, secret: Optional[str] = None, token_ttl_s: int = 3600) -> None:
+        self._users: Dict[str, User] = {}
+        self.secret = (secret or uuid.uuid4().hex).encode()
+        self.token_ttl_s = token_ttl_s
+
+    # -- users -----------------------------------------------------------
+    def create_user(
+        self,
+        username: str,
+        password: str,
+        authorities: Optional[List[str]] = None,
+        first_name: str = "",
+        last_name: str = "",
+    ) -> User:
+        if username in self._users:
+            raise ValueError(f"user '{username}' exists")
+        u = User(
+            username=username,
+            first_name=first_name,
+            last_name=last_name,
+            authorities=list(authorities or [AUTH_EVENT_VIEW]),
+        )
+        u.password_hash = hash_password(password, u.salt)
+        self._users[username] = u
+        return u
+
+    def get_user(self, username: str) -> Optional[User]:
+        return self._users.get(username)
+
+    def delete_user(self, username: str) -> None:
+        self._users.pop(username, None)
+
+    def list_users(self) -> List[User]:
+        return sorted(self._users.values(), key=lambda u: u.username)
+
+    def set_enabled(self, username: str, enabled: bool) -> None:
+        u = self._users[username]
+        u.enabled = enabled
+
+    def update_authorities(self, username: str, authorities: List[str]) -> None:
+        self._users[username].authorities = list(authorities)
+
+    # -- auth ------------------------------------------------------------
+    def authenticate(self, username: str, password: str) -> User:
+        u = self._users.get(username)
+        if u is None or not u.enabled:
+            raise AuthError("unknown or disabled user")
+        if not hmac.compare_digest(u.password_hash, hash_password(password, u.salt)):
+            raise AuthError("bad credentials")
+        return u
+
+    def issue_token(self, username: str, password: str) -> str:
+        """Login → signed JWT (HS256)."""
+        u = self.authenticate(username, password)
+        header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        payload = _b64url(
+            json.dumps(
+                {
+                    "sub": u.username,
+                    "auth": u.authorities,
+                    "iat": int(time.time()),
+                    "exp": int(time.time()) + self.token_ttl_s,
+                }
+            ).encode()
+        )
+        signing_input = f"{header}.{payload}".encode()
+        sig = _b64url(hmac.new(self.secret, signing_input, hashlib.sha256).digest())
+        return f"{header}.{payload}.{sig}"
+
+    def validate_token(self, token: str) -> Dict:
+        """Token → claims dict; raises AuthError on any problem."""
+        try:
+            header, payload, sig = token.split(".")
+        except ValueError:
+            raise AuthError("malformed token") from None
+        signing_input = f"{header}.{payload}".encode()
+        expect = _b64url(hmac.new(self.secret, signing_input, hashlib.sha256).digest())
+        if not hmac.compare_digest(sig, expect):
+            raise AuthError("bad signature")
+        claims = json.loads(_b64url_dec(payload))
+        if claims.get("exp", 0) < time.time():
+            raise AuthError("token expired")
+        u = self._users.get(claims.get("sub", ""))
+        if u is None or not u.enabled:
+            raise AuthError("unknown or disabled user")
+        return claims
+
+    def require_authority(self, claims: Dict, authority: str) -> None:
+        auths = claims.get("auth", [])
+        if AUTH_ADMIN not in auths and authority not in auths:
+            raise AuthError(f"missing authority {authority}")

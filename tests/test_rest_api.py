@@ -1,0 +1,230 @@
+"""REST gateway: auth, CRUD controllers, events read path, commands,
+tenants, schedules, batch, labels, media — via aiohttp's test utilities."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from sitewhere_tpu.api.rest import make_app
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+from contextlib import asynccontextmanager
+
+
+@asynccontextmanager
+async def client_ctx():
+    inst = SiteWhereInstance(
+        InstanceConfig(
+            instance_id="api",
+            mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+        )
+    )
+    await inst.start()
+    try:
+        await inst.bootstrap(default_tenant="default", dataset_devices=5)
+        for _ in range(100):
+            if "default" in inst.tenants:
+                break
+            await asyncio.sleep(0.02)
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        resp = await client.post(
+            "/api/authapi/jwt",
+            json={"username": "admin", "password": "password"},
+        )
+        token = (await resp.json())["token"]
+        client._session.headers["Authorization"] = f"Bearer {token}"
+        try:
+            yield client, inst
+        finally:
+            await client.close()
+    finally:
+        await inst.terminate()
+
+
+async def test_login_and_auth_required():
+    async with client_ctx() as (client, inst):
+        # no token → 401
+        import aiohttp
+
+        async with aiohttp.ClientSession() as raw:
+            url = client.make_url("/api/devices")
+            async with raw.get(url) as resp:
+                assert resp.status == 401
+        # bad login → 401
+        resp = await client.post(
+            "/api/authapi/jwt", json={"username": "admin", "password": "nope"}
+        )
+        assert resp.status == 401
+        # health is public
+        resp = await client.get("/api/health")
+        assert (await resp.json())["status"] == "ok"
+
+
+async def test_device_crud_and_state(monkeypatch=None):
+    async with client_ctx() as (client, inst):
+        resp = await client.get("/api/devices")
+        body = await resp.json()
+        assert body["total"] == 5
+        # create a type + device
+        resp = await client.post("/api/devicetypes", json={"name": "camera"})
+        dt = await resp.json()
+        assert resp.status == 201
+        resp = await client.post(
+            "/api/devices",
+            json={"token": "cam-1", "name": "Cam", "device_type_token": dt["token"]},
+        )
+        assert resp.status == 201
+        resp = await client.get("/api/devices/cam-1")
+        body = await resp.json()
+        assert body["name"] == "Cam"
+        assert body["active_assignment"]["device_token"] == "cam-1"
+        # label PNG
+        resp = await client.get("/api/devices/cam-1/label")
+        assert resp.status == 200
+        assert (await resp.read())[:4] == b"\x89PNG"
+
+
+async def test_events_read_path():
+    async with client_ctx() as (client, inst):
+        sim = DeviceSimulator(
+            inst.broker, SimProfile(n_devices=5, seed=1),
+            topic_pattern="sitewhere/input/{device}",
+        )
+        for step in range(10):
+            await sim.publish_round(float(step))
+        # wait for scoring+persistence
+        rt = inst.tenant("default")
+        for _ in range(200):
+            if len(rt.event_store) >= 50:
+                break
+            await asyncio.sleep(0.05)
+        asn = rt.device_management.active_assignment_for("dev-00000")
+        resp = await client.get(f"/api/assignments/{asn.token}/measurements")
+        body = await resp.json()
+        assert body["total"] >= 10
+        assert body["results"][0]["name"] == "temperature"
+        resp = await client.get("/api/events?device=dev-00000&page_size=5")
+        body = await resp.json()
+        assert body["total"] >= 10 and len(body["results"]) == 5
+
+
+async def test_command_invocation_endpoint():
+    async with client_ctx() as (client, inst):
+        rt = inst.tenant("default")
+        dt_token = rt.device_management.get_device("dev-00000").device_type_token
+        resp = await client.post(
+            f"/api/devicetypes/{dt_token}/commands",
+            json={"name": "reboot", "parameters": [
+                {"name": "delay", "type": "int64", "required": "true"}]},
+        )
+        cmd = await resp.json()
+        asn = rt.device_management.active_assignment_for("dev-00000")
+        resp = await client.post(
+            f"/api/assignments/{asn.token}/invocations",
+            json={"command_token": cmd["token"], "parameters": {"delay": 3}},
+        )
+        assert resp.status == 201
+        inv = await resp.json()
+        assert inv["command_token"] == cmd["token"]
+        await asyncio.sleep(0.2)
+        assert inst.metrics.counter("command_delivery.delivered").value == 1
+
+
+async def test_tenant_endpoints():
+    async with client_ctx() as (client, inst):
+        resp = await client.post(
+            "/api/tenants", json={"token": "gamma", "template": "default"}
+        )
+        assert resp.status == 201
+        for _ in range(100):
+            if "gamma" in inst.tenants:
+                break
+            await asyncio.sleep(0.02)
+        resp = await client.get("/api/tenants")
+        body = await resp.json()
+        assert {t["token"] for t in body["results"]} == {"default", "gamma"}
+        assert "iot-temperature" in body["templates"]
+        resp = await client.delete("/api/tenants/gamma")
+        assert resp.status == 200
+
+
+async def test_schedule_and_batch_endpoints():
+    async with client_ctx() as (client, inst):
+        resp = await client.post(
+            "/api/schedules",
+            json={"name": "nightly", "cron": "0 3 * * *",
+                  "command_token": "c1", "device_tokens": ["dev-00000"]},
+        )
+        assert resp.status == 201
+        resp = await client.get("/api/schedules")
+        assert (await resp.json())["results"][0]["name"] == "nightly"
+
+        rt = inst.tenant("default")
+        dt_token = rt.device_management.get_device("dev-00000").device_type_token
+        await client.post(
+            f"/api/devicetypes/{dt_token}/commands", json={"name": "ping", "token": "c-ping"}
+        )
+        resp = await client.post(
+            "/api/batch",
+            json={"command_token": "c-ping",
+                  "device_tokens": ["dev-00000", "dev-00001"]},
+        )
+        assert resp.status == 201
+        op = await resp.json()
+        for _ in range(100):
+            resp = await client.get(f"/api/batch/{op['token']}")
+            body = await resp.json()
+            if body["status"] in ("done", "done_with_errors"):
+                break
+            await asyncio.sleep(0.02)
+        assert body["counts"]["succeeded"] == 2
+
+
+async def test_media_endpoints():
+    async with client_ctx() as (client, inst):
+        resp = await client.post(
+            "/api/streams", json={"assignment_token": "asn", "stream_id": "cam"}
+        )
+        assert resp.status == 201
+        await client.put("/api/streams/cam/chunks/0", data=b"frame0")
+        resp = await client.get("/api/streams/cam/chunks/0")
+        assert await resp.read() == b"frame0"
+        resp = await client.get("/api/streams/cam/chunks/9")
+        assert resp.status == 404
+
+
+async def test_metrics_and_openapi():
+    async with client_ctx() as (client, inst):
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert "TYPE" in text
+        resp = await client.get("/api/openapi.json")
+        spec = await resp.json()
+        assert "/api/devices" in spec["paths"]
+        resp = await client.get("/api/instance/topology")
+        body = await resp.json()
+        assert body["instance_id"] == "api"
+
+
+async def test_authority_enforcement_on_users():
+    async with client_ctx() as (client, inst):
+        # create a low-privilege user, then try admin-only endpoint
+        resp = await client.post(
+            "/api/users",
+            json={"username": "viewer", "password": "pw",
+                  "authorities": ["ROLE_EVENT_VIEW"]},
+        )
+        assert resp.status == 201
+        resp = await client.post(
+            "/api/authapi/jwt", json={"username": "viewer", "password": "pw"}
+        )
+        viewer_token = (await resp.json())["token"]
+        resp = await client.get(
+            "/api/users", headers={"Authorization": f"Bearer {viewer_token}"}
+        )
+        assert resp.status == 403

@@ -1,0 +1,324 @@
+"""Domain services: state/presence, registration, batch, schedules, labels,
+assets, users/tokens, streaming media."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.events import (
+    DeviceAlert,
+    DeviceLocation,
+    DeviceMeasurement,
+    now_ms,
+)
+from sitewhere_tpu.core.model import (
+    Asset,
+    AssetType,
+    Device,
+    DeviceCommand,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceType,
+)
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.services.asset_management import AssetManagement
+from sitewhere_tpu.services.batch_operations import (
+    BatchOperationManager,
+    BatchOpStatus,
+    ElementStatus,
+)
+from sitewhere_tpu.services.device_management import DeviceManagement
+from sitewhere_tpu.services.device_state import DeviceStateService
+from sitewhere_tpu.services.label_generation import LabelGeneration, encode_qr
+from sitewhere_tpu.services.registration import RegistrationService
+from sitewhere_tpu.services.schedule_management import (
+    CronSpec,
+    Schedule,
+    ScheduleManager,
+)
+from sitewhere_tpu.services.streaming_media import StreamingMedia
+from sitewhere_tpu.services.user_management import (
+    AUTH_ADMIN,
+    AUTH_EVENT_VIEW,
+    AuthError,
+    UserManagement,
+)
+
+
+class TestDeviceState:
+    def _svc(self, bus, timeout_ms=100):
+        return DeviceStateService("t1", bus, presence_timeout_ms=timeout_ms)
+
+    def test_state_rollup(self, bus):
+        svc = self._svc(bus)
+        svc.apply_event(DeviceMeasurement(device_token="d1", name="temp", value=20.0, score=1.0))
+        svc.apply_event(DeviceMeasurement(device_token="d1", name="temp", value=21.0, score=2.0))
+        svc.apply_event(DeviceMeasurement(device_token="d1", name="rpm", value=900.0))
+        svc.apply_event(DeviceLocation(device_token="d1", latitude=1.0, longitude=2.0))
+        svc.apply_event(DeviceAlert(device_token="d1", alert_type="hot"))
+        st = svc.get_state("d1")
+        assert st.latest_measurements["temp"][0] == 21.0
+        assert st.latest_measurements["rpm"][0] == 900.0
+        assert st.latest_location[0] == 1.0
+        assert st.latest_alerts[-1]["alert_type"] == "hot"
+        d = st.to_dict()
+        assert d["latest_measurements"]["temp"]["score"] == 2.0
+
+    async def test_presence_sweep_emits_state_change(self, bus):
+        svc = self._svc(bus, timeout_ms=10)
+        old = DeviceMeasurement(device_token="d1", value=1.0)
+        old.received_ts = now_ms() - 1000
+        svc.apply_event(old)
+        bus.subscribe(bus.naming.scored_events("t1"), "probe")
+        changes = await svc.check_presence()
+        assert len(changes) == 1
+        assert changes[0].new_state == "missing"
+        assert svc.non_present() == ["d1"]
+        out = await bus.consume(bus.naming.scored_events("t1"), "probe", timeout_s=0)
+        assert len(out) == 1
+        # device comes back → present again
+        svc.apply_event(DeviceMeasurement(device_token="d1", value=2.0))
+        assert svc.non_present() == []
+
+
+class TestRegistration:
+    @pytest.fixture
+    def dm(self):
+        return DeviceManagement("t1")
+
+    async def test_auto_registration(self, bus, dm):
+        svc = RegistrationService("t1", bus, dm)
+        dev = await svc.process_request(
+            {"type": "measurement", "device_token": "new-dev", "value": 1.0}
+        )
+        assert dev is not None
+        assert dm.get_device("new-dev") is not None
+        assert dm.active_assignment_for("new-dev") is not None
+        assert dev.metadata["registration"] == "auto"
+
+    async def test_explicit_registration_with_type(self, bus, dm):
+        dm.create_device_type(DeviceType(token="dt-cam", name="camera"))
+        svc = RegistrationService("t1", bus, dm)
+        dev = await svc.process_request(
+            {"type": "register", "device_token": "cam-1",
+             "device_type_token": "dt-cam", "area_token": "ar1"}
+        )
+        assert dev.device_type_token == "dt-cam"
+        assert dm.active_assignment_for("cam-1").area_token == "ar1"
+
+    async def test_denied_when_auto_off(self, bus, dm):
+        svc = RegistrationService("t1", bus, dm, allow_auto_registration=False)
+        dev = await svc.process_request(
+            {"type": "measurement", "device_token": "x", "value": 1.0}
+        )
+        assert dev is None
+        # explicit register still allowed
+        dev = await svc.process_request({"type": "register", "device_token": "x"})
+        assert dev is not None
+
+
+class TestBatchOperations:
+    @pytest.fixture
+    def dm(self):
+        m = DeviceManagement("t1")
+        dt = DeviceType(token="dt1")
+        dt.commands.append(DeviceCommand(token="c1", name="ping"))
+        m.create_device_type(dt)
+        for i in range(5):
+            m.create_device(Device(token=f"d{i}", device_type_token="dt1"))
+        m.create_group(DeviceGroup(token="g1", elements=[
+            DeviceGroupElement(device_token="d0", roles=["r"]),
+            DeviceGroupElement(device_token="d1", roles=["r"]),
+        ]))
+        return m
+
+    async def test_execute_emits_invocations(self, bus, dm):
+        mgr = BatchOperationManager("t1", bus, dm)
+        op = mgr.create_operation("c1", device_tokens=["d0", "d1", "ghost"])
+        bus.subscribe(bus.naming.command_invocations("t1"), "probe")
+        await mgr.execute(op)
+        assert op.status is BatchOpStatus.DONE_WITH_ERRORS
+        st = [el.status for el in op.elements]
+        assert st == [ElementStatus.SUCCEEDED, ElementStatus.SUCCEEDED, ElementStatus.FAILED]
+        invs = await bus.consume(bus.naming.command_invocations("t1"), "probe", timeout_s=0)
+        assert len(invs) == 2
+        assert all(i.initiator == "batch" for i in invs)
+        assert op.summary()["counts"]["succeeded"] == 2
+
+    async def test_group_targeting(self, bus, dm):
+        mgr = BatchOperationManager("t1", bus, dm)
+        op = mgr.create_operation("c1", group_token="g1", role="r")
+        assert [el.device_token for el in op.elements] == ["d0", "d1"]
+
+    async def test_submit_worker_path(self, bus, dm):
+        mgr = BatchOperationManager("t1", bus, dm)
+        await mgr.start()
+        try:
+            op = mgr.create_operation("c1", device_tokens=["d0"])
+            await mgr.submit(op.token)
+            await asyncio.sleep(0.05)
+            assert op.status is BatchOpStatus.DONE
+        finally:
+            await mgr.stop()
+
+
+class TestSchedules:
+    def test_cron_parse_and_match(self):
+        from datetime import datetime
+
+        spec = CronSpec.parse("*/15 3 * * 1-5")
+        assert spec.matches(datetime(2026, 7, 29, 3, 30))  # wednesday
+        assert not spec.matches(datetime(2026, 7, 29, 4, 30))
+        assert not spec.matches(datetime(2026, 7, 26, 3, 30))  # sunday
+        with pytest.raises(ValueError):
+            CronSpec.parse("* * *")
+
+    async def test_interval_schedule_fires(self, bus):
+        mgr = ScheduleManager("t1", bus)
+        mgr.create_schedule(Schedule(
+            name="ping", every_s=100.0, command_token="c1", device_tokens=["d1", "d2"],
+        ))
+        bus.subscribe(bus.naming.command_invocations("t1"), "probe")
+        t = time.time()
+        n = await mgr.tick(now=t)
+        assert n == 2
+        assert await mgr.tick(now=t + 1) == 0      # not due yet
+        assert await mgr.tick(now=t + 101) == 2    # due again
+        invs = await bus.consume(bus.naming.command_invocations("t1"), "probe", timeout_s=0)
+        assert len(invs) == 4
+        assert invs[0].initiator == "schedule"
+
+    async def test_one_shot_fires_once(self, bus):
+        mgr = ScheduleManager("t1", bus)
+        s = mgr.create_schedule(Schedule(at_ts=100.0, command_token="c", device_tokens=["d"]))
+        assert await mgr.tick(now=99.0) == 0
+        assert await mgr.tick(now=101.0) == 1
+        assert await mgr.tick(now=102.0) == 0
+        assert s.fire_count == 1
+
+    async def test_cron_schedule_once_per_minute(self, bus):
+        mgr = ScheduleManager("t1", bus)
+        mgr.create_schedule(Schedule(cron="* * * * *", command_token="c", device_tokens=["d"]))
+        base = 1785340800.0  # some minute boundary
+        assert await mgr.tick(now=base) == 1
+        assert await mgr.tick(now=base + 10) == 0   # same minute
+        assert await mgr.tick(now=base + 61) == 1   # next minute
+
+
+class TestLabels:
+    def test_qr_structure(self):
+        m = encode_qr(b"sitewhere://device/dev-00042")
+        n = len(m)
+        assert n in (21, 25, 29, 33, 37)
+        # finder pattern corners: 7x7 ring dark at corners
+        assert m[0][0] and m[0][6] and m[6][0]
+        assert m[0][n - 1] and m[n - 7][0]
+        # timing pattern alternates
+        row6 = m[6][8 : n - 8]
+        assert all(row6[i] == (i % 2 == 0) for i in range(len(row6)))
+        # dark module
+        assert m[n - 8][8]
+
+    def test_qr_versions_scale_with_payload(self):
+        assert len(encode_qr(b"x" * 10)) == 21        # v1
+        assert len(encode_qr(b"x" * 30)) == 25        # v2
+        assert len(encode_qr(b"x" * 100)) == 37       # v5
+        with pytest.raises(ValueError):
+            encode_qr(b"x" * 200)
+
+    def test_qr_png_renders(self):
+        png = LabelGeneration("t1").qr_png("device", "dev-00001")
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        assert len(png) > 200
+
+
+class TestAssets:
+    def test_asset_crud(self):
+        am = AssetManagement("t1")
+        am.create_asset_type(AssetType(token="at1", asset_category="person"))
+        am.create_asset(Asset(token="a1", asset_type_token="at1", name="Alice"))
+        with pytest.raises(KeyError):
+            am.create_asset(Asset(token="a2", asset_type_token="nope"))
+        with pytest.raises(ValueError):
+            am.delete_asset_type("at1")  # in use
+        assets, total = am.list_assets(asset_type="at1")
+        assert total == 1 and assets[0].name == "Alice"
+        am.delete_asset("a1")
+        am.delete_asset_type("at1")
+
+
+class TestUsers:
+    def test_password_and_token_flow(self):
+        um = UserManagement(secret="s3cret", token_ttl_s=60)
+        um.create_user("admin", "pw", [AUTH_ADMIN])
+        with pytest.raises(AuthError):
+            um.issue_token("admin", "wrong")
+        token = um.issue_token("admin", "pw")
+        claims = um.validate_token(token)
+        assert claims["sub"] == "admin"
+        um.require_authority(claims, "ROLE_ANYTHING")  # admin passes all
+
+    def test_authority_enforcement(self):
+        um = UserManagement()
+        um.create_user("bob", "pw", [AUTH_EVENT_VIEW])
+        claims = um.validate_token(um.issue_token("bob", "pw"))
+        um.require_authority(claims, AUTH_EVENT_VIEW)
+        with pytest.raises(AuthError):
+            um.require_authority(claims, AUTH_ADMIN)
+
+    def test_tampered_token_rejected(self):
+        um = UserManagement()
+        um.create_user("bob", "pw")
+        token = um.issue_token("bob", "pw")
+        h, p, s = token.split(".")
+        import base64, json
+
+        payload = json.loads(base64.urlsafe_b64decode(p + "==="))
+        payload["auth"] = [AUTH_ADMIN]
+        p2 = base64.urlsafe_b64encode(json.dumps(payload).encode()).rstrip(b"=").decode()
+        with pytest.raises(AuthError):
+            um.validate_token(f"{h}.{p2}.{s}")
+
+    def test_disabled_user_rejected(self):
+        um = UserManagement()
+        um.create_user("bob", "pw")
+        token = um.issue_token("bob", "pw")
+        um.set_enabled("bob", False)
+        with pytest.raises(AuthError):
+            um.validate_token(token)
+
+
+class TestStreamingMedia:
+    def test_chunk_store_ordering(self):
+        sm = StreamingMedia("t1")
+        s = sm.create_stream("asn-1", "cam-1", "video/mjpeg")
+        sm.append_chunk("cam-1", 2, b"c")
+        sm.append_chunk("cam-1", 0, b"a")
+        sm.append_chunk("cam-1", 1, b"b")
+        assert b"".join(sm.iter_chunks("cam-1")) == b"abc"
+        assert sm.get_chunk("cam-1", 1) == b"b"
+        assert s.size_bytes == 3
+        assert sm.list_streams("asn-1")[0].stream_id == "cam-1"
+
+    def test_classify_frames_tiny(self):
+        sm = StreamingMedia("t1")
+        frames = np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype(np.float32)
+        out = sm.classify_frames(frames, top_k=3, tiny=True)
+        assert len(out) == 2 and len(out[0]) == 3
+        probs = [p for _, p in out[0]]
+        assert all(0 <= p <= 1 for p in probs)
+
+    def test_decode_frame(self):
+        import io
+
+        from PIL import Image
+
+        img = Image.new("RGB", (64, 48), (255, 0, 0))
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        sm = StreamingMedia("t1")
+        arr = sm.decode_frame(buf.getvalue(), image_size=32)
+        assert arr.shape == (32, 32, 3)
+        assert arr.max() <= 1.0 and arr.min() >= -1.0
