@@ -371,6 +371,7 @@ class RestApi:
 
     async def invoke_command(self, request) -> web.Response:
         """The §3.2 write path: create + dispatch a command invocation."""
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         a = rt.device_management.get_assignment(request.match_info["token"])
@@ -399,6 +400,7 @@ class RestApi:
         return web.json_response(_paged(items, total, page, size))
 
     async def create_area(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         area = Area(
@@ -418,6 +420,7 @@ class RestApi:
         return web.json_response(_paged(items, total, page, size))
 
     async def create_zone(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         z = Zone(
@@ -436,6 +439,7 @@ class RestApi:
         return web.json_response(_paged(items, total, page, size))
 
     async def create_asset_type(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         at = AssetType(
@@ -447,6 +451,7 @@ class RestApi:
         return web.json_response(_entity(at), status=201)
 
     async def create_asset(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         a = Asset(
@@ -515,6 +520,7 @@ class RestApi:
         )
 
     async def create_schedule(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         s = Schedule(
@@ -530,6 +536,7 @@ class RestApi:
         return web.json_response(s.to_dict(), status=201)
 
     async def create_batch(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         op = rt.batch.create_operation(
@@ -551,6 +558,7 @@ class RestApi:
 
     # -- streaming media -------------------------------------------------
     async def create_stream(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         b = await request.json()
         s = rt.media.create_stream(
@@ -563,6 +571,7 @@ class RestApi:
         )
 
     async def put_chunk(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
         rt = self._tenant(request)
         data = await request.read()
         rt.media.append_chunk(

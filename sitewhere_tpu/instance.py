@@ -94,6 +94,7 @@ class TenantRuntime:
     commands: CommandDelivery
     batch: BatchOperationManager
     schedules: ScheduleManager
+    broker_handler: object = None  # tenant input handler (for unsubscribe)
 
     def components(self) -> List[LifecycleComponent]:
         return [
@@ -132,6 +133,26 @@ class SiteWhereInstance(LifecycleComponent):
         self.add_child(self.inference)
         self.tenants: Dict[str, TenantRuntime] = {}
         self._updates_task: Optional[asyncio.Task] = None
+        # ONE instance-level subscription for the shared input pattern; it
+        # routes to opted-in tenants (cfg.shared_input) or — if none opted
+        # in — to the sole tenant. With >=2 tenants and no flag it routes
+        # nowhere: the shared pattern must never fan one device's telemetry
+        # into every tenant (tenant isolation).
+        self.broker.subscribe("sitewhere/input/+", self._on_shared_input)
+
+    async def _on_shared_input(self, topic: str, payload: bytes) -> None:
+        targets = [
+            rt for rt in self.tenants.values() if rt.config.shared_input
+        ]
+        if not targets and len(self.tenants) == 1:
+            # sole-tenant convenience fallback — but gate on the tenant
+            # REGISTRY, not the live runtime map: during an 'update' op the
+            # runtime is transiently absent while its registration remains,
+            # and shared input must not leak into the other tenant then
+            if len(self.tenant_management.list_tenants()) <= 1:
+                targets = list(self.tenants.values())
+        for rt in targets:
+            await rt.source.receiver.submit(payload, topic=topic)
 
     # -- bootstrap (instance-management parity) --------------------------
     async def bootstrap(
@@ -171,8 +192,8 @@ class SiteWhereInstance(LifecycleComponent):
             await receiver.submit(payload, topic=topic)
 
         self.broker.subscribe(f"sitewhere/{tenant}/input/+", on_broker_msg)
-        # default shared-topic pattern for single-tenant setups
-        self.broker.subscribe("sitewhere/input/+", on_broker_msg)
+        # shared 'sitewhere/input/+' routing happens at instance level
+        # (_on_shared_input) so multi-tenant isolation holds
 
         rules = RuleEngine(tenant, self.bus, [
             anomaly_score_rule(f"{tenant}-anomaly", min_score=3.0, cooldown_ms=5000),
@@ -212,11 +233,14 @@ class SiteWhereInstance(LifecycleComponent):
             ),
             batch=BatchOperationManager(tenant, self.bus, dm, self.metrics),
             schedules=ScheduleManager(tenant, self.bus, self.metrics),
+            broker_handler=on_broker_msg,
         )
 
     async def add_tenant(self, cfg: TenantEngineConfig) -> TenantRuntime:
         if cfg.tenant in self.tenants:
             raise ValueError(f"tenant '{cfg.tenant}' already running")
+        # lift any tombstone from a previous removal of this tenant token
+        self.bus.undrop(self.bus.naming.tenant_topic(cfg.tenant, ""))
         rt = self._build_tenant(cfg)
         self.tenants[cfg.tenant] = rt
         for comp in rt.components():
@@ -230,10 +254,19 @@ class SiteWhereInstance(LifecycleComponent):
         rt = self.tenants.pop(tenant, None)
         if rt is None:
             return
+        # stop broker ingress FIRST: the closure would otherwise keep
+        # filling the terminated EventSource's bounded queue until it
+        # blocks SimBroker.publish for every publisher in the process
+        if rt.broker_handler is not None:
+            self.broker.unsubscribe(rt.broker_handler)
         await self.inference.remove_tenant(tenant)
         for comp in reversed(rt.components()):
             await comp.terminate()
             self.remove_child(comp)
+        # drop the tenant's bus topics: stale group cursors on dead topics
+        # would backpressure future publishers (topics recreate lazily if
+        # the tenant is ever re-added)
+        self.bus.drop_topics(self.bus.naming.tenant_topic(tenant, ""))
 
     async def restart_tenant(self, tenant: str) -> None:
         rt = self.tenants.get(tenant)
@@ -310,7 +343,7 @@ class SiteWhereInstance(LifecycleComponent):
             "mesh": self.mesh.describe(),
             "tenants": {
                 t: {
-                    "template": rt.config.tenant,
+                    "template": rt.config.template,
                     "model": rt.config.model,
                     "components": {
                         c.name: c.state.value for c in rt.components()

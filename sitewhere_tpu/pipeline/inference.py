@@ -102,11 +102,29 @@ class TpuInferenceEngine(TenantEngine):
     async def on_stop(self) -> None:
         svc = self.service
         if self.placement is not None:
+            slot = svc.router.global_slot(self.placement)
             scorer = svc.scorers.get(self.config.model)
             if scorer is not None:
                 # full wipe: a recycled slot must not leak this tenant's
                 # window history or params to the next occupant
-                scorer.reset_slot(svc.router.global_slot(self.placement))
+                scorer.reset_slot(slot)
+            # drain pending lanes keyed by the freed slot: a later flush
+            # must not zero-score stale events into the removed tenant's
+            # topic. The bus cursor already advanced past these events, so
+            # dropping them would lose them from the store on every tenant
+            # restart — publish them unscored (passthrough) instead.
+            lanes = svc._lanes.get(self.config.model)
+            if lanes is not None:
+                drained = svc.metrics.counter("tpu_inference.drained_on_stop")
+                topic = svc.bus.naming.scored_events(self.tenant)
+                for key in [k for k in lanes if k[0] == slot]:
+                    for _local_id, _value, ev in lanes.pop(key):
+                        ev.mark("passthrough_stop")
+                        # non-blocking: at instance shutdown the scored-topic
+                        # consumer is already stopped, so an awaitable publish
+                        # against a full topic would never unblock
+                        svc.bus.publish_nowait(topic, ev)
+                        drained.inc()
             svc.router.remove(self.tenant)
             self.placement = None
 

@@ -97,6 +97,7 @@ class Topic:
         self._space_event.set()
         self.group_offsets: Dict[str, int] = {}
         self.fault: Optional[FaultPlan] = None
+        self.dropped = False  # set by EventBus.drop_topics; pollers return []
 
     def _live_len(self) -> int:
         return len(self._log) - self._head
@@ -123,6 +124,8 @@ class Topic:
 
     async def publish(self, payload: Any) -> int:
         """Append; backpressures while full AND a group needs the oldest."""
+        if self.dropped:
+            return self._next_offset  # tombstoned topic: publishes are no-ops
         if self.fault is not None:
             f = self.fault
             if f.delay_s:
@@ -143,6 +146,8 @@ class Topic:
 
     def publish_nowait(self, payload: Any) -> int:
         """Non-blocking append; evicts oldest beyond retention (lossy)."""
+        if self.dropped:
+            return self._next_offset
         if self._live_len() >= self.retention:
             self._evict_oldest()
         return self._append(payload)
@@ -208,7 +213,12 @@ class Topic:
         if group not in self.group_offsets:
             self.group_offsets[group] = self.earliest_retained
         while True:
-            cur = max(self.group_offsets[group], self.earliest_retained)
+            if self.dropped:
+                return []
+            cur = max(
+                self.group_offsets.get(group, self.earliest_retained),
+                self.earliest_retained,
+            )
             # offsets in the log are dense, so the entry at offset ``cur``
             # sits at index head + (cur - earliest) — O(items), not a scan
             start = self._head + (cur - self.earliest_retained)
@@ -238,10 +248,17 @@ class EventBus:
         self.naming = naming or TopicNaming()
         self.retention = retention
         self._topics: Dict[str, Topic] = {}
+        self._dropped_prefixes: set = set()
+        self._tombstone = Topic("<dropped>", 0)
+        self._tombstone.dropped = True
 
     def topic(self, name: str) -> Topic:
         t = self._topics.get(name)
         if t is None:
+            # an in-flight publisher for a torn-down tenant must not lazily
+            # resurrect its topics — hand back the shared tombstone instead
+            if any(name.startswith(p) for p in self._dropped_prefixes):
+                return self._tombstone
             t = self._topics[name] = Topic(name, self.retention)
         return t
 
@@ -275,6 +292,24 @@ class EventBus:
             items = await t.poll(group, max_items)
             if items:
                 yield items
+
+    def drop_topics(self, prefix: str) -> List[str]:
+        """Delete topics by name prefix (tenant teardown): releases any
+        backpressured publisher and forgets group cursors. The prefix stays
+        tombstoned (publishes no-op, no lazy recreation) until ``undrop``."""
+        self._dropped_prefixes.add(prefix)
+        victims = [n for n in self._topics if n.startswith(prefix)]
+        for name in victims:
+            t = self._topics.pop(name)
+            t.dropped = True
+            t.group_offsets.clear()
+            t._space_event.set()  # release anyone blocked in publish
+            t._data_event.set()   # wake pollers; they return [] (dropped)
+        return victims
+
+    def undrop(self, prefix: str) -> None:
+        """Lift a tombstone (tenant re-add): topics recreate lazily again."""
+        self._dropped_prefixes.discard(prefix)
 
     def inject_faults(self, topic: str, plan: FaultPlan) -> None:
         self.topic(topic).fault = plan

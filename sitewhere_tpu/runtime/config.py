@@ -40,11 +40,16 @@ class MicroBatchConfig:
 @dataclass(frozen=True)
 class TenantEngineConfig:
     tenant: str = "default"
+    template: str = "default"       # template this config was built from
     model: str = "lstm_ad"          # model-zoo key for the scoring model
     model_config: Dict[str, Any] = field(default_factory=dict)
     microbatch: MicroBatchConfig = field(default_factory=MicroBatchConfig)
     max_streams: int = 4096         # window-state capacity (series slots)
     decoder: str = "json"
+    # opt-in to the instance-shared 'sitewhere/input/+' broker pattern; the
+    # tenant-scoped 'sitewhere/{tenant}/input/+' pattern is always active.
+    # With >1 tenant and no flag, shared-input routes to NO tenant (isolation)
+    shared_input: bool = False
 
 
 @dataclass(frozen=True)
@@ -97,9 +102,11 @@ TENANT_TEMPLATES: Dict[str, Dict[str, Any]] = {
 def tenant_config_from_template(
     tenant: str, template: str = "default", **overrides: Any
 ) -> TenantEngineConfig:
-    tpl = TENANT_TEMPLATES.get(template, TENANT_TEMPLATES["default"])
+    resolved = template if template in TENANT_TEMPLATES else "default"
+    tpl = TENANT_TEMPLATES[resolved]
     cfg = TenantEngineConfig(
         tenant=tenant,
+        template=resolved,  # record what was APPLIED, not what was asked for
         model=tpl["model"],
         model_config=dict(tpl["model_config"]),
     )

@@ -228,3 +228,35 @@ async def test_authority_enforcement_on_users():
             "/api/users", headers={"Authorization": f"Bearer {viewer_token}"}
         )
         assert resp.status == 403
+
+
+async def test_viewer_cannot_mutate():
+    """ADVICE r1 (medium): command/batch/schedule/entity mutations require
+    AUTH_DEVICE_MANAGE — a default viewer (ROLE_EVENT_VIEW) gets 403."""
+    async with client_ctx() as (client, inst):
+        resp = await client.post(
+            "/api/users",
+            json={"username": "viewer2", "password": "pw"},  # default: viewer
+        )
+        assert resp.status == 201
+        resp = await client.post(
+            "/api/authapi/jwt", json={"username": "viewer2", "password": "pw"}
+        )
+        vtok = (await resp.json())["token"]
+        vh = {"Authorization": f"Bearer {vtok}"}
+        cases = [
+            ("/api/assignments/any/invocations", {"command_token": "c"}),
+            ("/api/batch", {"command_token": "c"}),
+            ("/api/schedules", {"name": "s"}),
+            ("/api/areas", {"name": "a"}),
+            ("/api/zones", {"area_token": "a"}),
+            ("/api/assettypes", {"name": "t"}),
+            ("/api/assets", {"asset_type_token": "t"}),
+            ("/api/streams", {}),
+        ]
+        for path, body in cases:
+            resp = await client.post(path, json=body, headers=vh)
+            assert resp.status == 403, f"{path} not gated: {resp.status}"
+        # reads still allowed for the viewer
+        resp = await client.get("/api/devices", headers=vh)
+        assert resp.status == 200
