@@ -113,6 +113,19 @@ def transformer_block(
     return x
 
 
+def carry_zeros(shape, like: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Zero scan-carry that inherits ``like``'s varying-axis (vma) type.
+
+    Under ``shard_map`` with the varying-axis checker on, a plain
+    ``jnp.zeros`` carry is 'unvarying' and ``lax.scan`` rejects it against
+    a data-derived carry output. Adding ``0 * like[..0..]`` transfers the
+    data's vma without naming mesh axes, so models stay mesh-agnostic and
+    also run outside shard_map. ``like``'s leading dim must match
+    ``shape[0]`` (the batch dim)."""
+    z = (like.reshape(like.shape[0], -1)[:, :1] * 0).astype(dtype)
+    return jnp.zeros(shape, dtype) + z
+
+
 def normalize_windows(windows: jnp.ndarray, eps: float = 1e-6):
     """Per-row standardization of [..., W] windows → (normed, mu, sigma).
 

@@ -19,7 +19,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from sitewhere_tpu.models.common import Params, dense_init, normalize_windows
+from sitewhere_tpu.models.common import (
+    Params,
+    carry_zeros,
+    dense_init,
+    normalize_windows,
+)
 
 
 @dataclass(frozen=True)
@@ -80,7 +85,7 @@ def _encode(params: Params, normed: jnp.ndarray, dtype):
         h = _gru_step(params, h, x_t, dtype)
         return h, _emit(params, h, dtype)
 
-    h0 = jnp.zeros((b, params["wh"]["w"].shape[0]), dtype)
+    h0 = carry_zeros((b, params["wh"]["w"].shape[0]), normed, dtype)
     h_last, (mus, sigmas) = jax.lax.scan(step, h0, normed.T.astype(dtype))
     return h_last, mus.T, sigmas.T  # [B, T]
 

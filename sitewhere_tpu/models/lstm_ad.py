@@ -24,7 +24,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from sitewhere_tpu.models.common import Params, dense_init, normalize_windows
+from sitewhere_tpu.models.common import (
+    Params,
+    carry_zeros,
+    dense_init,
+    normalize_windows,
+)
 
 
 @dataclass(frozen=True)
@@ -66,8 +71,8 @@ def _lstm_scan(params: Params, xs: jnp.ndarray, dtype) -> jnp.ndarray:
         return (h, c), h
 
     init_carry = (
-        jnp.zeros((b, h_dim), dtype),
-        jnp.zeros((b, h_dim), dtype),
+        carry_zeros((b, h_dim), xs, dtype),
+        carry_zeros((b, h_dim), xs, dtype),
     )
     _, hs = jax.lax.scan(step, init_carry, xs.T.astype(dtype))
     return hs  # [T, B, H]

@@ -30,7 +30,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from sitewhere_tpu.models import ModelSpec
-from sitewhere_tpu.ops.windows import WindowState, init_window_state, update_and_gather
+from sitewhere_tpu.ops.windows import (
+    WindowState,
+    gather_windows,
+    init_window_state,
+    update_and_gather,
+)
 from sitewhere_tpu.parallel.mesh import AXIS_DATA, AXIS_TENANT, MeshManager
 
 Params = Any
@@ -149,9 +154,6 @@ class ShardedScorer:
                 P(AXIS_TENANT, AXIS_DATA),   # new state
                 P(AXIS_TENANT, AXIS_DATA),   # scores
             ),
-            # scan carries are zeros-initialized inside the mapped body;
-            # the varying-axis checker would demand pcasts on every carry
-            check_vma=False,
         )
         return jax.jit(smapped, donate_argnums=(1,))
 
@@ -179,8 +181,9 @@ class ShardedScorer:
         self.active = self.active.at[global_slot].set(False)
 
     def reset_slot(self, global_slot: int) -> None:
-        """Wipe a slot's window state + params back to pristine — a recycled
-        slot must not leak the previous tenant's history or trained weights."""
+        """Wipe a slot's window state + params + optimizer moments back to
+        pristine — a recycled slot must not leak the previous tenant's
+        history, trained weights, or Adam momentum."""
         self.deactivate(global_slot)
         self.params = set_slot(self.params, global_slot, self._base_params)
         self.state = WindowState(
@@ -188,6 +191,98 @@ class ShardedScorer:
             pos=self.state.pos.at[global_slot].set(0),
             count=self.state.count.at[global_slot].set(0),
         )
+        if getattr(self, "_opt_state", None) is not None:
+            self._opt_state = jax.tree_util.tree_map(
+                lambda s, f: s.at[global_slot].set(f.astype(s.dtype)),
+                self._opt_state,
+                self._fresh_opt,
+            )
 
     def slot_params(self, global_slot: int) -> Params:
         return unstack_slot(self.params, global_slot)
+
+    # -- training (per-tenant divergence) --------------------------------
+    def init_optimizer(self, optimizer) -> None:
+        """Attach an optax-style optimizer; opt state is stacked per slot
+        and sharded along the tenant axis like the params."""
+        self._optimizer = optimizer
+        opt_state = jax.vmap(optimizer.init)(self.params)
+        t_shard = self.mm.tenant_stacked()
+        self._opt_state = jax.device_put(opt_state, t_shard)
+        self._fresh_opt = optimizer.init(self._base_params)  # for reset_slot
+        self._train = self._build_train_step(optimizer)
+
+    def _build_train_step(self, optimizer) -> Callable:
+        """Train every slot on its RESIDENT window state — the windows
+        already live sharded on device, so training moves ZERO bytes over
+        host↔device; grads ride ICI via a single pmean over the data axis
+        (the one collective in the whole framework's steady state)."""
+        mesh = self.mm.mesh
+        spec, cfg, window = self.spec, self.cfg, self.window
+
+        def local_step(params, opt_state, values, pos, count, active):
+            # params/opt [T_loc, ...], values [T_loc, S_loc, W], active [T_loc]
+            def one(p, o, vals, ps, cnt, act):
+                st = WindowState(values=vals, pos=ps, count=cnt)
+                ids = jnp.arange(vals.shape[0], dtype=jnp.int32)
+                windows, n = gather_windows(st, ids)
+                # only streams with a full-enough history contribute; a
+                # masked per-row mean keeps cold/garbage windows out of the
+                # gradient and stays well-defined with 0 live streams
+                mask = (n >= jnp.minimum(window, 8)).astype(jnp.float32) * act
+                def masked_loss(pp):
+                    per_row = jax.vmap(
+                        lambda w: spec.loss(pp, cfg, w[None])
+                    )(windows)  # [S_loc]
+                    # psum numerator and denominator SEPARATELY across data
+                    # shards: a local mean + pmean would weight shards
+                    # equally regardless of how many live streams each holds
+                    num = jax.lax.psum((per_row * mask).sum(), AXIS_DATA)
+                    den = jnp.maximum(jax.lax.psum(mask.sum(), AXIS_DATA), 1.0)
+                    return num / den
+                l, grads = jax.value_and_grad(masked_loss)(p)
+                # masked_loss is already globally normalized, so the full
+                # gradient is the SUM of the shards' partials
+                grads = jax.lax.psum(grads, AXIS_DATA)
+                updates, o2 = optimizer.update(grads, o, p)
+                p2 = jax.tree_util.tree_map(
+                    lambda a, u: (a + u).astype(a.dtype), p, updates
+                )
+                # inactive slots keep pristine params AND optimizer state
+                # (an advancing Adam step count would skew bias correction
+                # when the slot later activates)
+                p2 = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(act > 0, new, old), p2, p
+                )
+                o2 = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(act > 0, new, old), o2, o
+                )
+                return p2, o2, l
+            act_f = active.astype(jnp.float32)
+            return jax.vmap(one)(params, opt_state, values, pos, count, act_f)
+
+        smapped = jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(
+                P(AXIS_TENANT),              # params
+                P(AXIS_TENANT),              # opt state
+                P(AXIS_TENANT, AXIS_DATA),   # window values [T, S, W]
+                P(AXIS_TENANT, AXIS_DATA),   # pos
+                P(AXIS_TENANT, AXIS_DATA),   # count
+                P(AXIS_TENANT),              # active mask
+            ),
+            out_specs=(P(AXIS_TENANT), P(AXIS_TENANT), P(AXIS_TENANT)),
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1))
+
+    def train_resident(self) -> jnp.ndarray:
+        """One optimizer step for every active slot on its resident window
+        state; returns per-slot loss f32[T]. Call ``init_optimizer`` first."""
+        if getattr(self, "_train", None) is None:
+            raise RuntimeError("call init_optimizer(optax_optimizer) first")
+        self.params, self._opt_state, losses = self._train(
+            self.params, self._opt_state,
+            self.state.values, self.state.pos, self.state.count, self.active,
+        )
+        return losses
