@@ -19,8 +19,9 @@ the on-device window state (``ops.windows``). Object-shaped events
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
-from typing import Iterable, List, Optional, Sequence
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -46,6 +47,16 @@ class MeasurementBatch:
     event_ids: Optional[np.ndarray] = None     # object [n]
     device_tokens: Optional[np.ndarray] = None  # object [n]
     names: Optional[np.ndarray] = None          # object [n]
+    # enrichment columns (inbound-processing) + scoring output
+    assignment_tokens: Optional[np.ndarray] = None  # object [n]
+    area_tokens: Optional[np.ndarray] = None        # object [n]
+    scores: Optional[np.ndarray] = None             # float32 [n], NaN=unscored
+    # batch-level trace marks (stage → epoch ms) — the columnar analog of
+    # DeviceEvent.trace for p99 accounting
+    trace: Dict[str, float] = field(default_factory=dict)
+
+    def mark(self, stage: str) -> None:
+        self.trace[stage] = time.time() * 1000.0
 
     @property
     def n(self) -> int:
@@ -54,6 +65,9 @@ class MeasurementBatch:
     @property
     def n_valid(self) -> int:
         return int(self.valid.sum())
+
+    OBJ_COLS = ("event_ids", "device_tokens", "names",
+                "assignment_tokens", "area_tokens")
 
     @staticmethod
     def empty(tenant: str = "default") -> "MeasurementBatch":
@@ -65,6 +79,126 @@ class MeasurementBatch:
             received_ts=np.zeros((0,), np.float64),
             valid=np.zeros((0,), bool),
         )
+
+    @staticmethod
+    def from_requests(
+        tenant: str,
+        reqs: Sequence[dict],
+    ) -> "MeasurementBatch":
+        """Build from decoded measurement request dicts (the event-source
+        fast path). Event ids are batch-prefixed sequences — one uuid per
+        BATCH, not per row (uuid4 per row would dominate the decode loop)."""
+        n = len(reqs)
+        prefix = uuid.uuid4().hex[:16]
+        now = time.time() * 1000.0
+        # ONE pass over the dicts (not one per column) — this runs at the
+        # full ingest rate
+        values = np.empty((n,), np.float32)
+        event_ts = np.empty((n,), np.float64)
+        received_ts = np.empty((n,), np.float64)
+        event_ids = np.empty((n,), object)
+        device_tokens = np.empty((n,), object)
+        names = np.empty((n,), object)
+        for i, r in enumerate(reqs):
+            get = r.get
+            values[i] = get("value", 0.0)
+            event_ts[i] = get("event_ts", now)
+            received_ts[i] = get("received_ts", now)
+            event_ids[i] = get("id") or f"{prefix}-{i:06d}"
+            device_tokens[i] = get("device_token", "")
+            names[i] = get("name", "")
+        return MeasurementBatch(
+            tenant=tenant,
+            stream_ids=np.zeros((n,), np.int32),  # assigned by tpu-inference
+            values=values,
+            event_ts=event_ts,
+            received_ts=received_ts,
+            valid=np.ones((n,), bool),
+            event_ids=event_ids,
+            device_tokens=device_tokens,
+            names=names,
+        )
+
+    @staticmethod
+    def from_columns(
+        tenant: str,
+        device_tokens: list,
+        names: list,
+        values: list,
+        event_ts: list,
+        received_ms: Optional[float] = None,
+    ) -> "MeasurementBatch":
+        """Build straight from decoder column lists — the zero-dict ingest
+        path. ``event_ts`` entries of 0 mean 'now'."""
+        n = len(values)
+        now = received_ms if received_ms is not None else time.time() * 1000.0
+        ets = np.asarray(event_ts, np.float64)
+        if (ets == 0).any():
+            ets = np.where(ets == 0, now, ets)
+        prefix = uuid.uuid4().hex[:16]
+        return MeasurementBatch(
+            tenant=tenant,
+            stream_ids=np.zeros((n,), np.int32),
+            values=np.asarray(values, np.float32),
+            event_ts=ets,
+            received_ts=np.full((n,), now, np.float64),
+            valid=np.ones((n,), bool),
+            event_ids=np.asarray(
+                [f"{prefix}-{i:06d}" for i in range(n)], object
+            ),
+            device_tokens=np.asarray(device_tokens, object),
+            names=np.asarray(names, object),
+        )
+
+    def select(self, idx: np.ndarray) -> "MeasurementBatch":
+        """Row subset (fancy index or bool mask) carrying every column."""
+        def cut(a):
+            return None if a is None else a[idx]
+
+        return MeasurementBatch(
+            tenant=self.tenant,
+            stream_ids=self.stream_ids[idx],
+            values=self.values[idx],
+            event_ts=self.event_ts[idx],
+            received_ts=self.received_ts[idx],
+            valid=self.valid[idx],
+            event_ids=cut(self.event_ids),
+            device_tokens=cut(self.device_tokens),
+            names=cut(self.names),
+            assignment_tokens=cut(self.assignment_tokens),
+            area_tokens=cut(self.area_tokens),
+            scores=cut(self.scores),
+            trace=dict(self.trace),
+        )
+
+    def to_events(self) -> List[DeviceMeasurement]:
+        """Materialize rows as edge objects (REST/conn/rules slow path)."""
+        out: List[DeviceMeasurement] = []
+        ids = self.event_ids
+        toks = self.device_tokens
+        names = self.names
+        asg = self.assignment_tokens
+        areas = self.area_tokens
+        sc = self.scores
+        for i in range(self.n):
+            if not self.valid[i]:
+                continue
+            score = None
+            if sc is not None and not np.isnan(sc[i]):
+                score = float(sc[i])
+            out.append(DeviceMeasurement(
+                id=str(ids[i]) if ids is not None else "",
+                device_token=str(toks[i]) if toks is not None else "",
+                assignment_token=str(asg[i]) if asg is not None else "",
+                area_token=str(areas[i]) if areas is not None else "",
+                tenant=self.tenant,
+                name=str(names[i]) if names is not None else "",
+                value=float(self.values[i]),
+                score=score,
+                event_ts=int(self.event_ts[i]),
+                received_ts=int(self.received_ts[i]),
+            ))
+        return out
 
     @staticmethod
     def from_arrays(
@@ -109,17 +243,16 @@ class MeasurementBatch:
         bs: List[MeasurementBatch] = [b for b in batches if b.n]
         if not bs:
             return MeasurementBatch.empty()
-        any_obj = any(b.event_ids is not None for b in bs)
 
-        def _cat_obj(col: str) -> Optional[np.ndarray]:
-            # preserve identity columns row-aligned even when some inputs
-            # lack them (those rows get ""), rather than dropping the column
-            if not any_obj:
+        def _cat_opt(col: str, fill, dtype) -> Optional[np.ndarray]:
+            # preserve optional columns row-aligned even when some inputs
+            # lack them (those rows get the fill), rather than dropping them
+            if not any(getattr(b, col) is not None for b in bs):
                 return None
             parts = []
             for b in bs:
                 a = getattr(b, col)
-                parts.append(a if a is not None else np.full((b.n,), "", object))
+                parts.append(a if a is not None else np.full((b.n,), fill, dtype))
             return np.concatenate(parts)
 
         return MeasurementBatch(
@@ -129,9 +262,8 @@ class MeasurementBatch:
             event_ts=np.concatenate([b.event_ts for b in bs]),
             received_ts=np.concatenate([b.received_ts for b in bs]),
             valid=np.concatenate([b.valid for b in bs]),
-            event_ids=_cat_obj("event_ids"),
-            device_tokens=_cat_obj("device_tokens"),
-            names=_cat_obj("names"),
+            scores=_cat_opt("scores", np.nan, np.float32),
+            **{c: _cat_opt(c, "", object) for c in MeasurementBatch.OBJ_COLS},
         )
 
     def pad_to(self, size: int) -> "MeasurementBatch":
@@ -151,10 +283,10 @@ class MeasurementBatch:
         def _pad(a: np.ndarray, fill: float = 0.0) -> np.ndarray:
             return np.concatenate([a, np.full((pad,), fill, a.dtype)])
 
-        def _pad_obj(a: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        def _pad_opt(a: Optional[np.ndarray], fill, dtype) -> Optional[np.ndarray]:
             if a is None:
                 return None
-            return np.concatenate([a, np.full((pad,), "", object)])
+            return np.concatenate([a, np.full((pad,), fill, dtype)])
 
         return MeasurementBatch(
             tenant=self.tenant,
@@ -163,37 +295,14 @@ class MeasurementBatch:
             event_ts=_pad(self.event_ts),
             received_ts=_pad(self.received_ts),
             valid=np.concatenate([self.valid, np.zeros((pad,), bool)]),
-            event_ids=_pad_obj(self.event_ids),
-            device_tokens=_pad_obj(self.device_tokens),
-            names=_pad_obj(self.names),
+            scores=_pad_opt(self.scores, np.nan, np.float32),
+            trace=dict(self.trace),
+            **{
+                c: _pad_opt(getattr(self, c), "", object)
+                for c in self.OBJ_COLS
+            },
         )
 
     def take(self, n: int) -> "tuple[MeasurementBatch, MeasurementBatch]":
         """Split into (first n rows, rest) — used by the micro-batcher."""
-
-        def cut(a: Optional[np.ndarray], lo: int, hi: Optional[int]) -> Optional[np.ndarray]:
-            return None if a is None else a[lo:hi]
-
-        head = MeasurementBatch(
-            tenant=self.tenant,
-            stream_ids=self.stream_ids[:n],
-            values=self.values[:n],
-            event_ts=self.event_ts[:n],
-            received_ts=self.received_ts[:n],
-            valid=self.valid[:n],
-            event_ids=cut(self.event_ids, 0, n),
-            device_tokens=cut(self.device_tokens, 0, n),
-            names=cut(self.names, 0, n),
-        )
-        tail = MeasurementBatch(
-            tenant=self.tenant,
-            stream_ids=self.stream_ids[n:],
-            values=self.values[n:],
-            event_ts=self.event_ts[n:],
-            received_ts=self.received_ts[n:],
-            valid=self.valid[n:],
-            event_ids=cut(self.event_ids, n, None),
-            device_tokens=cut(self.device_tokens, n, None),
-            names=cut(self.names, n, None),
-        )
-        return head, tail
+        return self.select(np.s_[:n]), self.select(np.s_[n:])

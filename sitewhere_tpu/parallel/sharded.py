@@ -157,6 +157,20 @@ class ShardedScorer:
         )
         return jax.jit(smapped, donate_argnums=(1,))
 
+    def prewarm(self, lane_sizes) -> None:
+        """Compile every bucketed batch shape up front. A first-use compile
+        inside the scoring loop blocks the event loop for seconds (tens of
+        seconds on TPU) and torpedoes p99 — pay it at startup instead.
+        All-invalid rows leave window state untouched (scatter mode=drop)."""
+        import numpy as _np
+
+        t, d = self.n_slots, self.mm.n_data_shards
+        for b in sorted(set(int(x) for x in lane_sizes)):
+            ids = _np.zeros((t, d * b), _np.int32)
+            vals = _np.zeros((t, d * b), _np.float32)
+            valid = _np.zeros((t, d * b), bool)
+            _np.asarray(self.step(ids, vals, valid))
+
     def step(
         self,
         stream_ids: jnp.ndarray,  # i32[T, B] LOCAL ids per data shard lane

@@ -79,6 +79,62 @@ class JsonDecoder:
                 r.setdefault("device_token", context["device_token"])
         return reqs
 
+    def decode_any(self, payload: bytes, context=None):
+        """ONE parse, two possible shapes: ``("columns", (toks, names,
+        vals, ets))`` for pure-measurement payloads (no per-row dicts), or
+        ``("requests", [dict, ...])`` for everything else. Payloads with
+        client-supplied ids always take the request path so the
+        Deduplicator sees them."""
+        try:
+            obj = json.loads(payload)
+        except json.JSONDecodeError as exc:
+            raise DecodeError(f"bad JSON payload: {exc}") from exc
+        cols = self._columns_from_obj(obj, context)
+        if cols is not None:
+            return "columns", cols
+        reqs = _as_requests(obj)
+        if context and context.get("device_token"):
+            for r in reqs:
+                r.setdefault("device_token", context["device_token"])
+        return "requests", reqs
+
+    @staticmethod
+    def _columns_from_obj(obj, context):
+        if not isinstance(obj, dict):
+            return None
+        events = obj.get("events")
+        if isinstance(events, list):
+            device = obj.get("device") or obj.get("device_token") or (
+                context.get("device_token", "") if context else ""
+            )
+            try:
+                # C-driven comprehensions; `+ 0.0` rejects non-numeric
+                # values here (TypeError) instead of crashing the batch
+                # build later; any odd shape falls back to the general path
+                vals = [e["value"] + 0.0 for e in events]
+                names = [e.get("name", "") for e in events]
+                toks = [e.get("device_token") or device for e in events]
+                ets = [e.get("event_ts", 0) + 0.0 for e in events]
+            except (KeyError, TypeError):
+                return None
+            if any(
+                e.get("type", "measurement") != "measurement" or "id" in e
+                for e in events
+            ):
+                return None
+            return toks, names, vals, ets
+        if obj.get("type", "measurement") == "measurement" and "id" not in obj:
+            try:
+                val = obj["value"] + 0.0
+                ets = obj.get("event_ts", 0) + 0.0
+            except (KeyError, TypeError):
+                return None
+            tok = obj.get("device_token") or (
+                context.get("device_token", "") if context else ""
+            )
+            return [tok], [obj.get("name", "")], [val], [ets]
+        return None
+
 
 # -- binary format --------------------------------------------------------
 # Header: magic u16 = 0x5754 ("TW"), version u8, msg_type u8,

@@ -5,35 +5,42 @@ inbound-processing and event-management on the bus, micro-batching
 DeviceMeasurement events into JAX/XLA pjit calls on a TPU pod"
 (BASELINE.json north_star; no reference counterpart — SURVEY.md §2.3).
 
-Dataflow per scoring cycle:
+Dataflow per scoring cycle (columnar hot path):
 
-  inbound-events[tenant_i] ─┐   (async poll, all active tenants)
-  inbound-events[tenant_j] ─┼→ lanes[(slot, data_shard)] pending queues
+  inbound-events[tenant_i] ─┐  MeasurementBatch (struct-of-arrays)
+  inbound-events[tenant_j] ─┼→ lanes[(slot, data_shard)]: numpy chunks
           ...              ─┘        │ flush on deadline_ms OR full bucket
                                      ▼
               stacked arrays i32/f32[T, D·B] (bucketed static shapes)
                                      ▼
               ShardedScorer.step  — ONE jit call scores every tenant
+                                     ▼ (dispatch is async; materialization
+                                        happens OFF the scoring loop)
+              scores scatter back into each batch's ``scores`` column
                                      ▼
-              scores → events (score field) → tpu-scored-events[tenant]
+              completed batches → tpu-scored-events[tenant]
 
-Latency accounting is first-class (the p99 < 50 ms budget, BASELINE.json:5):
-each event carries trace marks; the ``tpu_inference.latency`` histogram
-records received→scored wall time.
+Two latency-hiding moves matter here (SURVEY.md §7 hard parts):
+- the host side never touches per-event Python objects — rows move as
+  numpy slices end to end;
+- score materialization (device→host) is pipelined: up to
+  ``max_inflight`` flushes ride concurrently, so one device round-trip
+  never stalls the collect loop. p99 still lands in the
+  ``tpu_inference.latency`` histogram per row.
 
 Tenant start/stop flips the scorer's active mask — no recompile; batch-size
-buckets keep XLA at a handful of compiled shapes (SURVEY.md §7 hard parts).
+buckets keep XLA at a handful of compiled shapes.
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
-from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sitewhere_tpu.core.batch import MeasurementBatch
 from sitewhere_tpu.core.events import DeviceMeasurement
 from sitewhere_tpu.models import get_model, make_config
 from sitewhere_tpu.parallel.mesh import MeshManager
@@ -80,6 +87,58 @@ class StreamRegistry:
         return len(self._map)
 
 
+class _Lane:
+    """Pending rows for one (slot, data_shard): parallel numpy chunks."""
+
+    __slots__ = ("ids", "vals", "seqs", "rows", "count")
+
+    def __init__(self) -> None:
+        self.ids: List[np.ndarray] = []    # int32 local stream ids
+        self.vals: List[np.ndarray] = []   # float32 values
+        self.seqs: List[np.ndarray] = []   # int64 batch sequence numbers
+        self.rows: List[np.ndarray] = []   # int32 row index inside the batch
+        self.count = 0
+
+    def append(self, ids, vals, seqs, rows) -> None:
+        self.ids.append(ids)
+        self.vals.append(vals)
+        self.seqs.append(seqs)
+        self.rows.append(rows)
+        self.count += len(ids)
+
+    def pop(self, n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Take up to n rows off the front (FIFO across chunks)."""
+        take_i, take_v, take_s, take_r = [], [], [], []
+        got = 0
+        while got < n and self.ids:
+            head = self.ids[0]
+            need = n - got
+            if len(head) <= need:
+                take_i.append(self.ids.pop(0))
+                take_v.append(self.vals.pop(0))
+                take_s.append(self.seqs.pop(0))
+                take_r.append(self.rows.pop(0))
+                got += len(head)
+            else:
+                take_i.append(head[:need])
+                take_v.append(self.vals[0][:need])
+                take_s.append(self.seqs[0][:need])
+                take_r.append(self.rows[0][:need])
+                self.ids[0] = head[need:]
+                self.vals[0] = self.vals[0][need:]
+                self.seqs[0] = self.seqs[0][need:]
+                self.rows[0] = self.rows[0][need:]
+                got = n
+        self.count -= got
+        cat = np.concatenate
+        return (
+            cat(take_i) if take_i else np.zeros(0, np.int32),
+            cat(take_v) if take_v else np.zeros(0, np.float32),
+            cat(take_s) if take_s else np.zeros(0, np.int64),
+            cat(take_r) if take_r else np.zeros(0, np.int32),
+        )
+
+
 class TpuInferenceEngine(TenantEngine):
     """Per-tenant engine: placement on the mesh + stream registry."""
 
@@ -108,23 +167,22 @@ class TpuInferenceEngine(TenantEngine):
                 # full wipe: a recycled slot must not leak this tenant's
                 # window history or params to the next occupant
                 scorer.reset_slot(slot)
-            # drain pending lanes keyed by the freed slot: a later flush
-            # must not zero-score stale events into the removed tenant's
-            # topic. The bus cursor already advanced past these events, so
-            # dropping them would lose them from the store on every tenant
-            # restart — publish them unscored (passthrough) instead.
+            # drain pending lanes keyed by the freed slot: the bus cursor
+            # already advanced past these rows, so dropping them would lose
+            # them from the store on every tenant restart — resolve them
+            # unscored (NaN) instead
             lanes = svc._lanes.get(self.config.model)
             if lanes is not None:
                 drained = svc.metrics.counter("tpu_inference.drained_on_stop")
-                topic = svc.bus.naming.scored_events(self.tenant)
                 for key in [k for k in lanes if k[0] == slot]:
-                    for _local_id, _value, ev in lanes.pop(key):
-                        ev.mark("passthrough_stop")
-                        # non-blocking: at instance shutdown the scored-topic
-                        # consumer is already stopped, so an awaitable publish
-                        # against a full topic would never unblock
-                        svc.bus.publish_nowait(topic, ev)
-                        drained.inc()
+                    lane = lanes.pop(key)
+                    n = lane.count
+                    if n:
+                        _ids, _vals, seqs, rows = lane.pop(n)
+                        await svc._resolve_rows(
+                            seqs, rows, None, publish_nowait=True
+                        )
+                        drained.inc(n)
             svc.router.remove(self.tenant)
             self.placement = None
 
@@ -138,20 +196,24 @@ class TpuInferenceService(MultitenantService):
         mm: Optional[MeshManager] = None,
         metrics: Optional[MetricsRegistry] = None,
         slots_per_shard: int = 8,
-        poll_batch: int = 8192,
+        poll_batch: int = 64,
+        max_inflight: int = 4,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
         self.metrics = metrics or MetricsRegistry()
         self.slots_per_shard = slots_per_shard
-        self.poll_batch = poll_batch
+        self.poll_batch = poll_batch  # bus items (batches) per poll
         self.router = TenantRouter(self.mm.n_tenant_shards, slots_per_shard)
         self.scorers: Dict[str, ShardedScorer] = {}
-        # pending measurement lanes: family → (slot, dshard) → deque of
-        # (local_id, value, event)
-        self._lanes: Dict[str, Dict[Tuple[int, int], Deque]] = {}
+        self._lanes: Dict[str, Dict[Tuple[int, int], _Lane]] = {}
         self._first_pending_ts: Dict[str, float] = {}
         self._loop_task: Optional[asyncio.Task] = None
+        # batch registry: seq → [batch, rows_awaiting_scores]
+        self._batches: Dict[int, list] = {}
+        self._next_seq = 0
+        self._inflight = asyncio.Semaphore(max_inflight)
+        self._deliver_tasks: set = set()
 
     @property
     def group(self) -> str:
@@ -189,31 +251,113 @@ class TpuInferenceService(MultitenantService):
     async def on_stop(self) -> None:
         await cancel_and_wait(self._loop_task)
         self._loop_task = None
+        # let in-flight deliveries finish (they hold rows already popped
+        # from lanes — cancelling would strand their batches unpublished);
+        # only force-cancel if the device never comes back
+        if self._deliver_tasks:
+            _done, pending = await asyncio.wait(
+                list(self._deliver_tasks), timeout=10.0
+            )
+            for t in pending:
+                await cancel_and_wait(t)
 
-    # -- ingestion → lanes ----------------------------------------------
-    def _enqueue(self, engine: TpuInferenceEngine, events: List) -> List:
-        """Route a tenant's polled events into scoring lanes; returns the
-        pass-through events (non-measurements / over-capacity streams)."""
+    # -- ingestion → lanes (columnar) ------------------------------------
+    async def _enqueue_batch(self, engine: TpuInferenceEngine, batch: MeasurementBatch) -> None:
+        """Route a MeasurementBatch's rows into scoring lanes. Rows that
+        can't get a stream slot resolve immediately as unscored."""
         family = engine.config.model
         lanes = self._lanes[family]
         slot = self.router.global_slot(engine.placement)
-        passthrough = []
-        skipped = self.metrics.counter("tpu_inference.skipped_capacity")
-        for ev in events:
-            if not isinstance(ev, DeviceMeasurement):
-                passthrough.append(ev)
-                continue
-            assigned = engine.streams.lookup_or_assign(ev.device_token, ev.name)
+        n = batch.n
+        if batch.scores is None:
+            batch.scores = np.full((n,), np.nan, np.float32)
+        seq = self._next_seq
+        self._next_seq += 1
+        entry = [batch, n]
+        self._batches[seq] = entry
+
+        # per-row (dshard, local_id) via the registry; the dict lookup runs
+        # in a C-level zip loop — no event objects, no awaits
+        lookup = engine.streams.lookup_or_assign
+        dshards = np.empty((n,), np.int32)
+        locals_ = np.empty((n,), np.int32)
+        toks = batch.device_tokens.tolist()
+        names = batch.names.tolist()
+        skipped = 0
+        for i, (tok, nm) in enumerate(zip(toks, names)):
+            assigned = lookup(tok, nm)
             if assigned is None:
-                skipped.inc()
-                passthrough.append(ev)
+                dshards[i] = -1
+                locals_[i] = 0
+                skipped += 1
+            else:
+                dshards[i], locals_[i] = assigned[0], assigned[1]
+        if skipped:
+            self.metrics.counter("tpu_inference.skipped_capacity").inc(skipped)
+            entry[1] -= skipped
+            if entry[1] <= 0:
+                await self._publish_batch(seq)
+        rows_all = np.arange(n, dtype=np.int32)
+        seqs_all = np.full((n,), seq, np.int64)
+        for d in range(self.mm.n_data_shards):
+            sel = np.nonzero(dshards == d)[0]
+            if sel.size == 0:
                 continue
-            dshard, local_id = assigned
-            lane = lanes.setdefault((slot, dshard), deque())
-            lane.append((local_id, ev.value, ev))
-            if family not in self._first_pending_ts:
-                self._first_pending_ts[family] = time.monotonic()
-        return passthrough
+            lane = lanes.get((slot, d))
+            if lane is None:
+                lane = lanes[(slot, d)] = _Lane()
+            lane.append(
+                locals_[sel], batch.values[sel], seqs_all[sel], rows_all[sel]
+            )
+        if family not in self._first_pending_ts:
+            self._first_pending_ts[family] = time.monotonic()
+
+    # -- score write-back -------------------------------------------------
+    async def _resolve_rows(
+        self,
+        seqs: np.ndarray,
+        rows: np.ndarray,
+        scores: Optional[np.ndarray],
+        publish_nowait: bool = False,
+    ) -> List[int]:
+        """Scatter scores (or NaN) into their batches; returns seqs whose
+        batches became complete (and publishes them)."""
+        done: List[int] = []
+        for s in np.unique(seqs):
+            entry = self._batches.get(int(s))
+            if entry is None:
+                continue
+            mask = seqs == s
+            if scores is not None:
+                entry[0].scores[rows[mask]] = scores[mask]
+            entry[1] -= int(mask.sum())
+            if entry[1] <= 0:
+                done.append(int(s))
+        for s in done:
+            await self._publish_batch(s, nowait=publish_nowait)
+        return done
+
+    async def _publish_batch(self, seq: int, nowait: bool = False) -> None:
+        batch, _ = self._batches.pop(seq)
+        batch.mark("scored")
+        topic = self.bus.naming.scored_events(batch.tenant)
+        if nowait:
+            # teardown path: the consumer may already be stopped; an
+            # awaitable publish against a full topic would never unblock
+            self.bus.publish_nowait(topic, batch)
+        else:
+            # normal path: preserve backpressure toward persistence — a
+            # lagging store slows scoring instead of silently evicting
+            # whole batches past retention
+            await self.bus.publish(topic, batch)
+        # latency accounting: sample rows (full per-row recording would be
+        # a Python loop over 10^5 rows/s)
+        lat = self.metrics.histogram("tpu_inference.latency", unit="s")
+        now = time.time() * 1000.0
+        rts = batch.received_ts[:: max(1, batch.n // 16)]
+        lat.record_many(((now - rts) / 1000.0).tolist())
+        self.metrics.counter("tpu_inference.scored_total").inc(batch.n)
+        self.metrics.meter("tpu_inference.scored").mark(batch.n)
 
     # -- flush -----------------------------------------------------------
     def _pick_bucket(self, need: int, buckets: Tuple[int, ...], max_batch: int) -> int:
@@ -223,14 +367,14 @@ class TpuInferenceService(MultitenantService):
         return max_batch
 
     async def _flush_family(self, engine_cfgs: Dict[int, TenantEngineConfig], family: str) -> int:
-        """Build the stacked batch for one family and run the jit step."""
+        """Build the stacked batch for one family, dispatch the jit step,
+        and hand score materialization to a pipelined delivery task."""
         scorer = self.scorers[family]
         lanes = self._lanes[family]
-        pending_max = max((len(q) for q in lanes.values()), default=0)
+        pending_max = max((l.count for l in lanes.values()), default=0)
         if pending_max == 0:
             self._first_pending_ts.pop(family, None)
             return 0
-        # all engines of one family share microbatch config by construction
         any_cfg = next(iter(engine_cfgs.values()))
         mb = any_cfg.microbatch
         b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
@@ -238,49 +382,85 @@ class TpuInferenceService(MultitenantService):
         ids = np.zeros((t, d * b_lane), np.int32)
         vals = np.zeros((t, d * b_lane), np.float32)
         valid = np.zeros((t, d * b_lane), bool)
-        taken: List[Tuple[int, int, object]] = []  # (slot, col, event)
-        for (slot, dshard), q in lanes.items():
+        tk_slots, tk_cols, tk_seqs, tk_rows = [], [], [], []
+        moved = 0
+        for (slot, dshard), lane in list(lanes.items()):
+            if lane.count == 0:
+                continue
+            li, lv, ls, lr = lane.pop(b_lane)
+            k = len(li)
             base = dshard * b_lane
-            for i in range(min(len(q), b_lane)):
-                local_id, value, ev = q.popleft()
-                col = base + i
-                ids[slot, col] = local_id
-                vals[slot, col] = value
-                valid[slot, col] = True
-                taken.append((slot, col, ev))
-        if any(q for q in lanes.values()):
+            ids[slot, base : base + k] = li
+            vals[slot, base : base + k] = lv
+            valid[slot, base : base + k] = True
+            tk_slots.append(np.full((k,), slot, np.int32))
+            tk_cols.append(np.arange(base, base + k, dtype=np.int32))
+            tk_seqs.append(ls)
+            tk_rows.append(lr)
+            moved += k
+        if any(l.count for l in lanes.values()):
             self._first_pending_ts[family] = time.monotonic()
         else:
             self._first_pending_ts.pop(family, None)
+        if moved == 0:
+            return 0
 
-        scores = scorer.step(ids, vals, valid)
-        # device→host sync off the event loop (jax dispatch is async until
-        # materialization; don't stall other tenants' polling on it)
-        scores_np = await asyncio.get_running_loop().run_in_executor(
-            None, np.asarray, scores
+        # backpressure: bounded number of flushes in flight at once
+        await self._inflight.acquire()
+        scores_dev = scorer.step(ids, vals, valid)  # async dispatch
+        taken = (
+            np.concatenate(tk_slots),
+            np.concatenate(tk_cols),
+            np.concatenate(tk_seqs),
+            np.concatenate(tk_rows),
         )
+        task = asyncio.create_task(
+            self._deliver(scores_dev, taken), name=f"tpu-deliver-{family}"
+        )
+        self._deliver_tasks.add(task)
+        task.add_done_callback(self._deliver_tasks.discard)
+        return moved
 
-        latency = self.metrics.histogram("tpu_inference.latency", unit="s")
-        meter = self.metrics.meter("tpu_inference.scored")
-        now = time.time() * 1000.0
-        scored_ctr = self.metrics.counter("tpu_inference.scored_total")
-        by_tenant: Dict[str, List] = {}
-        for slot, col, ev in taken:
-            ev.score = float(scores_np[slot, col])
-            ev.mark("scored")
-            latency.record(max(now - ev.received_ts, 0.0) / 1000.0)
-            by_tenant.setdefault(ev.tenant, []).append(ev)
-        for tenant, evs in by_tenant.items():
-            topic = self.bus.naming.scored_events(tenant)
-            for ev in evs:
-                await self.bus.publish(topic, ev)
-        meter.mark(len(taken))
-        scored_ctr.inc(len(taken))
-        return len(taken)
+    async def _deliver(self, scores_dev, taken) -> None:
+        """Materialize one flush's scores off the loop and resolve rows."""
+        try:
+            scores_np = await asyncio.get_running_loop().run_in_executor(
+                None, np.asarray, scores_dev
+            )
+            slots, cols, seqs, rows = taken
+            await self._resolve_rows(seqs, rows, scores_np[slots, cols])
+        except asyncio.CancelledError:
+            # cancelled mid-flight (forced teardown): the rows were already
+            # popped from lanes, so resolve them unscored or they're lost
+            _, _, seqs, rows = taken
+            await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+            raise
+        except Exception as exc:  # noqa: BLE001 - a failed materialization
+            # must not strand the batches: resolve rows unscored
+            self._record_error("deliver", exc)
+            _, _, seqs, rows = taken
+            await self._resolve_rows(seqs, rows, None)
+        finally:
+            self._inflight.release()
 
-    def _deadline_reached(self, family: str, deadline_ms: float) -> bool:
-        first = self._first_pending_ts.get(family)
-        return first is not None and (time.monotonic() - first) * 1000.0 >= deadline_ms
+    # -- legacy object path (low-volume / tests) --------------------------
+    async def _enqueue_events(self, engine: TpuInferenceEngine, events: List) -> List:
+        """Object events: wrap measurements into a single-row batch each is
+        wasteful — instead convert the poll's measurements into one batch."""
+        measurements = [e for e in events if isinstance(e, DeviceMeasurement)]
+        passthrough = [e for e in events if not isinstance(e, DeviceMeasurement)]
+        if measurements:
+            batch = MeasurementBatch.from_events(
+                measurements, [0] * len(measurements), tenant=engine.tenant
+            )
+            batch.assignment_tokens = np.asarray(
+                [e.assignment_token for e in measurements], object
+            )
+            batch.area_tokens = np.asarray(
+                [e.area_token for e in measurements], object
+            )
+            await self._enqueue_batch(engine, batch)
+        return passthrough
 
     # -- main loop -------------------------------------------------------
     async def _scoring_loop(self) -> None:
@@ -291,7 +471,7 @@ class TpuInferenceService(MultitenantService):
                 if engine.state is not LifecycleState.STARTED:
                     continue
                 assert isinstance(engine, TpuInferenceEngine)
-                events = await self.bus.consume(
+                items = await self.bus.consume(
                     self.bus.naming.inbound_events(tenant),
                     self.group,
                     self.poll_batch,
@@ -300,22 +480,46 @@ class TpuInferenceService(MultitenantService):
                 fam_cfgs.setdefault(engine.config.model, {})[
                     self.router.global_slot(engine.placement)
                 ] = engine.config
-                if events:
-                    passthrough = self._enqueue(engine, events)
+                if not items:
+                    continue
+                batches = [i for i in items if isinstance(i, MeasurementBatch)]
+                objects = [i for i in items if not isinstance(i, MeasurementBatch)]
+                for b in batches:
+                    await self._enqueue_batch(engine, b)
+                    moved += b.n
+                if objects:
+                    passthrough = await self._enqueue_events(engine, objects)
                     topic = self.bus.naming.scored_events(tenant)
                     for ev in passthrough:
                         await self.bus.publish(topic, ev)
-                    moved += len(events)
+                    moved += len(objects)
             for family, cfgs in fam_cfgs.items():
                 if family not in self.scorers:
                     continue
                 mb = next(iter(cfgs.values())).microbatch
                 lanes = self._lanes[family]
-                full = any(len(q) >= mb.max_batch for q in lanes.values())
+                full = any(l.count >= mb.max_batch for l in lanes.values())
                 if full or self._deadline_reached(family, mb.deadline_ms):
                     moved += await self._flush_family(cfgs, family)
             if moved == 0:
                 await asyncio.sleep(0.001)
+
+    def _deadline_reached(self, family: str, deadline_ms: float) -> bool:
+        first = self._first_pending_ts.get(family)
+        return first is not None and (time.monotonic() - first) * 1000.0 >= deadline_ms
+
+    def prewarm(self) -> None:
+        """Compile every active family's bucket shapes (see
+        ShardedScorer.prewarm). Call after tenants are added, before
+        latency-sensitive traffic."""
+        for tenant, engine in self.engines.items():
+            assert isinstance(engine, TpuInferenceEngine)
+            scorer = self.scorers.get(engine.config.model)
+            if scorer is None:
+                continue
+            mb = engine.config.microbatch
+            sizes = [min(b, mb.max_batch) for b in mb.buckets] + [mb.max_batch]
+            scorer.prewarm(sizes)
 
     # -- introspection ---------------------------------------------------
     def describe(self) -> dict:

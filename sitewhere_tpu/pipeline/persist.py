@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
+from sitewhere_tpu.core.batch import MeasurementBatch
 from sitewhere_tpu.runtime.bus import EventBus
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
@@ -53,8 +54,15 @@ class EventPersistence(LifecycleComponent):
         out = self.bus.naming.persisted_events(self.tenant)
         persisted = self.metrics.counter("event_management.persisted")
         while True:
-            events = await self.bus.consume(src, self.group, self.poll_batch)
-            self.store.add_events(events)
-            persisted.inc(len(events))
-            for e in events:
-                await self.bus.publish(out, e)
+            items = await self.bus.consume(src, self.group, self.poll_batch)
+            for item in items:
+                if isinstance(item, MeasurementBatch):
+                    # columnar fast path: ONE append + ONE re-publish per batch
+                    self.store.add_measurement_batch(item)
+                    persisted.inc(item.n)
+                    item.mark("persisted")
+                    await self.bus.publish(out, item)
+                else:
+                    self.store.add_event(item)
+                    persisted.inc()
+                    await self.bus.publish(out, item)

@@ -36,6 +36,7 @@ from typing import (
 
 import numpy as np
 
+from sitewhere_tpu.core.batch import MeasurementBatch
 from sitewhere_tpu.core.events import (
     AlertLevel,
     DeviceAlert,
@@ -103,11 +104,18 @@ class Rule:
 
     ``group_by`` defaults to per-(device, measurement-name) grouping; the
     windowed aggregate value is passed to ``action`` in the context dict.
+
+    ``vector_where``, when set, is the columnar fast path: it takes a
+    ``MeasurementBatch`` and returns a bool row mask of candidate hits;
+    only hit rows are materialized into event objects for the (stateful)
+    per-event ``evaluate``. Stateless filter rules (threshold, anomaly
+    score) provide it; windowed rules fall back to full materialization.
     """
 
     name: str
     event_type: Optional[EventType] = EventType.MEASUREMENT
     where: Optional[Predicate] = None
+    vector_where: Optional[Callable[[Any], np.ndarray]] = None
     window: int = 0
     window_time_ms: int = 0
     aggregate: str = ""                      # key into AGGREGATES
@@ -221,10 +229,21 @@ def threshold_rule(
     """measurement <op> threshold → alert. The CPU-baseline config's rule
     (BASELINE.json:7)."""
     cmp = _OPS[op]
+    _np_ops = {">": np.greater, ">=": np.greater_equal, "<": np.less,
+               "<=": np.less_equal, "==": np.equal, "!=": np.not_equal}
+    np_cmp = _np_ops[op]
+
+    def vec(batch) -> np.ndarray:
+        mask = np_cmp(batch.values, threshold)
+        if batch.names is not None:
+            mask &= batch.names == measurement
+        return mask & batch.valid
+
     return Rule(
         name=name,
         event_type=EventType.MEASUREMENT,
         where=lambda e: e.name == measurement and cmp(e.value, threshold),  # type: ignore[attr-defined]
+        vector_where=vec,
         action=alert_action(alert_type, level, f"{measurement} {op} {threshold}"),
         cooldown_ms=cooldown_ms,
     )
@@ -237,10 +256,18 @@ def anomaly_score_rule(
     cooldown_ms: int = 0,
 ) -> Rule:
     """TPU anomaly score → alert: the scored-stream consumer rule [B:8]."""
+
+    def vec(batch) -> np.ndarray:
+        if batch.scores is None:
+            return np.zeros((batch.n,), bool)
+        with np.errstate(invalid="ignore"):
+            return (batch.scores >= min_score) & batch.valid
+
     return Rule(
         name=name,
         event_type=EventType.MEASUREMENT,
         where=lambda e: e.score is not None and e.score >= min_score,  # type: ignore[attr-defined]
+        vector_where=vec,
         action=alert_action("anomaly", level, "tpu anomaly score"),
         cooldown_ms=cooldown_ms,
     )
@@ -432,9 +459,67 @@ class RuleEngine(LifecycleComponent):
     async def _run(self) -> None:
         src = self.bus.naming.persisted_events(self.tenant)
         while True:
-            events = await self.bus.consume(src, self.group, self.poll_batch)
-            for e in events:
-                await self.process_event(e)
+            items = await self.bus.consume(src, self.group, self.poll_batch)
+            for item in items:
+                if isinstance(item, MeasurementBatch):
+                    await self.process_batch(item)
+                else:
+                    await self.process_event(item)
+
+    async def process_batch(self, batch: MeasurementBatch) -> List[DeviceEvent]:
+        """Columnar evaluation: rules with a ``vector_where`` run one numpy
+        mask over the batch and materialize ONLY hit rows; rules without
+        one (windowed/UDF rules) need every row, so the batch materializes
+        once and runs the per-event path."""
+        evaluated = self.metrics.counter("rules.evaluated")
+        derived_out: List[DeviceEvent] = []
+        need_full = [
+            r for r in self.rules
+            if r.vector_where is None
+            and r.event_type in (None, EventType.MEASUREMENT)
+        ]
+        if need_full:
+            for e in batch.to_events():
+                derived_out.extend(await self.process_event(e))
+            return derived_out
+        fired = self.metrics.counter("rules.fired")
+        for rule in self.rules:
+            if rule.event_type not in (None, EventType.MEASUREMENT):
+                continue
+            evaluated.inc(batch.n)
+            try:
+                mask = rule.vector_where(batch)
+                hits = np.nonzero(mask)[0]
+            except Exception as exc:  # noqa: BLE001
+                self._record_error(f"rule '{rule.name}' (vector)", exc)
+                continue
+            if hits.size == 0:
+                continue
+            # hit rows materialize to objects; evaluate() re-applies the
+            # scalar filter plus cooldown/window state and runs the action
+            for e in batch.select(hits).to_events():
+                try:
+                    derived = await rule.evaluate(e)
+                except Exception as exc:  # noqa: BLE001
+                    self._record_error(f"rule '{rule.name}'", exc)
+                    continue
+                if derived:
+                    fired.inc()
+                    derived_out.extend(derived)
+        await self._emit_derived(derived_out)
+        return derived_out
+
+    async def _emit_derived(self, derived_out: List[DeviceEvent]) -> None:
+        for d in derived_out:
+            d.mark("rule")
+            if d.EVENT_TYPE is EventType.COMMAND_INVOCATION:
+                await self.bus.publish(
+                    self.bus.naming.command_invocations(self.tenant), d
+                )
+            else:
+                await self.bus.publish(
+                    self.bus.naming.scored_events(self.tenant), d
+                )
 
     async def process_event(self, e: DeviceEvent) -> List[DeviceEvent]:
         """Evaluate all rules; publish derived events into the pipeline."""
@@ -451,17 +536,7 @@ class RuleEngine(LifecycleComponent):
             if derived:
                 fired.inc()
                 derived_out.extend(derived)
-        for d in derived_out:
-            d.mark("rule")
-            if d.EVENT_TYPE is EventType.COMMAND_INVOCATION:
-                await self.bus.publish(
-                    self.bus.naming.command_invocations(self.tenant), d
-                )
-            else:
-                # derived alerts re-enter at the scored stage (they get
-                # persisted + fanned out); alerts don't match measurement
-                # rules so no feedback loop
-                await self.bus.publish(
-                    self.bus.naming.scored_events(self.tenant), d
-                )
+        # derived alerts re-enter at the scored stage (they get persisted +
+        # fanned out); alerts don't match measurement rules so no feedback loop
+        await self._emit_derived(derived_out)
         return derived_out

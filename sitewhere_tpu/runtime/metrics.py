@@ -84,6 +84,14 @@ class Histogram:
         for v in vs:
             self.record(float(v))
 
+    def reset(self) -> None:
+        """Zero all buckets (bench phase boundaries)."""
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._sum = 0.0
+            self._n = 0
+            self._max = 0.0
+
     @property
     def count(self) -> int:
         return self._n

@@ -13,6 +13,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import numpy as np
+
+from sitewhere_tpu.core.batch import MeasurementBatch
 from sitewhere_tpu.core.events import (
     DeviceAlert,
     DeviceEvent,
@@ -111,6 +114,39 @@ class DeviceStateService(LifecycleComponent):
             if len(st.latest_alerts) > 32:
                 del st.latest_alerts[:16]
 
+    def apply_batch(self, b: MeasurementBatch) -> None:
+        """Columnar rollup: one pass over plain Python lists (tolist() is a
+        C-level bulk convert; per-row numpy scalar getitem would triple the
+        cost); last row per (device, name) wins (rows are event-ordered)."""
+        states = self.states
+        returned = self.metrics.counter("device_state.returned")
+        toks = b.device_tokens.tolist()
+        names = b.names.tolist()
+        vals = b.values.tolist()
+        ets = b.event_ts.tolist()
+        rts_l = b.received_ts.tolist()
+        asg = b.assignment_tokens.tolist() if b.assignment_tokens is not None \
+            else None
+        scs = b.scores.tolist() if b.scores is not None else None
+        for i in range(b.n):
+            tok = toks[i]
+            st = states.get(tok)
+            if st is None:
+                st = states[tok] = DeviceState(tok)
+            if asg is not None and asg[i]:
+                st.assignment_token = asg[i]
+            rts = rts_l[i]
+            if rts > st.last_interaction_ts:
+                st.last_interaction_ts = int(rts)
+            if not st.present:
+                st.present = True
+                st.presence_missing_ts = None
+                returned.inc()
+            sc = scs[i] if scs is not None else None
+            if sc is not None and sc != sc:  # NaN → unscored
+                sc = None
+            st.latest_measurements[names[i]] = (vals[i], sc, int(ets[i]))
+
     def get_state(self, device_token: str) -> Optional[DeviceState]:
         return self.states.get(device_token)
 
@@ -161,9 +197,12 @@ class DeviceStateService(LifecycleComponent):
     async def _run(self) -> None:
         src = self.bus.naming.persisted_events(self.tenant)
         while True:
-            events = await self.bus.consume(src, self.group, self.poll_batch)
-            for e in events:
-                self.apply_event(e)
+            items = await self.bus.consume(src, self.group, self.poll_batch)
+            for item in items:
+                if isinstance(item, MeasurementBatch):
+                    self.apply_batch(item)
+                else:
+                    self.apply_event(item)
 
     async def _presence_loop(self) -> None:
         while True:

@@ -55,6 +55,9 @@ class _MeasurementColumns:
         self._chunks: List[Dict[str, np.ndarray]] = []
         self._cur: Dict[str, list] = self._fresh()
         self._materialized: Optional[Dict[str, np.ndarray]] = None
+        # concat of SEALED chunks only — invalidated on seal, not on every
+        # append, so live-ingest reads pay O(tail) not O(n) per query
+        self._sealed_cache: Optional[Dict[str, np.ndarray]] = None
 
     @staticmethod
     def _fresh() -> Dict[str, list]:
@@ -75,13 +78,40 @@ class _MeasurementColumns:
         c["score"].append(e.score if e.score is not None else np.nan)
         c["event_ts"].append(e.event_ts)
         c["received_ts"].append(e.received_ts)
-        self._materialized = None  # invalidate read cache
+        self._materialized = None  # invalidate read cache (tail changed)
+        if len(c["value"]) >= self.CHUNK:
+            self._seal()
+
+    def append_batch(self, b) -> None:
+        """Columnar bulk append from a MeasurementBatch (C-level extends)."""
+        n = b.n
+        if n == 0:
+            return
+        c = self._cur
+        empty = ("",) * n
+
+        def col(a, fallback=empty):
+            return a.tolist() if a is not None else list(fallback)
+
+        c["event_id"].extend(col(b.event_ids))
+        c["device_token"].extend(col(b.device_tokens))
+        c["assignment_token"].extend(col(b.assignment_tokens))
+        c["area_token"].extend(col(b.area_tokens))
+        c["name"].extend(col(b.names))
+        c["value"].extend(b.values.tolist())
+        c["score"].extend(
+            b.scores.tolist() if b.scores is not None else [np.nan] * n
+        )
+        c["event_ts"].extend(b.event_ts.astype(np.int64).tolist())
+        c["received_ts"].extend(b.received_ts.astype(np.int64).tolist())
+        self._materialized = None
         if len(c["value"]) >= self.CHUNK:
             self._seal()
 
     def _seal(self) -> None:
         if not self._cur["value"]:
             return
+        self._sealed_cache = None
         self._chunks.append(
             {
                 "event_id": np.asarray(self._cur["event_id"], object),
@@ -97,21 +127,37 @@ class _MeasurementColumns:
         )
         self._cur = self._fresh()
 
+    OBJ = ("event_id", "device_token", "assignment_token", "area_token", "name")
+
+    def _tail_arrays(self) -> Dict[str, np.ndarray]:
+        dtypes = {"value": np.float32, "score": np.float32,
+                  "event_ts": np.int64, "received_ts": np.int64}
+        return {
+            k: np.asarray(v, object if k in self.OBJ else dtypes[k])
+            for k, v in self._cur.items()
+        }
+
     def columns(self) -> Dict[str, np.ndarray]:
-        """Materialize all rows as one struct-of-arrays dict (cached until
-        the next append — reads are much more frequent than writes on the
-        query path, and an O(n) copy per REST call would dominate)."""
+        """Materialize all rows as one struct-of-arrays dict. Two-level
+        cache: sealed chunks concat once per seal (not per append), the
+        live tail concats on top per read — so a REST query racing live
+        ingest pays O(tail), not O(total rows)."""
         if self._materialized is not None:
             return self._materialized
-        self._seal()
-        if not self._chunks:
-            out = {k: np.asarray([], object if k in (
-                "event_id", "device_token", "assignment_token", "area_token", "name"
-            ) else np.float32) for k in self._fresh()}
-        else:
-            out = {
+        if self._sealed_cache is None and self._chunks:
+            self._sealed_cache = {
                 k: np.concatenate([ch[k] for ch in self._chunks])
                 for k in self._chunks[0]
+            }
+        tail = self._tail_arrays()
+        if self._sealed_cache is None:
+            out = tail
+        elif len(tail["value"]) == 0:
+            out = self._sealed_cache
+        else:
+            out = {
+                k: np.concatenate([self._sealed_cache[k], tail[k]])
+                for k in tail
             }
         self._materialized = out
         return out
@@ -146,6 +192,11 @@ class EventStore:
         for e in events:
             self.add_event(e)
         return len(events)
+
+    def add_measurement_batch(self, batch) -> int:
+        """Columnar bulk insert (the TSDB batch-insert loop analog)."""
+        self.measurements.append_batch(batch)
+        return batch.n
 
     # -- reads -----------------------------------------------------------
     def get_event(self, event_id: str) -> Optional[DeviceEvent]:

@@ -37,6 +37,9 @@ class SimProfile:
     wire: str = "json"              # json | binary
     token_prefix: str = "dev"
     seed: int = 0
+    # samples batched into ONE wire message (devices commonly buffer and
+    # send telemetry in bursts; the JSON {"device","events":[...]} form)
+    samples_per_message: int = 1
 
 
 class DeviceSimulator:
@@ -94,20 +97,89 @@ class DeviceSimulator:
         ).encode()
 
     async def publish_once(self, token: str, t: float, force_anomaly: bool = False) -> None:
-        value, is_anomaly = self._value(token, t, force_anomaly)
-        if is_anomaly:
-            self.anomalies_injected.append(
-                {"device": token, "value": value, "ts": now_ms()}
+        p = self.profile
+        k = max(1, p.samples_per_message)
+        if k == 1 or p.wire == "binary":
+            value, is_anomaly = self._value(token, t, force_anomaly)
+            if is_anomaly:
+                self.anomalies_injected.append(
+                    {"device": token, "value": value, "ts": now_ms()}
+                )
+            await self.broker.publish(
+                self.topic_pattern.format(device=token), self._payload(token, value)
+            )
+            self.sent += 1
+            return
+        # burst form: k samples in one JSON message
+        events = []
+        ts = now_ms()
+        for j in range(k):
+            value, is_anomaly = self._value(
+                token, t + j * p.interval_s, force_anomaly and j == 0
+            )
+            if is_anomaly:
+                self.anomalies_injected.append(
+                    {"device": token, "value": value, "ts": ts}
+                )
+            events.append(
+                {"type": "measurement", "name": p.measurement,
+                 "value": value, "event_ts": ts + j}
             )
         await self.broker.publish(
-            self.topic_pattern.format(device=token), self._payload(token, value)
+            self.topic_pattern.format(device=token),
+            json.dumps({"device": token, "events": events}).encode(),
         )
-        self.sent += 1
+        self.sent += k
 
     async def publish_round(self, t: float) -> None:
         """One sample from every device (deterministic batch mode for tests)."""
         for token in self.device_tokens():
             await self.publish_once(token, t)
+
+    def pregenerate(self, rounds: int, t0: float = 0.0) -> list:
+        """Precompute wire payloads for ``rounds`` rounds — lets a bench
+        pump measure PIPELINE throughput instead of generator throughput
+        (the payload bytes are identical to live generation)."""
+        out = []
+        for r in range(rounds):
+            t = t0 + float(r)
+            batch = []
+            for token in self.device_tokens():
+                p = self.profile
+                k = max(1, p.samples_per_message)
+                topic = self.topic_pattern.format(device=token)
+                if k == 1 or p.wire == "binary":
+                    value, is_anomaly = self._value(token, t)
+                    if is_anomaly:
+                        self.anomalies_injected.append(
+                            {"device": token, "value": value, "ts": now_ms()}
+                        )
+                    batch.append((topic, self._payload(token, value), 1))
+                else:
+                    ts = now_ms()
+                    events = []
+                    for j in range(k):
+                        value, is_anomaly = self._value(token, t + j * p.interval_s)
+                        if is_anomaly:
+                            self.anomalies_injected.append(
+                                {"device": token, "value": value, "ts": ts}
+                            )
+                        events.append(
+                            {"type": "measurement", "name": p.measurement,
+                             "value": value, "event_ts": ts + j}
+                        )
+                    batch.append((
+                        topic,
+                        json.dumps({"device": token, "events": events}).encode(),
+                        k,
+                    ))
+            out.append(batch)
+        return out
+
+    async def publish_pregenerated(self, round_payloads: list) -> None:
+        for topic, payload, k in round_payloads:
+            await self.broker.publish(topic, payload)
+            self.sent += k
 
     async def run(self, duration_s: float) -> None:
         """Free-running mode: every device publishes at its own interval."""

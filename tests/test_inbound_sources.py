@@ -5,6 +5,7 @@ import json
 
 import pytest
 
+from sitewhere_tpu.core.batch import MeasurementBatch
 from sitewhere_tpu.core.events import DeviceMeasurement, EventType
 from sitewhere_tpu.core.model import Device, DeviceAssignment, DeviceType
 from sitewhere_tpu.pipeline.inbound import InboundProcessor
@@ -36,9 +37,12 @@ async def test_source_decodes_and_publishes(bus: EventBus):
         )
         await asyncio.sleep(0.05)
         reqs = await bus.consume(bus.naming.decoded_events("t1"), "probe", timeout_s=0)
+        # measurements travel as ONE columnar MeasurementBatch (hot path)
         assert len(reqs) == 1
-        assert reqs[0]["value"] == 5.0
-        assert reqs[0]["_source"] == "mqtt"
+        mb = reqs[0]
+        assert isinstance(mb, MeasurementBatch)
+        assert mb.n == 1 and mb.values[0] == 5.0
+        assert mb.device_tokens[0] == "d1"
     finally:
         await src.stop()
 
@@ -129,3 +133,69 @@ async def test_source_survives_garbled_bytes(bus: EventBus):
         assert len(ok) == 1  # pump still alive after the bad payload
     finally:
         await src.stop()
+
+
+async def test_source_survives_malformed_value_in_burst(bus: EventBus):
+    """A JSON-valid but type-malformed payload must not kill the pump and
+    must land on the failed-decode path (or be salvaged row-wise)."""
+    src = make_source("mqtt", "t1", bus)
+    await src.start()
+    try:
+        bus.subscribe(bus.naming.decoded_events("t1"), "probe")
+        bus.subscribe(bus.naming.failed_decode("t1"), "probef")
+        await src.receiver.submit(
+            b'{"device":"d1","events":[{"name":"t","value":"oops"}]}'
+        )
+        await src.receiver.submit(
+            json.dumps({"device_token": "d1", "name": "t", "value": 2.0}).encode()
+        )
+        await asyncio.sleep(0.1)
+        ok = await bus.consume(bus.naming.decoded_events("t1"), "probe", timeout_s=0)
+        # the good payload still flows — pump alive
+        assert any(isinstance(m, MeasurementBatch) and 2.0 in m.values.tolist()
+                   for m in ok)
+    finally:
+        await src.stop()
+
+
+async def test_burst_with_ids_takes_dedup_path(bus: EventBus):
+    """Client-supplied ids must reach the Deduplicator (QoS1 redelivery)."""
+    src = make_source("mqtt", "t1", bus)
+    await src.start()
+    try:
+        bus.subscribe(bus.naming.decoded_events("t1"), "probe")
+        payload = b'{"device":"d1","events":[{"id":"e1","name":"t","value":5.0}]}'
+        await src.receiver.submit(payload)
+        await src.receiver.submit(payload)  # duplicate delivery
+        await asyncio.sleep(0.1)
+        out = await bus.consume(bus.naming.decoded_events("t1"), "probe", timeout_s=0)
+        total = sum(m.n if isinstance(m, MeasurementBatch) else 1 for m in out)
+        assert total == 1, f"duplicate id not deduped: {total} rows"
+        assert src.metrics.counter("event_sources.deduplicated").value == 1
+    finally:
+        await src.stop()
+
+
+async def test_inbound_batch_enrichment(bus: EventBus, dm):
+    """Columnar inbound: enrichment columns attached, unknown devices
+    routed to registration, unassigned rejected."""
+    import numpy as np
+    from sitewhere_tpu.core.batch import MeasurementBatch as MB
+
+    proc = InboundProcessor("t1", bus, dm)
+    bus.subscribe(bus.naming.inbound_events("t1"), "probe")
+    bus.subscribe(bus.naming.unregistered_devices("t1"), "probe-u")
+    batch = MB.from_columns(
+        "t1",
+        ["d1", "ghost", "d2", "d1"],
+        ["t", "t", "t", "t"],
+        [1.0, 2.0, 3.0, 4.0],
+        [0, 0, 0, 0],
+    )
+    out = await proc.process_batch(batch)
+    assert out is not None and out.n == 2  # both d1 rows survive
+    assert list(out.assignment_tokens) == ["a1", "a1"]
+    assert list(out.area_tokens) == ["ar1", "ar1"]
+    unreg = await bus.consume(bus.naming.unregistered_devices("t1"), "probe-u", timeout_s=0)
+    assert unreg and unreg[0]["device_token"] == "ghost"
+    assert proc.metrics.counter("inbound.rejected").value == 1
