@@ -1,0 +1,18 @@
+import os
+os.environ["JAX_PLATFORMS"]="cpu"
+os.environ["XLA_FLAGS"]="--xla_force_host_platform_device_count=1"
+import cProfile, pstats, asyncio, io, time
+from bench import _bench_e2e
+
+def main():
+    pr = cProfile.Profile()
+    pr.enable()
+    r = asyncio.run(_bench_e2e(6.0, 100))
+    pr.disable()
+    print("events_per_sec:", r["events_per_sec"], "sent:", r["sent"])
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(35)
+    print(s.getvalue()[:6500])
+
+main()

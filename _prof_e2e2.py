@@ -1,0 +1,45 @@
+import os
+os.environ["JAX_PLATFORMS"]="cpu"
+os.environ["XLA_FLAGS"]="--xla_force_host_platform_device_count=1"
+import cProfile, pstats, asyncio, io, time
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+async def main():
+    inst = SiteWhereInstance(InstanceConfig(instance_id="bench",
+        mesh=MeshConfig(tenant_axis=1, data_axis=1, slots_per_shard=8)))
+    await inst.start()
+    await inst.bootstrap(default_tenant="bench", dataset_devices=100)
+    for _ in range(200):
+        if "bench" in inst.tenants: break
+        await asyncio.sleep(0.02)
+    sim = DeviceSimulator(inst.broker, SimProfile(n_devices=100, seed=3, samples_per_message=10),
+                          topic_pattern="sitewhere/input/{device}")
+    # warmup (compile)
+    import concurrent.futures
+    await asyncio.get_running_loop().run_in_executor(None, inst.inference.prewarm)
+    for s in range(5):
+        await sim.publish_round(float(s))
+        await asyncio.sleep(0.2)
+    scored = inst.metrics.counter("tpu_inference.scored_total")
+    start = scored.value; sent0 = sim.sent
+    pr = cProfile.Profile()
+    pr.enable()
+    t0 = time.perf_counter()
+    step = 10
+    while time.perf_counter() - t0 < 8.0:
+        await sim.publish_round(float(step)); step += 1
+        await asyncio.sleep(0)
+    for _ in range(200):
+        if scored.value - start >= sim.sent - sent0 - 100: break
+        await asyncio.sleep(0.05)
+    pr.disable()
+    dt = time.perf_counter() - t0
+    print(f"steady-state: sent={sim.sent-sent0} scored={scored.value-start} -> {(scored.value-start)/dt:.0f} ev/s")
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("tottime").print_stats(30)
+    print(s.getvalue()[:5500])
+    await inst.terminate()
+
+asyncio.run(main())

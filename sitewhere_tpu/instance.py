@@ -45,6 +45,7 @@ from sitewhere_tpu.pipeline.rules import (
 )
 from sitewhere_tpu.pipeline.sources import EventSource, QueueReceiver
 from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.checkpoint import CheckpointManager
 from sitewhere_tpu.runtime.config import (
     InstanceConfig,
     TenantEngineConfig,
@@ -126,9 +127,13 @@ class SiteWhereInstance(LifecycleComponent):
         )
         self.users = UserManagement()
         self.tenant_management = TenantManagement(self.bus)
+        self.checkpoints = (
+            CheckpointManager(cfg.data_dir) if cfg.checkpointing else None
+        )
         self.inference = TpuInferenceService(
             self.bus, self.mesh, self.metrics,
             slots_per_shard=cfg.mesh.slots_per_shard,
+            checkpoints=self.checkpoints,
         )
         self.add_child(self.inference)
         self.tenants: Dict[str, TenantRuntime] = {}
@@ -181,8 +186,14 @@ class SiteWhereInstance(LifecycleComponent):
     # -- tenant runtime construction -------------------------------------
     def _build_tenant(self, cfg: TenantEngineConfig) -> TenantRuntime:
         tenant = cfg.tenant
-        dm = DeviceManagement(tenant)
-        store = EventStore(tenant)
+        dm = store = None
+        if self.checkpoints is not None:
+            # resume path: persisted device model + event history win over
+            # fresh stores (crash-restart keeps every persisted event)
+            dm = self.checkpoints.load_device_management(tenant)
+            store = self.checkpoints.load_event_store(tenant)
+        dm = dm or DeviceManagement(tenant)
+        store = store or EventStore(tenant)
         receiver = QueueReceiver(f"recv[{tenant}]")
         source = EventSource(
             f"mqtt[{tenant}]", tenant, self.bus, receiver, cfg.decoder, self.metrics
@@ -334,6 +345,50 @@ class SiteWhereInstance(LifecycleComponent):
     async def _updates_loop(self) -> None:
         while True:
             await self.drain_tenant_updates(timeout_s=None)
+
+    # -- checkpoint / restore ---------------------------------------------
+    async def checkpoint(self) -> None:
+        """Persist the whole instance: bus (topic logs + group cursors),
+        per-tenant device model + event store, tenant manifest. Per-tenant
+        model params are saved by the inference engines on stop; call this
+        on a stopped (or quiesced) instance for a crash-consistent cut."""
+        ck = self.checkpoints
+        if ck is None:
+            raise RuntimeError("checkpointing disabled (InstanceConfig)")
+        loop = asyncio.get_running_loop()
+
+        def _sync() -> None:
+            ck.save_bus(self.bus)
+            for token, rt in self.tenants.items():
+                ck.save_tenant_stores(token, rt.device_management, rt.event_store)
+            ck.save_manifest([
+                {"token": t, "template": rt.config.template}
+                for t, rt in self.tenants.items()
+            ])
+
+        await loop.run_in_executor(None, _sync)
+
+    async def restore(self) -> int:
+        """Resume from the data_dir checkpoint: bus state FIRST (so newly
+        subscribing consumer groups find their saved cursors), then the
+        tenant set from the manifest (tenant builders pick up persisted
+        device models / event stores automatically). Returns the number of
+        tenants restored."""
+        ck = self.checkpoints
+        if ck is None or not ck.exists():
+            return 0
+        await asyncio.get_running_loop().run_in_executor(
+            None, ck.load_bus, self.bus
+        )
+        manifest = ck.load_manifest() or []
+        for entry in manifest:
+            if entry["token"] in self.tenants:
+                continue
+            cfg = tenant_config_from_template(
+                entry["token"], entry.get("template", "default")
+            )
+            await self.add_tenant(cfg)
+        return len(manifest)
 
     # -- introspection ---------------------------------------------------
     def topology(self) -> dict:

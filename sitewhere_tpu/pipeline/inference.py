@@ -156,13 +156,29 @@ class TpuInferenceEngine(TenantEngine):
             svc.mm.n_data_shards, scorer.max_streams // svc.mm.n_data_shards
         )
         svc.bus.subscribe(svc.bus.naming.inbound_events(self.tenant), svc.group)
-        scorer.activate(svc.router.global_slot(self.placement))
+        params = None
+        if svc.checkpoints is not None:
+            # resume this tenant's trained weights (possibly onto a
+            # DIFFERENT slot/shard than before — mesh re-placement)
+            params = await asyncio.get_running_loop().run_in_executor(
+                None, svc.checkpoints.load_params,
+                self.tenant, self.config.model,
+            )
+        scorer.activate(svc.router.global_slot(self.placement), params=params)
 
     async def on_stop(self) -> None:
         svc = self.service
         if self.placement is not None:
             slot = svc.router.global_slot(self.placement)
             scorer = svc.scorers.get(self.config.model)
+            if scorer is not None and svc.checkpoints is not None:
+                # save this tenant's (possibly trained) weights BEFORE the
+                # slot wipe below destroys them
+                params = scorer.slot_params(slot)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, svc.checkpoints.save_params,
+                    self.tenant, self.config.model, params,
+                )
             if scorer is not None:
                 # full wipe: a recycled slot must not leak this tenant's
                 # window history or params to the next occupant
@@ -198,10 +214,12 @@ class TpuInferenceService(MultitenantService):
         slots_per_shard: int = 8,
         poll_batch: int = 64,
         max_inflight: int = 4,
+        checkpoints=None,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
         self.metrics = metrics or MetricsRegistry()
+        self.checkpoints = checkpoints  # CheckpointManager | None
         self.slots_per_shard = slots_per_shard
         self.poll_batch = poll_batch  # bus items (batches) per poll
         self.router = TenantRouter(self.mm.n_tenant_shards, slots_per_shard)
@@ -260,6 +278,15 @@ class TpuInferenceService(MultitenantService):
             )
             for t in pending:
                 await cancel_and_wait(t)
+        # final sweep: rows can land in lanes AFTER their engine's own
+        # stop-drain (the scoring loop keeps consuming during the stop
+        # cascade) — resolve them unscored so no consumed event is lost
+        for lanes in self._lanes.values():
+            for key in list(lanes):
+                lane = lanes.pop(key)
+                if lane.count:
+                    _i, _v, seqs, rows = lane.pop(lane.count)
+                    await self._resolve_rows(seqs, rows, None, publish_nowait=True)
 
     # -- ingestion → lanes (columnar) ------------------------------------
     async def _enqueue_batch(self, engine: TpuInferenceEngine, batch: MeasurementBatch) -> None:
@@ -378,6 +405,10 @@ class TpuInferenceService(MultitenantService):
         any_cfg = next(iter(engine_cfgs.values()))
         mb = any_cfg.microbatch
         b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
+        # acquire the in-flight slot BEFORE popping rows off the lanes:
+        # a cancellation while waiting here must not strand popped rows
+        # (everything from the pop to create_task below is await-free)
+        await self._inflight.acquire()
         t, d = scorer.n_slots, self.mm.n_data_shards
         ids = np.zeros((t, d * b_lane), np.int32)
         vals = np.zeros((t, d * b_lane), np.float32)
@@ -403,10 +434,9 @@ class TpuInferenceService(MultitenantService):
         else:
             self._first_pending_ts.pop(family, None)
         if moved == 0:
+            self._inflight.release()
             return 0
 
-        # backpressure: bounded number of flushes in flight at once
-        await self._inflight.acquire()
         scores_dev = scorer.step(ids, vals, valid)  # async dispatch
         taken = (
             np.concatenate(tk_slots),
@@ -477,6 +507,16 @@ class TpuInferenceService(MultitenantService):
                     self.poll_batch,
                     timeout_s=0,
                 )
+                # the engine can stop DURING the consume await (stop
+                # cascade); its cursor already advanced, so resolve the
+                # items unscored instead of crashing on a dead placement
+                if engine.state is not LifecycleState.STARTED or engine.placement is None:
+                    topic = self.bus.naming.scored_events(tenant)
+                    for item in items:
+                        if isinstance(item, MeasurementBatch):
+                            item.mark("passthrough_stop")
+                        self.bus.publish_nowait(topic, item)
+                    continue
                 fam_cfgs.setdefault(engine.config.model, {})[
                     self.router.global_slot(engine.placement)
                 ] = engine.config

@@ -70,6 +70,9 @@ class InstanceConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     default_tenant_template: str = "default"
     bus_retention: int = 65536
+    # opt-in durability: per-tenant params on engine stop/start, bus
+    # offsets+logs, device model + event stores under data_dir
+    checkpointing: bool = False
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

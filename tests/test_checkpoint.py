@@ -1,0 +1,157 @@
+"""Checkpoint/resume: param round trips, bus snapshots, and the headline
+guarantee — an instance killed mid-stream restarts with NO event lost and
+NO event persisted twice (SURVEY.md §5 checkpoint; VERDICT r1 item 4)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.checkpoint import CheckpointManager
+from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+from sitewhere_tpu.services.event_store import EventQuery
+from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+
+def test_params_round_trip(tmp_path):
+    ck = CheckpointManager(tmp_path)
+    # pytree with nested dicts AND a list (the ViT blocks shape)
+    params = {
+        "patch": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        "blocks": [
+            {"w": np.ones((2, 2), np.float32)},
+            {"w": np.full((2, 2), 7.0, np.float32)},
+        ],
+    }
+    ck.save_params("acme", "vit_b16", params)
+    loaded = ck.load_params("acme", "vit_b16")
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(a, b)
+    assert ck.load_params("acme", "nope") is None
+    ck.delete_params("acme")
+    assert ck.load_params("acme", "vit_b16") is None
+
+
+async def test_bus_snapshot_round_trip(tmp_path):
+    ck = CheckpointManager(tmp_path)
+    bus = EventBus()
+    bus.subscribe("t.a", "g1")
+    for i in range(10):
+        await bus.publish("t.a", {"i": i})
+    got = await bus.consume("t.a", "g1", 4, timeout_s=0)
+    assert len(got) == 4
+    ck.save_bus(bus)
+
+    bus2 = EventBus()
+    assert ck.load_bus(bus2)
+    rest = await bus2.consume("t.a", "g1", 100, timeout_s=0)
+    assert [r["i"] for r in rest] == list(range(4, 10))  # cursor preserved
+    # offsets continue monotonically after restore
+    off = await bus2.publish("t.a", {"i": 10})
+    assert off == 10
+
+
+async def test_crash_resume_exactly_once(tmp_path):
+    """Kill an instance mid-stream, restart from the checkpoint, and prove
+    every sent event is persisted exactly once."""
+    def make_cfg():
+        return InstanceConfig(
+            instance_id="ck",
+            data_dir=str(tmp_path),
+            checkpointing=True,
+            mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+        )
+
+    inst = SiteWhereInstance(make_cfg())
+    await inst.start()
+    await inst.bootstrap(default_tenant="acme", dataset_devices=8)
+    for _ in range(100):
+        if "acme" in inst.tenants:
+            break
+        await asyncio.sleep(0.02)
+    sim = DeviceSimulator(
+        inst.broker, SimProfile(n_devices=8, seed=11),
+        topic_pattern="sitewhere/input/{device}",
+    )
+    for step in range(25):
+        await sim.publish_round(float(step))
+        await asyncio.sleep(0.002)
+    sent = sim.sent
+    # wait until at least SOME events persisted, but don't drain fully —
+    # the crash must catch events still in flight on the bus
+    persisted = inst.metrics.counter("event_management.persisted")
+    for _ in range(200):
+        if persisted.value >= sent * 0.3:
+            break
+        await asyncio.sleep(0.02)
+    await inst.stop()          # "crash": engines drain lanes unscored
+    await inst.checkpoint()
+    await inst.terminate()
+
+    # fresh process analog: new instance, same data_dir
+    inst2 = SiteWhereInstance(make_cfg())
+    await inst2.start()
+    restored = await inst2.restore()
+    assert restored == 1 and "acme" in inst2.tenants
+    store = inst2.tenant("acme").event_store
+    # the backlog left on the bus drains into the store exactly once
+    for _ in range(400):
+        evs, total = store.list_measurements(EventQuery(page_size=100000))
+        if total >= sent:
+            break
+        await asyncio.sleep(0.05)
+    evs, total = store.list_measurements(EventQuery(page_size=100000))
+    assert total == sent, f"persisted {total} != sent {sent}"
+    ids = [e.id for e in evs]
+    assert len(set(ids)) == total, "event persisted twice after resume"
+    # device model survived too
+    assert inst2.tenant("acme").device_management.get_device("dev-00000") is not None
+    await inst2.terminate()
+
+
+async def test_tenant_params_persist_across_restart(tmp_path):
+    """Engine stop saves slot params; engine start restores them (even
+    onto a different slot)."""
+    cfg = InstanceConfig(
+        instance_id="ckp",
+        data_dir=str(tmp_path),
+        checkpointing=True,
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+    )
+    inst = SiteWhereInstance(cfg)
+    await inst.start()
+    await inst.bootstrap(default_tenant="acme")
+    for _ in range(100):
+        if "acme" in inst.tenants:
+            break
+        await asyncio.sleep(0.02)
+    engine = inst.inference.engines["acme"]
+    scorer = inst.inference.scorers[engine.config.model]
+    slot = inst.inference.router.global_slot(engine.placement)
+    # perturb the tenant's params so restore is observable
+    marked = jax.tree_util.tree_map(
+        lambda x: x + 1.25, scorer.slot_params(slot)
+    )
+    scorer.activate(slot, params=marked)
+    await inst.stop()
+    await inst.checkpoint()
+    await inst.terminate()
+
+    inst2 = SiteWhereInstance(cfg)
+    await inst2.start()
+    await inst2.restore()
+    engine2 = inst2.inference.engines["acme"]
+    scorer2 = inst2.inference.scorers[engine2.config.model]
+    slot2 = inst2.inference.router.global_slot(engine2.placement)
+    got = scorer2.slot_params(slot2)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(marked), jax.tree_util.tree_leaves(got)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    await inst2.terminate()
