@@ -49,7 +49,9 @@ from sitewhere_tpu.runtime.checkpoint import CheckpointManager
 from sitewhere_tpu.runtime.config import (
     InstanceConfig,
     TenantEngineConfig,
+    tenant_config_from_dict,
     tenant_config_from_template,
+    tenant_config_to_dict,
 )
 from sitewhere_tpu.runtime.lifecycle import (
     LifecycleComponent,
@@ -349,24 +351,43 @@ class SiteWhereInstance(LifecycleComponent):
     # -- checkpoint / restore ---------------------------------------------
     async def checkpoint(self) -> None:
         """Persist the whole instance: bus (topic logs + group cursors),
-        per-tenant device model + event store, tenant manifest. Per-tenant
-        model params are saved by the inference engines on stop; call this
-        on a stopped (or quiesced) instance for a crash-consistent cut."""
+        per-tenant device model + event store, tenant manifest.
+
+        Safe on a LIVE instance: the state cut happens synchronously on the
+        event loop (no awaits between reads, so nothing mutates mid-
+        snapshot), and only serialization + file writes run on an executor
+        thread. Per-tenant model params are captured here too
+        (``inference.snapshot_params``) so a live checkpoint preserves
+        on-device training — engines additionally save params on stop."""
         ck = self.checkpoints
         if ck is None:
             raise RuntimeError("checkpointing disabled (InstanceConfig)")
-        loop = asyncio.get_running_loop()
+        # phase 1 — consistent cut, no awaits
+        bus_bytes = ck.snapshot_bus(self.bus)
+        param_snaps = self.inference.snapshot_params()
+        tenant_snaps = {
+            token: ck.snapshot_tenant_stores(rt.device_management, rt.event_store)
+            for token, rt in self.tenants.items()
+        }
+        manifest = [
+            {
+                "token": t,
+                "template": rt.config.template,
+                "config": tenant_config_to_dict(rt.config),
+            }
+            for t, rt in self.tenants.items()
+        ]
 
-        def _sync() -> None:
-            ck.save_bus(self.bus)
-            for token, rt in self.tenants.items():
-                ck.save_tenant_stores(token, rt.device_management, rt.event_store)
-            ck.save_manifest([
-                {"token": t, "template": rt.config.template}
-                for t, rt in self.tenants.items()
-            ])
+        # phase 2 — serialization/IO off the loop
+        def _write() -> None:
+            ck.write_bus(bus_bytes)
+            for (token, family), params in param_snaps.items():
+                ck.save_params(token, family, params)
+            for token, snap in tenant_snaps.items():
+                ck.write_tenant_stores(token, snap)
+            ck.save_manifest(manifest)
 
-        await loop.run_in_executor(None, _sync)
+        await asyncio.get_running_loop().run_in_executor(None, _write)
 
     async def restore(self) -> int:
         """Resume from the data_dir checkpoint: bus state FIRST (so newly
@@ -384,9 +405,15 @@ class SiteWhereInstance(LifecycleComponent):
         for entry in manifest:
             if entry["token"] in self.tenants:
                 continue
-            cfg = tenant_config_from_template(
-                entry["token"], entry.get("template", "default")
-            )
+            if "config" in entry:
+                # full saved config wins: tenants added with overrides
+                # (model/decoder/…) must resume identically, or restored
+                # params can fail the pytree-structure match in set_slot
+                cfg = tenant_config_from_dict(entry["config"])
+            else:  # legacy manifest (round-2 format)
+                cfg = tenant_config_from_template(
+                    entry["token"], entry.get("template", "default")
+                )
             await self.add_tenant(cfg)
         return len(manifest)
 

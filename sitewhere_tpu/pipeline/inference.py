@@ -322,8 +322,11 @@ class TpuInferenceService(MultitenantService):
         if skipped:
             self.metrics.counter("tpu_inference.skipped_capacity").inc(skipped)
             entry[1] -= skipped
-            if entry[1] <= 0:
-                await self._publish_batch(seq)
+        if entry[1] <= 0:
+            # nothing left awaiting scores (all rows skipped, or an empty
+            # batch) — publish now or the registry entry leaks forever
+            await self._publish_batch(seq)
+            return
         rows_all = np.arange(n, dtype=np.int32)
         seqs_all = np.full((n,), seq, np.int64)
         for d in range(self.mm.n_data_shards):
@@ -511,11 +514,9 @@ class TpuInferenceService(MultitenantService):
                 # cascade); its cursor already advanced, so resolve the
                 # items unscored instead of crashing on a dead placement
                 if engine.state is not LifecycleState.STARTED or engine.placement is None:
-                    topic = self.bus.naming.scored_events(tenant)
-                    for item in items:
-                        if isinstance(item, MeasurementBatch):
-                            item.mark("passthrough_stop")
-                        self.bus.publish_nowait(topic, item)
+                    await self._passthrough(
+                        self.bus.naming.scored_events(tenant), items
+                    )
                     continue
                 fam_cfgs.setdefault(engine.config.model, {})[
                     self.router.global_slot(engine.placement)
@@ -544,6 +545,33 @@ class TpuInferenceService(MultitenantService):
             if moved == 0:
                 await asyncio.sleep(0.001)
 
+    async def _passthrough(self, topic: str, items: list) -> None:
+        """Forward consumed items downstream unscored. While the service is
+        up (e.g. a tenant restart mid-flight) this backpressures like the
+        normal path — a lagging persistence consumer must slow us down, not
+        have retained batches evicted out from under it. The lossy
+        ``publish_nowait`` is reserved for service teardown, when the
+        consumer may already be gone and an awaitable publish would never
+        unblock. The consume cursor has already advanced past these items,
+        so even a cancellation mid-publish must still emit them."""
+        pending = list(items)
+        try:
+            while pending:
+                item = pending[0]
+                if isinstance(item, MeasurementBatch):
+                    item.mark("passthrough_stop")
+                if self.state is LifecycleState.STARTED:
+                    await self.bus.publish(topic, item)
+                else:
+                    self.bus.publish_nowait(topic, item)
+                pending.pop(0)
+        except asyncio.CancelledError:
+            for item in pending:
+                if isinstance(item, MeasurementBatch):
+                    item.mark("passthrough_stop")
+                self.bus.publish_nowait(topic, item)
+            raise
+
     def _deadline_reached(self, family: str, deadline_ms: float) -> bool:
         first = self._first_pending_ts.get(family)
         return first is not None and (time.monotonic() - first) * 1000.0 >= deadline_ms
@@ -560,6 +588,23 @@ class TpuInferenceService(MultitenantService):
             mb = engine.config.microbatch
             sizes = [min(b, mb.max_batch) for b in mb.buckets] + [mb.max_batch]
             scorer.prewarm(sizes)
+
+    def snapshot_params(self) -> Dict[Tuple[str, str], object]:
+        """Live param cut for checkpointing: (tenant, family) → param
+        pytree for that tenant's slot. The leaves are jax arrays
+        (immutable), so the caller can hand them to an executor thread for
+        host transfer + serialization without racing ongoing training."""
+        out: Dict[Tuple[str, str], object] = {}
+        for tenant, engine in self.engines.items():
+            assert isinstance(engine, TpuInferenceEngine)
+            if engine.placement is None:
+                continue
+            scorer = self.scorers.get(engine.config.model)
+            if scorer is None:
+                continue
+            slot = self.router.global_slot(engine.placement)
+            out[(tenant, engine.config.model)] = scorer.slot_params(slot)
+        return out
 
     # -- introspection ---------------------------------------------------
     def describe(self) -> dict:

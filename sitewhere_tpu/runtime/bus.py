@@ -199,6 +199,24 @@ class Topic:
     def committed(self, group: str) -> int:
         return self.group_offsets.get(group, 0)
 
+    # -- durable state (checkpoint contract) -----------------------------
+    def snapshot_state(self) -> dict:
+        """Retained entries + cursors — the durable-state cut every bus
+        backend must expose (checkpointing goes through this, never through
+        the backend's internals)."""
+        return {
+            "entries": self._log[self._head :],
+            "next": self._next_offset,
+            "groups": dict(self.group_offsets),
+        }
+
+    def restore_state(self, st: dict) -> None:
+        self._log = list(st["entries"])
+        self._head = 0
+        self._next_offset = st["next"]
+        self.group_offsets.update(st["groups"])
+        self._data_event.set()
+
     def lag(self, group: str) -> int:
         return self.latest_offset - self.committed(group)
 

@@ -69,23 +69,27 @@ class CheckpointManager:
             p.unlink()
 
     # -- bus --------------------------------------------------------------
-    def save_bus(self, bus) -> Path:
-        """Snapshot retained topic entries + group cursors (the Kafka-
-        durability analog: what a broker would hold across our restart)."""
-        state: Dict[str, dict] = {}
-        for name in bus.topics():
-            t = bus.topic(name)
-            state[name] = {
-                "entries": t._log[t._head:],
-                "next": t._next_offset,
-                "groups": dict(t.group_offsets),
-            }
+    def snapshot_bus(self, bus) -> bytes:
+        """Serialize the bus's durable state NOW (synchronous, no awaits):
+        the caller runs this on the event loop so the cut is consistent
+        even on a live instance; the bytes then go to ``write_bus`` on an
+        executor thread. Uses the Topic snapshot contract — never backend
+        internals."""
+        state: Dict[str, dict] = {
+            name: bus.topic(name).snapshot_state() for name in bus.topics()
+        }
+        return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def write_bus(self, data: bytes) -> Path:
         path = self.root / "bus.ckpt"
         tmp = path.with_suffix(".tmp")
-        with tmp.open("wb") as fh:
-            pickle.dump(state, fh, protocol=pickle.HIGHEST_PROTOCOL)
-        tmp.replace(path)
+        tmp.write_bytes(data)
+        tmp.replace(path)  # atomic
         return path
+
+    def save_bus(self, bus) -> Path:
+        """One-shot snapshot+write (callers already off the event loop)."""
+        return self.write_bus(self.snapshot_bus(bus))
 
     def load_bus(self, bus) -> bool:
         path = self.root / "bus.ckpt"
@@ -94,33 +98,44 @@ class CheckpointManager:
         with path.open("rb") as fh:
             state = pickle.load(fh)
         for name, st in state.items():
-            t = bus.topic(name)
-            t._log = list(st["entries"])
-            t._head = 0
-            t._next_offset = st["next"]
-            t.group_offsets.update(st["groups"])
-            t._data_event.set()
+            bus.topic(name).restore_state(st)
         return True
 
     # -- device model + events -------------------------------------------
-    def save_tenant_stores(self, tenant: str, dm, store) -> None:
-        dm.save(self.root / "devices" / f"{tenant}.json")
+    def snapshot_tenant_stores(self, dm, store) -> dict:
+        """Capture a consistent cut of one tenant's device model + events
+        (synchronous, no awaits — safe on a live instance). Only the cheap
+        dict/array capture happens here; the returned snapshot holds
+        private copies (dicts) and never-mutated arrays (column chunks are
+        append-only), so JSON/parquet serialization runs on an executor
+        thread in ``write_tenant_stores``."""
+        return {
+            "devices": dm.snapshot(),
+            "cols": store.measurements.columns(),
+            "other": [e.to_dict() for lst in store._other.values() for e in lst],
+        }
+
+    def write_tenant_stores(self, tenant: str, snap: dict) -> None:
+        (self.root / "devices" / f"{tenant}.json").write_text(
+            json.dumps(snap["devices"], default=str)
+        )
         # deterministic filename (save_parquet's default is timestamped)
-        cols = store.measurements.columns()
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         table = pa.table({
             k: pa.array(list(v) if v.dtype == object else v)
-            for k, v in cols.items()
+            for k, v in snap["cols"].items()
         })
         pq.write_table(
             table, self.root / "events" / f"measurements-{tenant}.parquet"
         )
-        other = [e.to_dict() for lst in store._other.values() for e in lst]
         (self.root / "events" / f"events-{tenant}.jsonl").write_text(
-            "\n".join(json.dumps(d) for d in other)
+            "\n".join(json.dumps(d) for d in snap["other"])
         )
+
+    def save_tenant_stores(self, tenant: str, dm, store) -> None:
+        self.write_tenant_stores(tenant, self.snapshot_tenant_stores(dm, store))
 
     def load_device_management(self, tenant: str):
         from sitewhere_tpu.services.device_management import DeviceManagement

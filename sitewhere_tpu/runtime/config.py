@@ -130,6 +130,30 @@ def _to_jsonable(obj: Any) -> Any:
     return obj
 
 
+def tenant_config_to_dict(cfg: TenantEngineConfig) -> Dict[str, Any]:
+    """Full round-trippable dict for manifests/checkpoints — tenants added
+    with overrides (model, decoder, …) must resume with the SAME config,
+    not a re-derivation from the template."""
+    return _to_jsonable(cfg)
+
+
+def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
+    d = dict(d)
+    mb = d.pop("microbatch", None) or {}
+    if "buckets" in mb:
+        mb["buckets"] = tuple(mb["buckets"])
+    # drop unknown keys at BOTH levels: a manifest written by a newer build
+    # (extra knobs) must degrade gracefully, not abort the whole restore
+    mb_known = MicroBatchConfig.__dataclass_fields__
+    known = TenantEngineConfig.__dataclass_fields__
+    return TenantEngineConfig(
+        microbatch=MicroBatchConfig(
+            **{k: v for k, v in mb.items() if k in mb_known}
+        ),
+        **{k: v for k, v in d.items() if k in known and k != "microbatch"},
+    )
+
+
 def save_instance_config(cfg: InstanceConfig, path: str | Path) -> None:
     Path(path).write_text(json.dumps(_to_jsonable(cfg), indent=2))
 

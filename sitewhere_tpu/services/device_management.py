@@ -281,7 +281,7 @@ class DeviceManagement:
         return devices
 
     # -- snapshot persistence -------------------------------------------
-    def save(self, path: str | Path) -> None:
+    def snapshot(self) -> dict:
         def dt_dict(dt: DeviceType) -> dict:
             d = dt.to_dict()
             d["commands"] = [c.to_dict() for c in dt.commands]
@@ -300,7 +300,7 @@ class DeviceManagement:
             ]
             return d
 
-        data = {
+        return {
             "tenant": self.tenant,
             "device_types": [dt_dict(e) for e in self.device_types.values()],
             "devices": [e.to_dict() for e in self.devices.values()],
@@ -310,7 +310,9 @@ class DeviceManagement:
             "customers": [e.to_dict() for e in self.customers.values()],
             "groups": [group_dict(e) for e in self.groups.values()],
         }
-        Path(path).write_text(json.dumps(data, default=str))
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.snapshot(), default=str))
 
     @classmethod
     def load(cls, path: str | Path) -> "DeviceManagement":

@@ -155,3 +155,48 @@ async def test_tenant_params_persist_across_restart(tmp_path):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
     await inst2.terminate()
+
+
+async def test_restore_preserves_override_config(tmp_path):
+    """A tenant added with config overrides (different model than its
+    template) must resume with the SAME config — not a re-derivation from
+    the template (ADVICE r2: manifest carried only {token, template})."""
+    def make_cfg():
+        return InstanceConfig(
+            instance_id="ov", data_dir=str(tmp_path), checkpointing=True,
+        )
+
+    inst = SiteWhereInstance(make_cfg())
+    await inst.start()
+    await inst.tenant_management.create_tenant(
+        "acme", template="default", model="deepar", decoder="json",
+    )
+    await inst.drain_tenant_updates()
+    assert inst.tenants["acme"].config.model == "deepar"
+    await inst.stop()
+    await inst.checkpoint()
+    await inst.terminate()
+
+    inst2 = SiteWhereInstance(make_cfg())
+    restored = await inst2.restore()
+    assert restored == 1
+    assert inst2.tenants["acme"].config.model == "deepar"
+    assert inst2.tenants["acme"].config.template == "default"
+    await inst2.terminate()
+
+
+def test_tenant_config_dict_round_trip():
+    from sitewhere_tpu.runtime.config import (
+        MicroBatchConfig,
+        TenantEngineConfig,
+        tenant_config_from_dict,
+        tenant_config_to_dict,
+    )
+
+    cfg = TenantEngineConfig(
+        tenant="t1", template="forecasting", model="deepar",
+        model_config={"context": 64},
+        microbatch=MicroBatchConfig(max_batch=512, deadline_ms=2.0, buckets=(64, 512)),
+        max_streams=123, decoder="binary", shared_input=True,
+    )
+    assert tenant_config_from_dict(tenant_config_to_dict(cfg)) == cfg
