@@ -189,27 +189,117 @@ def bench_vit(batch: int, steps: int) -> dict:
 
 
 # ---------------------------------------------------------------- config 1
-async def _bench_e2e(secs: float, n_devices: int, burst: int = 20) -> dict:
+class _TraceCollector:
+    """Consumes persisted batches off the bus and accumulates per-stage
+    latency samples from the batch trace marks — the p99 decomposition the
+    latency budget analysis needs (stage deltas in ms)."""
+
+    STAGES = (
+        ("decode_to_inbound_ms", "decoded", "inbound"),
+        ("inbound_to_scored_ms", "inbound", "scored"),   # collect+device+RTT
+        ("scored_to_persisted_ms", "scored", "persisted"),
+    )
+
+    def __init__(self, inst, tenant: str) -> None:
+        self.inst = inst
+        self.topic = inst.bus.naming.persisted_events(tenant)
+        inst.bus.subscribe(self.topic, "bench-trace", at="latest")
+        self.samples: dict = {k: [] for k, _, _ in self.STAGES}
+        self.samples["e2e_ms"] = []  # row received_ts → persisted mark
+        self._task = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            items = await self.inst.bus.consume(self.topic, "bench-trace", 4096)
+            for b in items:
+                tr = getattr(b, "trace", None)
+                if not tr:
+                    continue
+                for key, a, z in self.STAGES:
+                    if a in tr and z in tr:
+                        self.samples[key].append(tr[z] - tr[a])
+                if "persisted" in tr and getattr(b, "n", 0):
+                    rts = b.received_ts[:: max(1, b.n // 8)]
+                    self.samples["e2e_ms"].extend(
+                        (tr["persisted"] - rts).tolist()
+                    )
+
+    def quantiles(self, q: float) -> dict:
+        out = {}
+        for k, v in self.samples.items():
+            out[k] = float(np.quantile(np.asarray(v), q)) if v else None
+        return out
+
+
+async def _bench_e2e(
+    secs: float,
+    n_devices: int,
+    burst: int = 20,
+    wire: str = "binary",
+    slots_per_shard: int = 4,
+    max_inflight: int = 16,
+    max_batch: int = 8192,
+    deadline_ms: float = 5.0,
+    paced_frac: float = 0.6,
+    paced_rate: float = 0.0,   # >0: skip saturation, pace at this fixed rate
+    hidden: int = 64,
+    window: int = 32,
+) -> dict:
     """Full pipeline E2E: sim → ingest → decode → inbound → TPU score →
-    persist → rules → outbound, one process, one tenant."""
+    persist → rules → outbound, one process, one tenant.
+
+    Phase 1 saturates (throughput); phase 2 paces at ``paced_frac`` of the
+    measured capacity (latency). Accounting is per-phase and a trace
+    collector decomposes p99 by pipeline stage."""
     from sitewhere_tpu.instance import SiteWhereInstance
-    from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        MicroBatchConfig,
+    )
     from sitewhere_tpu.sim import DeviceSimulator, SimProfile
 
     inst = SiteWhereInstance(InstanceConfig(
         instance_id="bench",
-        mesh=MeshConfig(tenant_axis=1, data_axis=1, slots_per_shard=8),
+        mesh=MeshConfig(
+            tenant_axis=1, data_axis=1, slots_per_shard=slots_per_shard
+        ),
+        inference_max_inflight=max_inflight,
     ))
     await inst.start()
     try:
-        await inst.bootstrap(default_tenant="bench", dataset_devices=n_devices)
+        mb = MicroBatchConfig(
+            max_batch=max_batch,
+            deadline_ms=deadline_ms,
+            buckets=(max_batch // 16, max_batch // 4, max_batch),
+            window=window,
+        )
+        await inst.tenant_management.create_tenant(
+            "bench", template="iot-temperature",
+            microbatch=mb, decoder=wire, max_streams=8192,
+            model_config={"hidden": hidden},
+        )
+        await inst.drain_tenant_updates()
         for _ in range(200):
             if "bench" in inst.tenants:
                 break
             await asyncio.sleep(0.02)
+        inst.tenants["bench"].device_management.bootstrap_fleet(n_devices)
         sim = DeviceSimulator(
             inst.broker,
-            SimProfile(n_devices=n_devices, seed=3, samples_per_message=burst),
+            SimProfile(n_devices=n_devices, seed=3,
+                       samples_per_message=burst, wire=wire),
             topic_pattern="sitewhere/input/{device}",
         )
         # compile every bucket shape BEFORE the timed window — a first-use
@@ -226,29 +316,52 @@ async def _bench_e2e(secs: float, n_devices: int, burst: int = 20) -> dict:
         # pre-generate wire payloads so the pump measures PIPELINE
         # throughput, not the synthetic generator's Python cost
         rounds = sim.pregenerate(64, t0=1.0)
-        start_scored = scored.value
-        t0 = time.perf_counter()
-        step = 0
-        while time.perf_counter() - t0 < secs:
-            await sim.publish_pregenerated(rounds[step % len(rounds)])
-            step += 1
-            await asyncio.sleep(0)  # yield to the pipeline
-        # drain
-        for _ in range(600):
-            if scored.value - start_scored >= sim.sent - n_devices:
-                break
-            await asyncio.sleep(0.05)
-        dt = time.perf_counter() - t0
-        n_scored = scored.value - start_scored
-        throughput = n_scored / dt
 
-        # phase 2 — PACED latency: pump at ~60% of measured capacity so p99
-        # reflects service latency, not saturation queueing
+        # ---- phase 1: saturation (throughput) --------------------------
+        if paced_rate > 0:
+            # latency-only mode (e.g. the CPU-backend decomposition run):
+            # no saturation phase, so no inherited backlog pollutes p99
+            throughput = paced_rate / max(paced_frac, 1e-9)
+            sat = {"skipped": True}
+            dt = 0.0
+            n_scored = 0
+        else:
+            sent_before = sim.sent
+            start_scored = scored.value
+            t0 = time.perf_counter()
+            step = 0
+            while time.perf_counter() - t0 < secs:
+                await sim.publish_pregenerated(rounds[step % len(rounds)])
+                step += 1
+                await asyncio.sleep(0)  # yield to the pipeline
+            sat_sent = sim.sent - sent_before
+            pump_s = time.perf_counter() - t0
+            drain_converged = False
+            for _ in range(600):
+                if scored.value - start_scored >= sat_sent - n_devices:
+                    drain_converged = True
+                    break
+                await asyncio.sleep(0.05)
+            dt = time.perf_counter() - t0
+            n_scored = scored.value - start_scored
+            throughput = n_scored / dt
+            sat = {
+                "sent": int(sat_sent),
+                "scored": int(n_scored),
+                "pump_s": pump_s,
+                "duration_s": dt,
+                "drain_converged": drain_converged,
+            }
+
+        # ---- phase 2: paced latency ------------------------------------
         hist = inst.metrics.histogram("tpu_inference.latency", unit="s")
         hist.reset()
+        tracer = _TraceCollector(inst, "bench")
+        tracer.start()
         per_round = n_devices * burst
-        target_rate = max(throughput * 0.6, per_round)
+        target_rate = max(throughput * paced_frac, per_round)
         interval = per_round / target_rate
+        paced_before = sim.sent
         t1 = time.perf_counter()
         step = 0
         while time.perf_counter() - t1 < min(secs, 8.0):
@@ -259,44 +372,106 @@ async def _bench_e2e(secs: float, n_devices: int, burst: int = 20) -> dict:
             if delay > 0:
                 await asyncio.sleep(delay)
         await asyncio.sleep(1.0)  # let the tail drain into the histogram
+        await tracer.stop()
 
         persisted = inst.metrics.counter("event_management.persisted").value
         return {
             "events_per_sec": throughput,
-            "sent": sim.sent,
-            "scored": int(n_scored),
+            "wire": wire,
+            "saturation": sat,
+            "paced": {
+                "sent": int(sim.sent - paced_before),
+                "rate": target_rate,
+                "p50_ms": hist.quantile(0.5) * 1e3,
+                "p99_ms": hist.quantile(0.99) * 1e3,
+                "stage_p99_ms": tracer.quantiles(0.99),
+                "stage_p50_ms": tracer.quantiles(0.5),
+            },
             "persisted": int(persisted),
-            "paced_rate": target_rate,
+            "devices": n_devices,
+            "burst": burst,
+            "slots_per_shard": slots_per_shard,
+            "max_inflight": max_inflight,
+            "max_batch": max_batch,
+            # back-compat flat fields (BENCH_r0{2,3} dashboards)
+            "sent": int(sim.sent),
+            "scored": int(n_scored),
             "p50_ms": hist.quantile(0.5) * 1e3,
             "p99_ms": hist.quantile(0.99) * 1e3,
             "duration_s": dt,
-            "devices": n_devices,
-            "burst": burst,
         }
     finally:
         await inst.terminate()
 
 
-def bench_e2e(secs: float, n_devices: int) -> dict:
-    return asyncio.run(_bench_e2e(secs, n_devices))
+def bench_e2e(secs: float, n_devices: int, **kw) -> dict:
+    return asyncio.run(_bench_e2e(secs, n_devices, **kw))
+
+
+def bench_e2e_cpu_subprocess(secs: float) -> dict:
+    """Run the E2E latency phase on the CPU backend (RTT=0) in a fresh
+    subprocess — isolates host+collect latency from the tunnel RTT, per
+    the p99 budget decomposition. Small config: CPU LSTM compute would
+    otherwise dominate the very latency being measured."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--configs", "e2e", "--backend", "cpu",
+             "--e2e-secs", str(secs), "--e2e-wire", "binary",
+             "--e2e-slots", "1", "--e2e-max-batch", "256", "--e2e-burst", "2",
+             "--e2e-paced-rate", "4000",
+             "--e2e-hidden", "32", "--e2e-window", "16"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        # a hung child must not take down the whole bench run (the driver
+        # depends on the one-JSON-line stdout contract)
+        return {"error": "cpu-backend e2e subprocess timed out (900s)"}
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or "")[-800:]}
+    try:
+        full = json.loads(proc.stdout.strip().splitlines()[-1])
+        return full["e2e_pipeline"]
+    except (ValueError, KeyError, IndexError) as exc:
+        return {"error": f"parse: {exc}; stdout tail: {proc.stdout[-400:]}"}
 
 
 # ---------------------------------------------------------------- main
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--configs", default="all",
-                   help="comma list: e2e,lstm,deepar,tenants32,vit or all")
+                   help="comma list: e2e,e2e-json,e2e-cpu,lstm,deepar,"
+                        "tenants32,vit or all")
     p.add_argument("--e2e-secs", type=float, default=10.0)
+    p.add_argument("--e2e-wire", default="binary", choices=["binary", "json"])
+    p.add_argument("--e2e-slots", type=int, default=4)
+    p.add_argument("--e2e-max-batch", type=int, default=8192)
+    p.add_argument("--e2e-paced-frac", type=float, default=0.6)
+    p.add_argument("--e2e-paced-rate", type=float, default=0.0)
+    p.add_argument("--e2e-burst", type=int, default=50)
+    p.add_argument("--e2e-hidden", type=int, default=64)
+    p.add_argument("--e2e-window", type=int, default=32)
     p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--backend", default="",
+                   help="force a jax platform (e.g. cpu) — env alone loses "
+                        "to the image's sitecustomize pin")
     p.add_argument("--profile", default="",
                    help="directory: capture a jax.profiler trace of config 4")
     args = p.parse_args()
     which = set(args.configs.split(",")) if args.configs != "all" else {
-        "e2e", "lstm", "deepar", "tenants32", "vit"
+        "e2e", "e2e-json", "e2e-cpu", "lstm", "deepar", "tenants32", "vit"
     }
 
     import jax
 
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
     dev = jax.devices()[0]
     details: dict = {
         "platform": dev.platform,
@@ -339,9 +514,37 @@ def main() -> None:
 
     if "e2e" in which:
         log("config 1: full-pipeline E2E (sim -> ... -> outbound) ...")
-        details["e2e_pipeline"] = bench_e2e(args.e2e_secs, n_devices=100)
+        details["e2e_pipeline"] = bench_e2e(
+            args.e2e_secs, n_devices=100, burst=args.e2e_burst,
+            wire=args.e2e_wire,
+            slots_per_shard=args.e2e_slots, max_batch=args.e2e_max_batch,
+            paced_frac=args.e2e_paced_frac, paced_rate=args.e2e_paced_rate,
+            hidden=args.e2e_hidden, window=args.e2e_window,
+        )
         log(f"  -> {details['e2e_pipeline']['events_per_sec']:.0f} ev/s e2e, "
             f"p99={details['e2e_pipeline']['p99_ms']:.1f}ms")
+
+    if "e2e-json" in which:
+        log("config 1b: E2E on the JSON wire ...")
+        # identical workload to config 1 except the wire — the delta
+        # isolates wire format, not burst amortization
+        details["e2e_pipeline_json"] = bench_e2e(
+            min(args.e2e_secs, 8.0), n_devices=100, burst=args.e2e_burst,
+            wire="json",
+            slots_per_shard=args.e2e_slots, max_batch=args.e2e_max_batch,
+            paced_frac=args.e2e_paced_frac,
+            hidden=args.e2e_hidden, window=args.e2e_window,
+        )
+        log(f"  -> {details['e2e_pipeline_json']['events_per_sec']:.0f} "
+            f"ev/s e2e (json)")
+
+    if "e2e-cpu" in which:
+        log("config 1c: E2E latency on the CPU backend (RTT=0) ...")
+        details["e2e_pipeline_cpu"] = bench_e2e_cpu_subprocess(6.0)
+        cpu = details["e2e_pipeline_cpu"]
+        if "error" not in cpu:
+            log(f"  -> p99={cpu['paced']['p99_ms']:.1f}ms at "
+                f"{cpu['paced']['rate']:.0f} ev/s paced (cpu backend)")
 
     # headline: the north-star metric — device events/sec anomaly-scored
     # through the 32-tenant stacked engine (BASELINE.json:5,10)

@@ -54,6 +54,34 @@ class MeasurementBatch:
     # batch-level trace marks (stage → epoch ms) — the columnar analog of
     # DeviceEvent.trace for p99 accounting
     trace: Dict[str, float] = field(default_factory=dict)
+    # cached group indices: (uniq object[], inverse int32[]) for the token /
+    # name columns. np.unique over object arrays is a string argsort — the
+    # single biggest per-batch host cost when every stage re-derives it —
+    # so it's computed at most once per batch (or inherited for free from
+    # the bulk wire's chunk structure) and shared by inbound, the stream
+    # registry, and device-state
+    tok_index: Optional[tuple] = None
+    name_index: Optional[tuple] = None
+
+    def token_index(self) -> tuple:
+        if self.tok_index is None:
+            u, inv = np.unique(self.device_tokens, return_inverse=True)
+            self.tok_index = (u, inv.astype(np.int32))
+        return self.tok_index
+
+    def names_index(self) -> tuple:
+        if self.name_index is None:
+            u, inv = np.unique(self.names, return_inverse=True)
+            self.name_index = (u, inv.astype(np.int32))
+        return self.name_index
+
+    def pair_codes(self) -> np.ndarray:
+        """int64[n] code per (device_token, name) pair — the single
+        audited combination of the two cached group indices (token code ×
+        name-vocab + name code). Equal codes ⇔ equal (token, name)."""
+        _, ti = self.token_index()
+        un, ni = self.names_index()
+        return ti.astype(np.int64) * len(un) + ni
 
     def mark(self, stage: str) -> None:
         self.trace[stage] = time.time() * 1000.0
@@ -135,7 +163,6 @@ class MeasurementBatch:
         ets = np.asarray(event_ts, np.float64)
         if (ets == 0).any():
             ets = np.where(ets == 0, now, ets)
-        prefix = uuid.uuid4().hex[:16]
         return MeasurementBatch(
             tenant=tenant,
             stream_ids=np.zeros((n,), np.int32),
@@ -143,12 +170,79 @@ class MeasurementBatch:
             event_ts=ets,
             received_ts=np.full((n,), now, np.float64),
             valid=np.ones((n,), bool),
-            event_ids=np.asarray(
-                [f"{prefix}-{i:06d}" for i in range(n)], object
-            ),
+            event_ids=None,  # lazily generated at the edges (ensure_event_ids)
             device_tokens=np.asarray(device_tokens, object),
             names=np.asarray(names, object),
         )
+
+    @staticmethod
+    def from_column_chunks(
+        tenant: str,
+        chunks: Sequence[tuple],
+        received_ms: Optional[float] = None,
+    ) -> "MeasurementBatch":
+        """Build from decoder chunk tuples ``(device_token, name,
+        values f32[k], event_ts f64[k])`` — the bulk-binary-wire ingest
+        path. Zero per-row Python: token/name columns are C-level
+        ``np.full`` fills, numeric columns concatenate."""
+        now = received_ms if received_ms is not None else time.time() * 1000.0
+
+        def cat(parts, dtype):
+            return (
+                np.asarray(parts[0], dtype)
+                if len(parts) == 1
+                else np.concatenate([np.asarray(p, dtype) for p in parts])
+            )
+
+        values = cat([c[2] for c in chunks], np.float32)
+        ets = cat([c[3] for c in chunks], np.float64)
+        if (ets == 0).any():
+            ets = np.where(ets == 0, now, ets)
+        n = int(values.shape[0])
+        toks = np.concatenate(
+            [np.full((len(c[2]),), c[0], object) for c in chunks]
+        )
+        names = np.concatenate(
+            [np.full((len(c[2]),), c[1], object) for c in chunks]
+        )
+        # group indices come FREE from the chunk structure (one (device,
+        # name) per chunk) — O(chunks), no string sort ever
+        lens = [len(c[2]) for c in chunks]
+        tok_map: dict = {}
+        name_map: dict = {}
+        tok_codes = [tok_map.setdefault(c[0], len(tok_map)) for c in chunks]
+        name_codes = [name_map.setdefault(c[1], len(name_map)) for c in chunks]
+        return MeasurementBatch(
+            tenant=tenant,
+            stream_ids=np.zeros((n,), np.int32),
+            values=values,
+            event_ts=ets,
+            received_ts=np.full((n,), now, np.float64),
+            valid=np.ones((n,), bool),
+            event_ids=None,
+            device_tokens=toks,
+            names=names,
+            tok_index=(
+                np.asarray(list(tok_map), object),
+                np.repeat(np.asarray(tok_codes, np.int32), lens),
+            ),
+            name_index=(
+                np.asarray(list(name_map), object),
+                np.repeat(np.asarray(name_codes, np.int32), lens),
+            ),
+        )
+
+    def ensure_event_ids(self) -> np.ndarray:
+        """Materialize per-row event ids on demand. Generated vectorized
+        (batch-unique prefix + row index) only where an edge actually needs
+        ids (event store seal, REST/object materialization) — the scoring
+        hot path never pays for them."""
+        if self.event_ids is None:
+            prefix = uuid.uuid4().hex[:16] + "-"
+            self.event_ids = np.char.add(
+                prefix, np.arange(self.n).astype("U8")
+            ).astype(object)
+        return self.event_ids
 
     def select(self, idx: np.ndarray) -> "MeasurementBatch":
         """Row subset (fancy index or bool mask) carrying every column."""
@@ -174,7 +268,7 @@ class MeasurementBatch:
     def to_events(self) -> List[DeviceMeasurement]:
         """Materialize rows as edge objects (REST/conn/rules slow path)."""
         out: List[DeviceMeasurement] = []
-        ids = self.event_ids
+        ids = self.ensure_event_ids() if self.n else self.event_ids
         toks = self.device_tokens
         names = self.names
         asg = self.assignment_tokens
@@ -243,6 +337,12 @@ class MeasurementBatch:
         bs: List[MeasurementBatch] = [b for b in batches if b.n]
         if not bs:
             return MeasurementBatch.empty()
+        if any(b.event_ids is not None for b in bs):
+            # mixed lazy/materialized ids: materialize the lazy sides now —
+            # the ""-fill below would otherwise permanently block
+            # ensure_event_ids on the combined batch
+            for b in bs:
+                b.ensure_event_ids()
 
         def _cat_opt(col: str, fill, dtype) -> Optional[np.ndarray]:
             # preserve optional columns row-aligned even when some inputs

@@ -135,6 +135,7 @@ class SiteWhereInstance(LifecycleComponent):
         self.inference = TpuInferenceService(
             self.bus, self.mesh, self.metrics,
             slots_per_shard=cfg.mesh.slots_per_shard,
+            max_inflight=cfg.inference_max_inflight,
             checkpoints=self.checkpoints,
         )
         self.add_child(self.inference)
@@ -362,9 +363,16 @@ class SiteWhereInstance(LifecycleComponent):
         ck = self.checkpoints
         if ck is None:
             raise RuntimeError("checkpointing disabled (InstanceConfig)")
-        # phase 1 — consistent cut, no awaits
+        # phase 1 — consistent cut, no awaits. Params are materialized to
+        # copied numpy HERE on the loop thread: np.asarray of jax arrays on
+        # the executor thread races the jax runtime (heap corruption)
+        from sitewhere_tpu.runtime.checkpoint import host_copy_params
+
         bus_bytes = ck.snapshot_bus(self.bus)
-        param_snaps = self.inference.snapshot_params()
+        param_snaps = {
+            key: host_copy_params(tree)
+            for key, tree in self.inference.snapshot_params().items()
+        }
         tenant_snaps = {
             token: ck.snapshot_tenant_stores(rt.device_management, rt.event_store)
             for token, rt in self.tenants.items()

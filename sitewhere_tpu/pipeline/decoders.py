@@ -149,6 +149,12 @@ class JsonDecoder:
 
 MAGIC = 0x5754
 _MSG_MEASUREMENT, _MSG_LOCATION, _MSG_ALERT, _MSG_REGISTER, _MSG_ACK = range(5)
+# bulk burst: ONE message carries a device's buffered samples for one
+# measurement name — the analog of the reference's multi-sample
+# `DeviceMeasurements` protobuf (SURVEY.md §2.1 sitewhere-communication [U]).
+#   body: name (u8 len+bytes), count u32, base_ts u64, stride_ms u32,
+#         values f32[count] (LE)
+_MSG_MEASUREMENTS_BULK = 5
 _ALERT_LEVELS = [AlertLevel.INFO, AlertLevel.WARNING, AlertLevel.ERROR, AlertLevel.CRITICAL]
 
 
@@ -188,6 +194,40 @@ class BinaryDecoder:
 
     name = "binary"
 
+    def decode_any(self, payload: bytes, context=None):
+        """Columnar fast path: a payload made ENTIRELY of bulk-measurement
+        messages decodes to ``("columns_np", [(device, name, values f32[k],
+        event_ts f64[k]), ...])`` — numeric columns come straight off the
+        wire via ``np.frombuffer``, zero per-row Python. Anything else
+        falls back to the per-message request path."""
+        import numpy as np
+
+        r = _Reader(payload)
+        chunks: List[tuple] = []
+        while r.more:
+            start = r.off
+            if r.u("<H") != MAGIC:
+                raise DecodeError("bad magic")
+            if r.u("<B") != 1:
+                raise DecodeError("unsupported binary version")
+            msg = r.u("<B")
+            if msg != _MSG_MEASUREMENTS_BULK:
+                r.off = start
+                return "requests", self.decode(payload, context)
+            device = r.s()
+            name = r.s()
+            count = r.u("<I")
+            base_ts = r.u("<Q")
+            stride = r.u("<I")
+            nbytes = count * 4
+            if r.off + nbytes > len(r.data):
+                raise DecodeError("truncated bulk values")
+            vals = np.frombuffer(r.data, "<f4", count, r.off)
+            r.off += nbytes
+            ets = base_ts + stride * np.arange(count, dtype=np.float64)
+            chunks.append((device, name, vals, ets))
+        return "columns_np", chunks
+
     def decode(self, payload: bytes, context=None) -> List[Dict[str, Any]]:
         r = _Reader(payload)
         out: List[Dict[str, Any]] = []
@@ -199,7 +239,22 @@ class BinaryDecoder:
                 raise DecodeError(f"unsupported binary version {version}")
             msg = r.u("<B")
             device = r.s()
-            if msg == _MSG_MEASUREMENT:
+            if msg == _MSG_MEASUREMENTS_BULK:
+                name = r.s()
+                count = r.u("<I")
+                base_ts = r.u("<Q")
+                stride = r.u("<I")
+                for j in range(count):
+                    out.append(
+                        {
+                            "type": "measurement",
+                            "device_token": device,
+                            "name": name,
+                            "value": r.u("<f"),
+                            "event_ts": base_ts + j * stride,
+                        }
+                    )
+            elif msg == _MSG_MEASUREMENT:
                 out.append(
                     {
                         "type": "measurement",
@@ -263,6 +318,30 @@ def encode_measurement_binary(
         + _pack_str(device_token)
         + _pack_str(name)
         + struct.pack("<dQ", value, event_ts if event_ts is not None else now_ms())
+    )
+
+
+def encode_measurements_bulk_binary(
+    device_token: str,
+    name: str,
+    values,
+    base_ts: Optional[int] = None,
+    stride_ms: int = 1,
+) -> bytes:
+    """Encode a device's buffered burst of samples as ONE bulk message
+    (values f32, timestamps base + i*stride) — the high-rate wire format."""
+    import numpy as np
+
+    arr = np.asarray(values, "<f4")
+    return (
+        struct.pack("<HBB", MAGIC, 1, _MSG_MEASUREMENTS_BULK)
+        + _pack_str(device_token)
+        + _pack_str(name)
+        + struct.pack(
+            "<IQI", arr.shape[0],
+            base_ts if base_ts is not None else now_ms(), stride_ms,
+        )
+        + arr.tobytes()
     )
 
 

@@ -81,7 +81,7 @@ class InboundProcessor(LifecycleComponent):
         rejected = self.metrics.counter("inbound.rejected")
 
         tokens = batch.device_tokens
-        uniq, inverse = np.unique(tokens, return_inverse=True)
+        uniq, inverse = batch.token_index()
         asg_by_u = np.empty((len(uniq),), object)
         area_by_u = np.empty((len(uniq),), object)
         status = np.zeros((len(uniq),), np.int8)  # 0 ok, 1 unknown, 2 no-asg

@@ -82,6 +82,30 @@ class StreamRegistry:
         self._map[key] = (shard, local_id)
         return shard, local_id
 
+    def lookup_or_assign_bulk(
+        self, batch: MeasurementBatch
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized per-row (data_shard, local_id): one dict lookup per
+        UNIQUE (token, name) pair; rows inherit via inverse indices. Rows
+        that can't get a slot come back with shard == -1. Group indices
+        come from the batch's cached token/name index (integer codes — no
+        string sorts here)."""
+        _, first, inverse = np.unique(
+            batch.pair_codes(), return_index=True, return_inverse=True
+        )
+        tokens, names = batch.device_tokens, batch.names
+        d_u = np.empty((len(first),), np.int32)
+        l_u = np.empty((len(first),), np.int32)
+        lookup = self.lookup_or_assign
+        for j, fi in enumerate(first.tolist()):
+            assigned = lookup(str(tokens[fi]), str(names[fi]))
+            if assigned is None:
+                d_u[j] = -1
+                l_u[j] = 0
+            else:
+                d_u[j], l_u[j] = assigned
+        return d_u[inverse], l_u[inverse]
+
     @property
     def n_streams(self) -> int:
         return len(self._map)
@@ -173,8 +197,12 @@ class TpuInferenceEngine(TenantEngine):
             scorer = svc.scorers.get(self.config.model)
             if scorer is not None and svc.checkpoints is not None:
                 # save this tenant's (possibly trained) weights BEFORE the
-                # slot wipe below destroys them
-                params = scorer.slot_params(slot)
+                # slot wipe below destroys them. Materialize to numpy ON
+                # THIS (loop) thread — jax materialization on the executor
+                # thread races the runtime (heap corruption)
+                from sitewhere_tpu.runtime.checkpoint import host_copy_params
+
+                params = host_copy_params(scorer.slot_params(slot))
                 await asyncio.get_running_loop().run_in_executor(
                     None, svc.checkpoints.save_params,
                     self.tenant, self.config.model, params,
@@ -213,7 +241,7 @@ class TpuInferenceService(MultitenantService):
         metrics: Optional[MetricsRegistry] = None,
         slots_per_shard: int = 8,
         poll_batch: int = 64,
-        max_inflight: int = 4,
+        max_inflight: int = 8,
         checkpoints=None,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
@@ -232,6 +260,8 @@ class TpuInferenceService(MultitenantService):
         self._next_seq = 0
         self._inflight = asyncio.Semaphore(max_inflight)
         self._deliver_tasks: set = set()
+        self.max_inflight = max_inflight
+        self._deliver_pool = None  # created on start, shut down on stop
 
     @property
     def group(self) -> str:
@@ -262,6 +292,14 @@ class TpuInferenceService(MultitenantService):
     # -- lifecycle -------------------------------------------------------
     async def on_start(self) -> None:
         await super().on_start()
+        # dedicated materialization pool: the default loop executor may have
+        # fewer workers than max_inflight, which would serialize the very
+        # device→host transfers the semaphore is meant to pipeline
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._deliver_pool = ThreadPoolExecutor(
+            max_workers=self.max_inflight, thread_name_prefix="tpu-deliver"
+        )
         self._loop_task = asyncio.create_task(
             self._scoring_loop(), name="tpu-inference-loop"
         )
@@ -287,6 +325,9 @@ class TpuInferenceService(MultitenantService):
                 if lane.count:
                     _i, _v, seqs, rows = lane.pop(lane.count)
                     await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+        if self._deliver_pool is not None:
+            self._deliver_pool.shutdown(wait=False)
+            self._deliver_pool = None
 
     # -- ingestion → lanes (columnar) ------------------------------------
     async def _enqueue_batch(self, engine: TpuInferenceEngine, batch: MeasurementBatch) -> None:
@@ -303,22 +344,11 @@ class TpuInferenceService(MultitenantService):
         entry = [batch, n]
         self._batches[seq] = entry
 
-        # per-row (dshard, local_id) via the registry; the dict lookup runs
-        # in a C-level zip loop — no event objects, no awaits
-        lookup = engine.streams.lookup_or_assign
-        dshards = np.empty((n,), np.int32)
-        locals_ = np.empty((n,), np.int32)
-        toks = batch.device_tokens.tolist()
-        names = batch.names.tolist()
-        skipped = 0
-        for i, (tok, nm) in enumerate(zip(toks, names)):
-            assigned = lookup(tok, nm)
-            if assigned is None:
-                dshards[i] = -1
-                locals_[i] = 0
-                skipped += 1
-            else:
-                dshards[i], locals_[i] = assigned[0], assigned[1]
+        # per-row (dshard, local_id): one registry lookup per UNIQUE
+        # (device, name) series, scattered back via inverse indices — no
+        # event objects, no awaits, no per-row Python
+        dshards, locals_ = engine.streams.lookup_or_assign_bulk(batch)
+        skipped = int((dshards == -1).sum())
         if skipped:
             self.metrics.counter("tpu_inference.skipped_capacity").inc(skipped)
             entry[1] -= skipped
@@ -401,17 +431,20 @@ class TpuInferenceService(MultitenantService):
         and hand score materialization to a pipelined delivery task."""
         scorer = self.scorers[family]
         lanes = self._lanes[family]
-        pending_max = max((l.count for l in lanes.values()), default=0)
-        if pending_max == 0:
+        if not any(l.count for l in lanes.values()):
             self._first_pending_ts.pop(family, None)
             return 0
         any_cfg = next(iter(engine_cfgs.values()))
         mb = any_cfg.microbatch
-        b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
         # acquire the in-flight slot BEFORE popping rows off the lanes:
         # a cancellation while waiting here must not strand popped rows
-        # (everything from the pop to create_task below is await-free)
+        # (everything from the pop to create_task below is await-free).
         await self._inflight.acquire()
+        # pick the bucket AFTER the (possibly long) acquire wait: rows that
+        # accumulated while every slot was busy should ride out in ONE
+        # bigger flush, not drain at the stale pre-wait size
+        pending_max = max((l.count for l in lanes.values()), default=0)
+        b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
         t, d = scorer.n_slots, self.mm.n_data_shards
         ids = np.zeros((t, d * b_lane), np.int32)
         vals = np.zeros((t, d * b_lane), np.float32)
@@ -458,7 +491,7 @@ class TpuInferenceService(MultitenantService):
         """Materialize one flush's scores off the loop and resolve rows."""
         try:
             scores_np = await asyncio.get_running_loop().run_in_executor(
-                None, np.asarray, scores_dev
+                self._deliver_pool, np.asarray, scores_dev
             )
             slots, cols, seqs, rows = taken
             await self._resolve_rows(seqs, rows, scores_np[slots, cols])

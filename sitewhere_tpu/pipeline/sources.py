@@ -125,8 +125,12 @@ class EventSource(LifecycleComponent):
         await cancel_and_wait(self._pump)
         self._pump = None
 
-    # max raw payloads drained per cycle → bounds the columnar batch size
+    # per-cycle caps → bound the columnar batch size. DRAIN caps raw
+    # payloads; EVENT_CAP caps decoded EVENTS, so bulk/burst wire messages
+    # (100s of samples each) can't snowball into monster batches that
+    # destabilize downstream flush sizing
     DRAIN = 8192
+    EVENT_CAP = 16384
 
     async def _run(self) -> None:
         decoded_topic = self.bus.naming.decoded_events(self.tenant)
@@ -139,22 +143,22 @@ class EventSource(LifecycleComponent):
         while True:
             # block for the first payload, then drain whatever is queued —
             # the columnar fast path forms one MeasurementBatch per cycle
-            # instead of publishing per-event objects (SURVEY.md §7 step 1)
-            batch_raw = [await q.get()]
-            while len(batch_raw) < self.DRAIN:
-                try:
-                    batch_raw.append(q.get_nowait())
-                except asyncio.QueueEmpty:
-                    break
-            received.inc(len(batch_raw))
+            # instead of publishing per-event objects (SURVEY.md §7 step 1).
+            # Payloads decode AS they drain so the event cap can stop the
+            # cycle mid-queue.
             measurements: list = []
             # columnar accumulators (zero-dict decode fast path)
             c_toks: list = []
             c_names: list = []
             c_vals: list = []
             c_ets: list = []
+            # array-chunk accumulator (bulk binary wire: zero per-row work)
+            np_chunks: list = []
             decode_any = getattr(self.decoder, "decode_any", None)
-            now = now_ms()
+            n_payloads = 0
+            n_events = 0
+            now = 0  # stamped AFTER the blocking get — idle wait must not
+            # count toward the rows' ingest latency
 
             async def report_failed(payload, context, exc) -> None:
                 failed.inc()
@@ -169,7 +173,11 @@ class EventSource(LifecycleComponent):
                     },
                 )
 
-            for payload, context in batch_raw:
+            item = await q.get()
+            now = now_ms()
+            while True:
+                payload, context = item
+                n_payloads += 1
                 try:
                     if decode_any is not None:
                         kind, out = decode_any(payload, context)
@@ -178,30 +186,40 @@ class EventSource(LifecycleComponent):
                 except Exception as exc:  # noqa: BLE001 - any bad payload (incl.
                     # UnicodeDecodeError from garbled bytes) must not kill the pump
                     await report_failed(payload, context, exc)
-                    continue
+                    kind, out = "requests", []
                 if kind == "columns":
                     toks, names, vals, ets = out
                     c_toks.extend(toks)
                     c_names.extend(names)
                     c_vals.extend(vals)
                     c_ets.extend(ets)
-                    continue
-                for req in out:
-                    rid = req.get("id")
-                    if self.dedup and rid and self.dedup.seen(str(rid)):
-                        duped.inc()
-                        continue
-                    req.setdefault("received_ts", now)
-                    if req.get("type", "measurement") == "measurement":
-                        measurements.append(req)
-                    else:
-                        req["_source"] = self.source_id
-                        await self.bus.publish(decoded_topic, req)
-                        decoded_ctr.inc()
+                    n_events += len(vals)
+                elif kind == "columns_np":
+                    np_chunks.extend(out)
+                    n_events += sum(len(c[2]) for c in out)
+                else:
+                    n_events += len(out)
+                    await self._route_requests(
+                        out, measurements, decoded_topic, duped, decoded_ctr, now
+                    )
+                if n_events >= self.EVENT_CAP or n_payloads >= self.DRAIN:
+                    break
+                try:
+                    item = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            received.inc(n_payloads)
             out_batches = []
             # batch construction must not kill the pump on one malformed
             # row (e.g. a string value the decoder didn't vet) — drop the
             # offending group to the failed topic instead
+            if np_chunks:
+                try:
+                    out_batches.append(MeasurementBatch.from_column_chunks(
+                        self.tenant, np_chunks, received_ms=float(now),
+                    ))
+                except Exception as exc:  # noqa: BLE001
+                    await report_failed(b"<bulk chunk batch>", {}, exc)
             if c_vals:
                 try:
                     out_batches.append(MeasurementBatch.from_columns(
@@ -235,6 +253,24 @@ class EventSource(LifecycleComponent):
                 mb.mark("decoded")
                 await self.bus.publish(decoded_topic, mb)
                 decoded_ctr.inc(mb.n)
+
+    async def _route_requests(
+        self, reqs, measurements, decoded_topic, duped, decoded_ctr, now
+    ) -> None:
+        """Non-columnar requests: dedup, split measurements (batched later)
+        from other event types (published as objects immediately)."""
+        for req in reqs:
+            rid = req.get("id")
+            if self.dedup and rid and self.dedup.seen(str(rid)):
+                duped.inc()
+                continue
+            req.setdefault("received_ts", now)
+            if req.get("type", "measurement") == "measurement":
+                measurements.append(req)
+            else:
+                req["_source"] = self.source_id
+                await self.bus.publish(decoded_topic, req)
+                decoded_ctr.inc()
 
 
 def make_source(

@@ -31,6 +31,23 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 
+def host_copy_params(params: Any) -> Any:
+    """Materialize a (possibly jax) param pytree into COPIED numpy arrays
+    on the calling thread. Call this ON THE EVENT-LOOP THREAD before
+    handing params to an executor: ``np.asarray`` of jax CPU arrays from a
+    worker thread races the jax runtime and corrupts the heap (observed as
+    intermittent segfaults surfacing later inside unrelated pyarrow
+    calls)."""
+    import jax
+
+    # numpy leaves pass through (already host-side, typically pre-copied by
+    # this very function on the loop thread) — only device arrays copy
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, np.ndarray) else np.array(x, copy=True),
+        params,
+    )
+
+
 class CheckpointManager:
     """Owns the data_dir layout; all methods are synchronous (callers
     off-load to an executor when on the event loop)."""
@@ -46,10 +63,10 @@ class CheckpointManager:
         return self.root / "params" / f"{tenant}.{family}.ckpt"
 
     def save_params(self, tenant: str, family: str, params: Any) -> Path:
-        """Persist a param pytree (device arrays → numpy)."""
-        import jax
-
-        host_tree = jax.tree_util.tree_map(np.asarray, params)
+        """Persist a param pytree. Callers on an event loop must pass a
+        tree already materialized via ``host_copy_params`` (see its
+        docstring) — this method may run on an executor thread."""
+        host_tree = host_copy_params(params)
         path = self._params_path(tenant, family)
         tmp = path.with_suffix(".tmp")
         with tmp.open("wb") as fh:
@@ -103,35 +120,43 @@ class CheckpointManager:
 
     # -- device model + events -------------------------------------------
     def snapshot_tenant_stores(self, dm, store) -> dict:
-        """Capture a consistent cut of one tenant's device model + events
-        (synchronous, no awaits — safe on a live instance). Only the cheap
-        dict/array capture happens here; the returned snapshot holds
-        private copies (dicts) and never-mutated arrays (column chunks are
-        append-only), so JSON/parquet serialization runs on an executor
-        thread in ``write_tenant_stores``."""
-        return {
-            "devices": dm.snapshot(),
-            "cols": store.measurements.columns(),
-            "other": [e.to_dict() for lst in store._other.values() for e in lst],
-        }
+        """Capture + SERIALIZE a consistent cut of one tenant's device
+        model + events (synchronous, no awaits — safe on a live instance).
 
-    def write_tenant_stores(self, tenant: str, snap: dict) -> None:
-        (self.root / "devices" / f"{tenant}.json").write_text(
-            json.dumps(snap["devices"], default=str)
-        )
-        # deterministic filename (save_parquet's default is timestamped)
+        All native serialization (the arrow table build + parquet encode)
+        happens HERE on the calling (event-loop) thread: constructing a
+        ParquetWriter on an executor thread while the jax runtime is live
+        segfaults intermittently in this image, so the snapshot hands the
+        executor nothing but ready-to-write bytes."""
         import pyarrow as pa
         import pyarrow.parquet as pq
 
+        cols = store.measurements.columns()
         table = pa.table({
-            k: pa.array(list(v) if v.dtype == object else v)
-            for k, v in snap["cols"].items()
+            k: pa.array([str(x) for x in v] if v.dtype == object else v)
+            for k, v in cols.items()
         })
-        pq.write_table(
-            table, self.root / "events" / f"measurements-{tenant}.parquet"
-        )
+        sink = pa.BufferOutputStream()
+        pq.write_table(table, sink)
+        return {
+            "devices": json.dumps(dm.snapshot(), default=str),
+            "parquet": sink.getvalue().to_pybytes(),
+            "other": "\n".join(
+                json.dumps(e.to_dict())
+                for lst in store._other.values()
+                for e in lst
+            ),
+        }
+
+    def write_tenant_stores(self, tenant: str, snap: dict) -> None:
+        """Pure file IO — safe on an executor thread (bytes in, disk out)."""
+        (self.root / "devices" / f"{tenant}.json").write_text(snap["devices"])
+        path = self.root / "events" / f"measurements-{tenant}.parquet"
+        tmp = path.with_suffix(".tmp")
+        tmp.write_bytes(snap["parquet"])
+        tmp.replace(path)  # atomic: no torn parquet on crash mid-write
         (self.root / "events" / f"events-{tenant}.jsonl").write_text(
-            "\n".join(json.dumps(d) for d in snap["other"])
+            snap["other"]
         )
 
     def save_tenant_stores(self, tenant: str, dm, store) -> None:

@@ -70,6 +70,10 @@ class InstanceConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     default_tenant_template: str = "default"
     bus_retention: int = 65536
+    # concurrent in-flight score materializations: each flush's device→host
+    # transfer rides its own executor thread, so throughput over a
+    # high-latency link ≈ max_inflight × flush_rows / RTT
+    inference_max_inflight: int = 8
     # opt-in durability: per-tenant params on engine stop/start, bus
     # offsets+logs, device model + event stores under data_dir
     checkpointing: bool = False

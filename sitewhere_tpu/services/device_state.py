@@ -115,37 +115,49 @@ class DeviceStateService(LifecycleComponent):
                 del st.latest_alerts[:16]
 
     def apply_batch(self, b: MeasurementBatch) -> None:
-        """Columnar rollup: one pass over plain Python lists (tolist() is a
-        C-level bulk convert; per-row numpy scalar getitem would triple the
-        cost); last row per (device, name) wins (rows are event-ordered)."""
+        """Columnar rollup, vectorized: presence/interaction update once per
+        UNIQUE device, latest-measurement write once per unique
+        (device, name) — last row wins (rows are event-ordered). Python
+        loops run over uniques (~#devices), never over rows."""
+        if b.n == 0:
+            return
         states = self.states
         returned = self.metrics.counter("device_state.returned")
-        toks = b.device_tokens.tolist()
-        names = b.names.tolist()
-        vals = b.values.tolist()
-        ets = b.event_ts.tolist()
-        rts_l = b.received_ts.tolist()
-        asg = b.assignment_tokens.tolist() if b.assignment_tokens is not None \
-            else None
-        scs = b.scores.tolist() if b.scores is not None else None
-        for i in range(b.n):
-            tok = toks[i]
+        names = b.names
+        ut, ti = b.token_index()
+        # max received_ts per unique device (C-level scatter-max)
+        rts_max = np.zeros((len(ut),), np.float64)
+        np.maximum.at(rts_max, ti, b.received_ts)
+        by_tok: list = [None] * len(ut)
+        for k, tok in enumerate(ut.tolist()):
             st = states.get(tok)
             if st is None:
                 st = states[tok] = DeviceState(tok)
-            if asg is not None and asg[i]:
-                st.assignment_token = asg[i]
-            rts = rts_l[i]
-            if rts > st.last_interaction_ts:
-                st.last_interaction_ts = int(rts)
+            by_tok[k] = st
+            rm = rts_max[k]
+            if rm > st.last_interaction_ts:
+                st.last_interaction_ts = int(rm)
             if not st.present:
                 st.present = True
                 st.presence_missing_ts = None
                 returned.inc()
-            sc = scs[i] if scs is not None else None
+        # last occurrence per (device, name): first hit in the reversed view
+        _, first_rev = np.unique(b.pair_codes()[::-1], return_index=True)
+        last_idx = b.n - 1 - first_rev
+        asg = b.assignment_tokens
+        scs = b.scores
+        vals = b.values
+        ets = b.event_ts
+        for i in last_idx.tolist():
+            st = by_tok[ti[i]]
+            if asg is not None and asg[i]:
+                st.assignment_token = asg[i]
+            sc = float(scs[i]) if scs is not None else None
             if sc is not None and sc != sc:  # NaN → unscored
                 sc = None
-            st.latest_measurements[names[i]] = (vals[i], sc, int(ets[i]))
+            st.latest_measurements[names[i]] = (
+                float(vals[i]), sc, int(ets[i])
+            )
 
     def get_state(self, device_token: str) -> Optional[DeviceState]:
         return self.states.get(device_token)

@@ -54,6 +54,10 @@ class _MeasurementColumns:
     def __init__(self) -> None:
         self._chunks: List[Dict[str, np.ndarray]] = []
         self._cur: Dict[str, list] = self._fresh()
+        # batch-append path: whole array chunks parked as-is (O(1) per
+        # batch, zero per-row work) until the next seal concatenates them
+        self._pending: List[Dict[str, np.ndarray]] = []
+        self._pending_rows = 0
         self._materialized: Optional[Dict[str, np.ndarray]] = None
         # concat of SEALED chunks only — invalidated on seal, not on every
         # append, so live-ingest reads pay O(tail) not O(n) per query
@@ -83,59 +87,74 @@ class _MeasurementColumns:
             self._seal()
 
     def append_batch(self, b) -> None:
-        """Columnar bulk append from a MeasurementBatch (C-level extends)."""
+        """Columnar bulk append from a MeasurementBatch: the batch's arrays
+        are parked as one pending chunk — O(1) per batch, no per-row work
+        on the ingest hot path."""
         n = b.n
         if n == 0:
             return
-        c = self._cur
-        empty = ("",) * n
 
-        def col(a, fallback=empty):
-            return a.tolist() if a is not None else list(fallback)
+        def col(a):
+            return a if a is not None else np.full((n,), "", object)
 
-        c["event_id"].extend(col(b.event_ids))
-        c["device_token"].extend(col(b.device_tokens))
-        c["assignment_token"].extend(col(b.assignment_tokens))
-        c["area_token"].extend(col(b.area_tokens))
-        c["name"].extend(col(b.names))
-        c["value"].extend(b.values.tolist())
-        c["score"].extend(
-            b.scores.tolist() if b.scores is not None else [np.nan] * n
+        self._pending.append(
+            {
+                "event_id": b.ensure_event_ids(),
+                "device_token": col(b.device_tokens),
+                "assignment_token": col(b.assignment_tokens),
+                "area_token": col(b.area_tokens),
+                "name": col(b.names),
+                "value": b.values,
+                "score": (
+                    b.scores
+                    if b.scores is not None
+                    else np.full((n,), np.nan, np.float32)
+                ),
+                "event_ts": b.event_ts.astype(np.int64),
+                "received_ts": b.received_ts.astype(np.int64),
+            }
         )
-        c["event_ts"].extend(b.event_ts.astype(np.int64).tolist())
-        c["received_ts"].extend(b.received_ts.astype(np.int64).tolist())
+        self._pending_rows += n
         self._materialized = None
-        if len(c["value"]) >= self.CHUNK:
+        if self._pending_rows + len(self._cur["value"]) >= self.CHUNK:
             self._seal()
 
     def _seal(self) -> None:
-        if not self._cur["value"]:
+        if not self._cur["value"] and not self._pending:
             return
         self._sealed_cache = None
+        parts: List[Dict[str, np.ndarray]] = list(self._pending)
+        if self._cur["value"]:
+            parts.append(self._cur_arrays())
         self._chunks.append(
-            {
-                "event_id": np.asarray(self._cur["event_id"], object),
-                "device_token": np.asarray(self._cur["device_token"], object),
-                "assignment_token": np.asarray(self._cur["assignment_token"], object),
-                "area_token": np.asarray(self._cur["area_token"], object),
-                "name": np.asarray(self._cur["name"], object),
-                "value": np.asarray(self._cur["value"], np.float32),
-                "score": np.asarray(self._cur["score"], np.float32),
-                "event_ts": np.asarray(self._cur["event_ts"], np.int64),
-                "received_ts": np.asarray(self._cur["received_ts"], np.int64),
-            }
+            parts[0]
+            if len(parts) == 1
+            else {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
         )
+        self._pending = []
+        self._pending_rows = 0
         self._cur = self._fresh()
 
     OBJ = ("event_id", "device_token", "assignment_token", "area_token", "name")
 
-    def _tail_arrays(self) -> Dict[str, np.ndarray]:
-        dtypes = {"value": np.float32, "score": np.float32,
-                  "event_ts": np.int64, "received_ts": np.int64}
+    DTYPES = {"value": np.float32, "score": np.float32,
+              "event_ts": np.int64, "received_ts": np.int64}
+
+    def _cur_arrays(self) -> Dict[str, np.ndarray]:
+        """Live per-row tail → typed arrays (the one _cur→array mapping)."""
         return {
-            k: np.asarray(v, object if k in self.OBJ else dtypes[k])
+            k: np.asarray(v, object if k in self.OBJ else self.DTYPES[k])
             for k, v in self._cur.items()
         }
+
+    def _tail_arrays(self) -> Dict[str, np.ndarray]:
+        cur = self._cur_arrays()
+        if not self._pending:
+            return cur
+        parts = list(self._pending) + ([cur] if len(cur["value"]) else [])
+        if len(parts) == 1:
+            return parts[0]
+        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
 
     def columns(self) -> Dict[str, np.ndarray]:
         """Materialize all rows as one struct-of-arrays dict. Two-level
@@ -163,7 +182,11 @@ class _MeasurementColumns:
         return out
 
     def __len__(self) -> int:
-        return sum(len(ch["value"]) for ch in self._chunks) + len(self._cur["value"])
+        return (
+            sum(len(ch["value"]) for ch in self._chunks)
+            + self._pending_rows
+            + len(self._cur["value"])
+        )
 
 
 class EventStore:

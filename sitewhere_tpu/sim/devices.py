@@ -19,7 +19,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from sitewhere_tpu.core.events import now_ms
-from sitewhere_tpu.pipeline.decoders import encode_measurement_binary
+from sitewhere_tpu.pipeline.decoders import (
+    encode_measurement_binary,
+    encode_measurements_bulk_binary,
+)
 from sitewhere_tpu.sim.broker import SimBroker
 
 
@@ -99,7 +102,7 @@ class DeviceSimulator:
     async def publish_once(self, token: str, t: float, force_anomaly: bool = False) -> None:
         p = self.profile
         k = max(1, p.samples_per_message)
-        if k == 1 or p.wire == "binary":
+        if k == 1:
             value, is_anomaly = self._value(token, t, force_anomaly)
             if is_anomaly:
                 self.anomalies_injected.append(
@@ -110,9 +113,20 @@ class DeviceSimulator:
             )
             self.sent += 1
             return
-        # burst form: k samples in one JSON message
-        events = []
+        # burst form: k samples in one wire message
+        await self.broker.publish(
+            self.topic_pattern.format(device=token),
+            self._burst_payload(token, t, force_anomaly),
+        )
+        self.sent += k
+
+    def _burst_payload(self, token: str, t: float, force_anomaly: bool = False) -> bytes:
+        """k buffered samples in one message: JSON ``{"device", "events"}``
+        or ONE bulk binary message (the high-rate wire format)."""
+        p = self.profile
+        k = max(1, p.samples_per_message)
         ts = now_ms()
+        values = []
         for j in range(k):
             value, is_anomaly = self._value(
                 token, t + j * p.interval_s, force_anomaly and j == 0
@@ -121,15 +135,21 @@ class DeviceSimulator:
                 self.anomalies_injected.append(
                     {"device": token, "value": value, "ts": ts}
                 )
-            events.append(
-                {"type": "measurement", "name": p.measurement,
-                 "value": value, "event_ts": ts + j}
+            values.append(value)
+        if p.wire == "binary":
+            return encode_measurements_bulk_binary(
+                token, p.measurement, values, base_ts=ts, stride_ms=1
             )
-        await self.broker.publish(
-            self.topic_pattern.format(device=token),
-            json.dumps({"device": token, "events": events}).encode(),
-        )
-        self.sent += k
+        return json.dumps(
+            {
+                "device": token,
+                "events": [
+                    {"type": "measurement", "name": p.measurement,
+                     "value": v, "event_ts": ts + j}
+                    for j, v in enumerate(values)
+                ],
+            }
+        ).encode()
 
     async def publish_round(self, t: float) -> None:
         """One sample from every device (deterministic batch mode for tests)."""
@@ -148,7 +168,7 @@ class DeviceSimulator:
                 p = self.profile
                 k = max(1, p.samples_per_message)
                 topic = self.topic_pattern.format(device=token)
-                if k == 1 or p.wire == "binary":
+                if k == 1:
                     value, is_anomaly = self._value(token, t)
                     if is_anomaly:
                         self.anomalies_injected.append(
@@ -156,23 +176,7 @@ class DeviceSimulator:
                         )
                     batch.append((topic, self._payload(token, value), 1))
                 else:
-                    ts = now_ms()
-                    events = []
-                    for j in range(k):
-                        value, is_anomaly = self._value(token, t + j * p.interval_s)
-                        if is_anomaly:
-                            self.anomalies_injected.append(
-                                {"device": token, "value": value, "ts": ts}
-                            )
-                        events.append(
-                            {"type": "measurement", "name": p.measurement,
-                             "value": value, "event_ts": ts + j}
-                        )
-                    batch.append((
-                        topic,
-                        json.dumps({"device": token, "events": events}).encode(),
-                        k,
-                    ))
+                    batch.append((topic, self._burst_payload(token, t), k))
             out.append(batch)
         return out
 

@@ -101,3 +101,68 @@ def test_deduplicator_window():
     assert not d.seen("c")  # evicts "a"
     assert not d.seen("a")
     assert not d.seen("")   # empty ids never dedup
+
+
+class TestBulkBinary:
+    def test_bulk_roundtrip_columns(self):
+        import numpy as np
+
+        from sitewhere_tpu.pipeline.decoders import (
+            encode_measurements_bulk_binary,
+        )
+
+        vals = [20.0, 20.5, 21.0, 35.5]
+        payload = encode_measurements_bulk_binary(
+            "dev-7", "temperature", vals, base_ts=1000, stride_ms=10
+        )
+        kind, chunks = BinaryDecoder().decode_any(payload)
+        assert kind == "columns_np"
+        (dev, name, v, ets), = chunks
+        assert dev == "dev-7" and name == "temperature"
+        np.testing.assert_allclose(v, vals, rtol=1e-6)
+        np.testing.assert_allclose(ets, [1000, 1010, 1020, 1030])
+
+    def test_bulk_concatenated_chunks(self):
+        from sitewhere_tpu.pipeline.decoders import (
+            encode_measurements_bulk_binary,
+        )
+
+        payload = encode_measurements_bulk_binary("a", "t", [1.0, 2.0]) + \
+            encode_measurements_bulk_binary("b", "t", [3.0])
+        kind, chunks = BinaryDecoder().decode_any(payload)
+        assert kind == "columns_np"
+        assert [c[0] for c in chunks] == ["a", "b"]
+        assert [len(c[2]) for c in chunks] == [2, 1]
+
+    def test_bulk_decode_expands_per_event(self):
+        from sitewhere_tpu.pipeline.decoders import (
+            encode_measurements_bulk_binary,
+        )
+
+        payload = encode_measurements_bulk_binary(
+            "d", "t", [1.0, 2.0, 3.0], base_ts=100, stride_ms=5
+        )
+        reqs = BinaryDecoder().decode(payload)
+        assert [r["value"] for r in reqs] == [1.0, 2.0, 3.0]
+        assert [r["event_ts"] for r in reqs] == [100, 105, 110]
+
+    def test_mixed_bulk_and_single_falls_back_to_requests(self):
+        from sitewhere_tpu.pipeline.decoders import (
+            encode_measurements_bulk_binary,
+        )
+
+        payload = encode_measurements_bulk_binary("a", "t", [1.0]) + \
+            encode_measurement_binary("b", "t", 2.0, event_ts=7)
+        kind, reqs = BinaryDecoder().decode_any(payload)
+        assert kind == "requests"
+        assert len(reqs) == 2
+        assert {r["device_token"] for r in reqs} == {"a", "b"}
+
+    def test_truncated_bulk_raises(self):
+        from sitewhere_tpu.pipeline.decoders import (
+            encode_measurements_bulk_binary,
+        )
+
+        payload = encode_measurements_bulk_binary("a", "t", [1.0, 2.0])
+        with pytest.raises(DecodeError):
+            BinaryDecoder().decode_any(payload[:-4])

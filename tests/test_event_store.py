@@ -93,3 +93,63 @@ def test_mixed_query_pagination_counts_all(store):
     assert len(evs) == 40
     evs_last, _ = store.list_events(EventQuery(page=3, page_size=40))
     assert len(evs_last) == 22
+
+
+def test_batch_append_pending_chunks_visible_and_seal():
+    from sitewhere_tpu.core.batch import MeasurementBatch
+
+    s = EventStore("t1")
+    b = MeasurementBatch.from_column_chunks(
+        "t1",
+        [("d1", "temp", np.asarray([1.0, 2.0], np.float32),
+          np.asarray([10.0, 11.0])),
+         ("d2", "temp", np.asarray([3.0], np.float32), np.asarray([12.0]))],
+    )
+    s.add_measurement_batch(b)
+    assert len(s.measurements) == 3
+    # pending (unsealed) rows are visible to queries immediately
+    rows, total = s.list_measurements(EventQuery(device_token="d1"))
+    assert total == 2 and rows[0].value == 1.0
+    # per-event and batch appends interleave across a seal
+    s.add_event(_m("d3", "temp", 9.0, 2000))
+    s.measurements._seal()
+    rows, total = s.list_measurements(EventQuery())
+    assert total == 4
+    # event ids were lazily materialized and are unique
+    ids = [r.id for r in rows]
+    assert len(set(ids)) == 4 and all(ids)
+
+
+def test_batch_append_ids_consistent_with_to_events():
+    from sitewhere_tpu.core.batch import MeasurementBatch
+
+    s = EventStore("t1")
+    b = MeasurementBatch.from_column_chunks(
+        "t1", [("d1", "t", np.asarray([5.0], np.float32), np.asarray([1.0]))],
+    )
+    s.add_measurement_batch(b)
+    # the id the store persisted equals the id a later edge
+    # materialization of the SAME batch object produces
+    (ev,) = b.to_events()
+    rows, _ = s.list_measurements(EventQuery(device_token="d1"))
+    assert rows[0].id == ev.id
+
+
+def test_pair_codes_and_group_index_cache():
+    from sitewhere_tpu.core.batch import MeasurementBatch
+
+    b = MeasurementBatch.from_column_chunks(
+        "t1",
+        [("d2", "x", np.asarray([1.0, 2.0], np.float32), np.asarray([1.0, 2.0])),
+         ("d1", "y", np.asarray([3.0], np.float32), np.asarray([3.0])),
+         ("d2", "x", np.asarray([4.0], np.float32), np.asarray([4.0]))],
+    )
+    u, inv = b.token_index()
+    assert [u[i] for i in inv] == ["d2", "d2", "d1", "d2"]
+    codes = b.pair_codes()
+    assert codes[0] == codes[1] == codes[3] != codes[2]
+    # cache equivalence with a fresh np.unique derivation
+    b2 = b.select(np.arange(b.n))  # drops the cache
+    assert b2.tok_index is None
+    u2, inv2 = b2.token_index()
+    assert [u2[i] for i in inv2] == ["d2", "d2", "d1", "d2"]
