@@ -1,0 +1,9 @@
+"""gRPC request/response plane (reference: sitewhere-grpc-* modules,
+SURVEY.md §2.1 [U]): protobuf model + converters + aio server/client.
+
+The REST surface (api/rest.py) and this plane expose the same platform;
+the reference's microservices talk to each other exclusively over gRPC
+(ApiChannel/ApiDemux), which this package's typed clients mirror.
+"""
+
+from sitewhere_tpu.grpcapi import sitewhere_pb2 as pb  # noqa: F401

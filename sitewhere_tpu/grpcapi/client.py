@@ -1,0 +1,79 @@
+"""Typed gRPC client (reference: ``ApiChannel`` per-service clients in
+sitewhere-microservice — SURVEY.md §2.1 [U]; reference mount empty, see
+provenance banner). Built on unary multicallables from the shared METHODS
+registry — the hand-written analog of protoc-generated stubs."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import grpc
+
+from sitewhere_tpu.grpcapi.service import METHODS, MethodSpec
+
+
+class SiteWhereGrpcClient:
+    """One channel, all three services; per-call tenant + JWT metadata.
+
+    Usage::
+
+        async with SiteWhereGrpcClient("127.0.0.1:50051", token=jwt) as c:
+            dev = await c.call("DeviceManagement", "GetDevice",
+                               pb.TokenRequest(token="d1"), tenant="acme")
+    """
+
+    def __init__(self, target: str, token: str = "", tenant: str = "") -> None:
+        self.target = target
+        self.token = token
+        self.tenant = tenant
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._calls: Dict[Tuple[str, str], grpc.aio.UnaryUnaryMultiCallable] = {}
+
+    async def __aenter__(self) -> "SiteWhereGrpcClient":
+        await self.connect()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def connect(self) -> None:
+        self._channel = grpc.aio.insecure_channel(self.target)
+        for spec in METHODS:
+            self._calls[(spec.service.rsplit(".", 1)[-1], spec.name)] = (
+                self._channel.unary_unary(
+                    f"/{spec.service}/{spec.name}",
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=spec.response_cls.FromString,
+                )
+            )
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self._calls.clear()
+
+    def _metadata(self, tenant: Optional[str]) -> tuple:
+        md = []
+        if self.token:
+            md.append(("authorization", f"Bearer {self.token}"))
+        t = tenant if tenant is not None else self.tenant
+        if t:
+            md.append(("tenant", t))
+        return tuple(md)
+
+    async def call(self, service: str, method: str, request,
+                   tenant: Optional[str] = None):
+        """Invoke ``service.method`` (short service name) with metadata."""
+        try:
+            fn = self._calls[(service, method)]
+        except KeyError:
+            raise KeyError(
+                f"unknown rpc {service}/{method}; known: "
+                f"{sorted(set(s for s, _ in self._calls))}"
+            ) from None
+        return await fn(request, metadata=self._metadata(tenant))
+
+
+def method_specs() -> Tuple[MethodSpec, ...]:
+    return METHODS

@@ -1,0 +1,198 @@
+"""Dataclass ↔ protobuf converters (reference: `*ModelConverter` classes in
+sitewhere-grpc-model — SURVEY.md §2.1 [U]; reference mount empty, see
+provenance banner). One pair of functions per wire entity; converters are
+total in both directions so a round-trip is lossless for the fields the
+wire carries."""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+from sitewhere_tpu.core.events import AlertLevel, DeviceAlert, DeviceMeasurement
+from sitewhere_tpu.core.model import (
+    Area,
+    AssignmentStatus,
+    Device,
+    DeviceAssignment,
+    DeviceStatus,
+    DeviceType,
+    Tenant,
+)
+from sitewhere_tpu.grpcapi import sitewhere_pb2 as pb
+
+
+# -- device model ---------------------------------------------------------
+
+def device_to_proto(d: Device) -> pb.Device:
+    return pb.Device(
+        token=d.token,
+        name=d.name,
+        description=d.description,
+        device_type_token=d.device_type_token,
+        status=d.status.value,
+        comments=d.comments,
+        parent_device_token=d.parent_device_token,
+        metadata=dict(d.metadata),
+        created_ts=d.created_ts,
+        updated_ts=d.updated_ts,
+    )
+
+
+def device_from_proto(p: pb.Device) -> Device:
+    kw = {}
+    if p.token:
+        kw["token"] = p.token
+    return Device(
+        name=p.name,
+        description=p.description,
+        device_type_token=p.device_type_token,
+        status=DeviceStatus(p.status) if p.status else DeviceStatus.ACTIVE,
+        comments=p.comments,
+        parent_device_token=p.parent_device_token,
+        metadata=dict(p.metadata),
+        **kw,
+    )
+
+
+def device_type_to_proto(dt: DeviceType) -> pb.DeviceType:
+    return pb.DeviceType(
+        token=dt.token,
+        name=dt.name,
+        description=dt.description,
+        container_policy=dt.container_policy,
+        image_url=dt.image_url,
+        metadata=dict(dt.metadata),
+    )
+
+
+def device_type_from_proto(p: pb.DeviceType) -> DeviceType:
+    kw = {"token": p.token} if p.token else {}
+    return DeviceType(
+        name=p.name,
+        description=p.description,
+        container_policy=p.container_policy or "standalone",
+        image_url=p.image_url,
+        metadata=dict(p.metadata),
+        **kw,
+    )
+
+
+def assignment_to_proto(a: DeviceAssignment) -> pb.DeviceAssignment:
+    return pb.DeviceAssignment(
+        token=a.token,
+        device_token=a.device_token,
+        customer_token=a.customer_token,
+        area_token=a.area_token,
+        asset_token=a.asset_token,
+        status=a.status.value,
+        active_date=a.active_date,
+        released_date=a.released_date or 0,
+        metadata=dict(a.metadata),
+    )
+
+
+def assignment_from_proto(p: pb.DeviceAssignment) -> DeviceAssignment:
+    kw = {"token": p.token} if p.token else {}
+    if p.active_date:
+        kw["active_date"] = p.active_date
+    if p.released_date:
+        kw["released_date"] = p.released_date
+    return DeviceAssignment(
+        device_token=p.device_token,
+        customer_token=p.customer_token,
+        area_token=p.area_token,
+        asset_token=p.asset_token,
+        status=AssignmentStatus(p.status) if p.status else AssignmentStatus.ACTIVE,
+        metadata=dict(p.metadata),
+        **kw,
+    )
+
+
+def area_to_proto(a: Area) -> pb.Area:
+    return pb.Area(
+        token=a.token,
+        name=a.name,
+        description=a.description,
+        area_type_token=a.area_type_token,
+        parent_token=a.parent_token,
+        bounds=[pb.LatLon(latitude=lat, longitude=lon) for lat, lon in a.bounds],
+    )
+
+
+def area_from_proto(p: pb.Area) -> Area:
+    kw = {"token": p.token} if p.token else {}
+    return Area(
+        name=p.name,
+        description=p.description,
+        area_type_token=p.area_type_token,
+        parent_token=p.parent_token,
+        bounds=[(b.latitude, b.longitude) for b in p.bounds],
+        **kw,
+    )
+
+
+def tenant_to_proto(t: Tenant) -> pb.Tenant:
+    return pb.Tenant(
+        token=t.token,
+        name=t.name,
+        template=t.template,
+        auth_token=t.auth_token,
+        logo_url=t.logo_url,
+        mesh_shard=t.mesh_shard,
+    )
+
+
+# -- events ---------------------------------------------------------------
+
+def measurement_to_proto(m: DeviceMeasurement) -> pb.DeviceMeasurement:
+    return pb.DeviceMeasurement(
+        id=m.id,
+        device_token=m.device_token,
+        assignment_token=m.assignment_token,
+        area_token=m.area_token,
+        name=m.name,
+        value=m.value,
+        score=m.score if m.score is not None else math.nan,
+        has_score=m.score is not None,
+        event_ts=m.event_ts,
+        received_ts=m.received_ts,
+    )
+
+
+def measurement_from_proto(p: pb.DeviceMeasurement) -> DeviceMeasurement:
+    return DeviceMeasurement(
+        id=p.id,
+        device_token=p.device_token,
+        assignment_token=p.assignment_token,
+        area_token=p.area_token,
+        name=p.name,
+        value=p.value,
+        score=p.score if p.has_score and not math.isnan(p.score) else None,
+        event_ts=p.event_ts,
+        received_ts=p.received_ts,
+    )
+
+
+def alert_to_proto(a: DeviceAlert) -> pb.DeviceAlert:
+    return pb.DeviceAlert(
+        id=a.id,
+        device_token=a.device_token,
+        assignment_token=a.assignment_token,
+        level=a.level.value,
+        alert_type=a.alert_type,
+        message=a.message,
+        event_ts=a.event_ts,
+    )
+
+
+def alert_from_proto(p: pb.DeviceAlert) -> DeviceAlert:
+    return DeviceAlert(
+        id=p.id,
+        device_token=p.device_token,
+        assignment_token=p.assignment_token,
+        level=AlertLevel(p.level) if p.level else AlertLevel.INFO,
+        alert_type=p.alert_type,
+        message=p.message,
+        event_ts=p.event_ts,
+    )

@@ -1,0 +1,46 @@
+"""gRPC server lifecycle component (reference: ``GrpcServer`` in
+sitewhere-microservice — SURVEY.md §2.1 [U]; reference mount empty, see
+provenance banner). Runs beside the REST surface over the same
+SiteWhereInstance."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+
+from sitewhere_tpu.grpcapi.service import build_rpc_handlers
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+
+class GrpcServer(LifecycleComponent):
+    """grpc.aio server exposing the device/event/tenant services."""
+
+    def __init__(self, instance, host: str = "127.0.0.1", port: int = 0) -> None:
+        super().__init__(f"grpc-server[{instance.config.instance_id}]")
+        self.instance = instance
+        self.host = host
+        self.port = port          # 0 = ephemeral; bound port in .bound_port
+        self.bound_port: Optional[int] = None
+        self._server: Optional[grpc.aio.Server] = None
+
+    async def on_start(self) -> None:
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers(tuple(build_rpc_handlers(self.instance)))
+        self.bound_port = server.add_insecure_port(f"{self.host}:{self.port}")
+        await server.start()
+        self._server = server
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=2.0)
+            self._server = None
+            self.bound_port = None
+
+
+async def serve_grpc(instance, host: str = "127.0.0.1", port: int = 50051) -> GrpcServer:
+    """Convenience: start a GrpcServer for a running instance."""
+    srv = GrpcServer(instance, host, port)
+    await srv.initialize()
+    await srv.start()
+    return srv

@@ -1,0 +1,348 @@
+"""gRPC servicers over a live SiteWhereInstance + the method registry.
+
+The reference generates servicer/stub plumbing with protoc's grpc plugin;
+this image has none, so the registry below (``METHODS``) is the single
+source of truth the server and client build their plumbing from — keep it
+in sync with the service blocks in protos/sitewhere.proto.
+
+Scoping/auth contract (mirrors the REST plane and the reference's JWT
+propagation over gRPC metadata [U]):
+
+- metadata ``tenant``: tenant token for tenant-scoped services,
+- metadata ``authorization``: ``Bearer <jwt>`` from UserManagement;
+  reads need a valid token, mutations additionally need the authority
+  listed in METHODS.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+import grpc
+
+from sitewhere_tpu.core.events import now_ms
+from sitewhere_tpu.grpcapi import converters as cv
+from sitewhere_tpu.grpcapi import sitewhere_pb2 as pb
+from sitewhere_tpu.services.event_store import EventQuery
+from sitewhere_tpu.services.user_management import (
+    AUTH_DEVICE_MANAGE,
+    AUTH_EVENT_VIEW,
+    AUTH_TENANT_ADMIN,
+    AuthError,
+)
+
+
+@dataclass(frozen=True)
+class MethodSpec:
+    service: str
+    name: str
+    request_cls: type
+    response_cls: type
+    authority: Optional[str] = None   # None = any valid token
+    tenant_scoped: bool = True
+
+
+class _Ctx:
+    """Per-call resolved context: claims + tenant runtime."""
+
+    __slots__ = ("claims", "runtime")
+
+    def __init__(self, claims, runtime) -> None:
+        self.claims = claims
+        self.runtime = runtime
+
+
+class DeviceManagementServicer:
+    SERVICE = "sitewhere.grpc.DeviceManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def CreateDevice(self, req: pb.Device, ctx: _Ctx) -> pb.Device:
+        d = ctx.runtime.device_management.create_device(cv.device_from_proto(req))
+        return cv.device_to_proto(d)
+
+    async def GetDevice(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Device:
+        d = ctx.runtime.device_management.get_device(req.token)
+        if d is None:
+            raise KeyError(req.token)
+        return cv.device_to_proto(d)
+
+    async def ListDevices(self, req: pb.DeviceListRequest, ctx: _Ctx) -> pb.DeviceList:
+        page = req.paging.page or 1
+        size = req.paging.page_size or 100
+        items, total = ctx.runtime.device_management.list_devices(
+            page=page, page_size=size, device_type=req.device_type_token
+        )
+        return pb.DeviceList(
+            devices=[cv.device_to_proto(d) for d in items], total=total
+        )
+
+    async def DeleteDevice(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Empty:
+        ctx.runtime.device_management.delete_device(req.token)
+        return pb.Empty()
+
+    async def CreateDeviceType(self, req: pb.DeviceType, ctx: _Ctx) -> pb.DeviceType:
+        dt = ctx.runtime.device_management.create_device_type(
+            cv.device_type_from_proto(req)
+        )
+        return cv.device_type_to_proto(dt)
+
+    async def ListDeviceTypes(self, req: pb.Paging, ctx: _Ctx) -> pb.DeviceTypeList:
+        dm = ctx.runtime.device_management
+        items, total = dm.device_types.page(req.page or 1, req.page_size or 100)
+        return pb.DeviceTypeList(
+            device_types=[cv.device_type_to_proto(t) for t in items], total=total
+        )
+
+    async def CreateAssignment(
+        self, req: pb.DeviceAssignment, ctx: _Ctx
+    ) -> pb.DeviceAssignment:
+        a = ctx.runtime.device_management.create_assignment(
+            cv.assignment_from_proto(req)
+        )
+        return cv.assignment_to_proto(a)
+
+    async def GetAssignment(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.DeviceAssignment:
+        a = ctx.runtime.device_management.get_assignment(req.token)
+        if a is None:
+            raise KeyError(req.token)
+        return cv.assignment_to_proto(a)
+
+    async def ListAssignments(
+        self, req: pb.AssignmentListRequest, ctx: _Ctx
+    ) -> pb.AssignmentList:
+        from sitewhere_tpu.core.model import AssignmentStatus
+
+        status = AssignmentStatus(req.status) if req.status else None
+        items, total = ctx.runtime.device_management.list_assignments(
+            page=req.paging.page or 1,
+            page_size=req.paging.page_size or 100,
+            device_token=req.device_token,
+            status=status,
+        )
+        return pb.AssignmentList(
+            assignments=[cv.assignment_to_proto(a) for a in items], total=total
+        )
+
+    async def ReleaseAssignment(
+        self, req: pb.TokenRequest, ctx: _Ctx
+    ) -> pb.DeviceAssignment:
+        a = ctx.runtime.device_management.release_assignment(req.token)
+        return cv.assignment_to_proto(a)
+
+    async def CreateArea(self, req: pb.Area, ctx: _Ctx) -> pb.Area:
+        a = ctx.runtime.device_management.create_area(cv.area_from_proto(req))
+        return cv.area_to_proto(a)
+
+    async def ListAreas(self, req: pb.Paging, ctx: _Ctx) -> pb.AreaList:
+        dm = ctx.runtime.device_management
+        items, total = dm.areas.page(req.page or 1, req.page_size or 100)
+        return pb.AreaList(areas=[cv.area_to_proto(a) for a in items], total=total)
+
+
+class EventManagementServicer:
+    SERVICE = "sitewhere.grpc.EventManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def ListMeasurements(
+        self, req: pb.MeasurementQuery, ctx: _Ctx
+    ) -> pb.MeasurementList:
+        q = EventQuery(
+            assignment_token=req.assignment_token,
+            device_token=req.device_token,
+            area_token=req.area_token,
+            name=req.name,
+            start_ts=req.start_ts,
+            end_ts=req.end_ts,
+            page=req.paging.page or 1,
+            page_size=req.paging.page_size or 100,
+        )
+        items, total = ctx.runtime.event_store.list_measurements(q)
+        return pb.MeasurementList(
+            measurements=[cv.measurement_to_proto(m) for m in items], total=total
+        )
+
+    async def AddMeasurements(
+        self, req: pb.AddMeasurementsRequest, ctx: _Ctx
+    ) -> pb.AddMeasurementsResponse:
+        """Ingest through the pipeline: requests enter at the
+        decoded-events topic — the same insertion point as an event source
+        (SURVEY.md §3.1), so they get inbound validation, TPU scoring,
+        persistence, and rules like any device-originated event."""
+        bus = self.instance.bus
+        tenant = ctx.runtime.tenant
+        topic = bus.naming.decoded_events(tenant)
+        now = now_ms()
+        accepted = 0
+        for m in req.measurements:
+            await bus.publish(topic, {
+                "type": "measurement",
+                "device_token": m.device_token,
+                "name": m.name,
+                "value": m.value,
+                "event_ts": m.event_ts or now,
+                "received_ts": now,
+            })
+            accepted += 1
+        return pb.AddMeasurementsResponse(accepted=accepted)
+
+
+class TenantManagementServicer:
+    SERVICE = "sitewhere.grpc.TenantManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def CreateTenant(self, req: pb.TenantCreateRequest, ctx: _Ctx) -> pb.Tenant:
+        t = await self.instance.tenant_management.create_tenant(
+            req.token, name=req.name, template=req.template or "default"
+        )
+        await self.instance.drain_tenant_updates()
+        return cv.tenant_to_proto(t)
+
+    async def GetTenant(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Tenant:
+        t = self.instance.tenant_management.get_tenant(req.token)
+        if t is None:
+            raise KeyError(req.token)
+        return cv.tenant_to_proto(t)
+
+    async def ListTenants(self, req: pb.Empty, ctx: _Ctx) -> pb.TenantList:
+        return pb.TenantList(
+            tenants=[
+                cv.tenant_to_proto(t)
+                for t in self.instance.tenant_management.list_tenants()
+            ]
+        )
+
+    async def UpdateTenant(self, req: pb.TenantUpdateRequest, ctx: _Ctx) -> pb.Tenant:
+        kw = {}
+        if req.name:
+            kw["name"] = req.name
+        if req.template:
+            kw["template"] = req.template
+        t = await self.instance.tenant_management.update_tenant(req.token, **kw)
+        await self.instance.drain_tenant_updates()
+        return cv.tenant_to_proto(t)
+
+    async def DeleteTenant(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Empty:
+        await self.instance.tenant_management.delete_tenant(req.token)
+        await self.instance.drain_tenant_updates()
+        return pb.Empty()
+
+
+# ---------------------------------------------------------------- registry
+# (service class, method name, request, response, authority-for-mutations,
+# tenant-scoped). Keep in sync with protos/sitewhere.proto.
+
+METHODS: Tuple[MethodSpec, ...] = (
+    # DeviceManagement
+    MethodSpec("sitewhere.grpc.DeviceManagement", "CreateDevice",
+               pb.Device, pb.Device, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "GetDevice",
+               pb.TokenRequest, pb.Device),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "ListDevices",
+               pb.DeviceListRequest, pb.DeviceList),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "DeleteDevice",
+               pb.TokenRequest, pb.Empty, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "CreateDeviceType",
+               pb.DeviceType, pb.DeviceType, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "ListDeviceTypes",
+               pb.Paging, pb.DeviceTypeList),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "CreateAssignment",
+               pb.DeviceAssignment, pb.DeviceAssignment, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "GetAssignment",
+               pb.TokenRequest, pb.DeviceAssignment),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "ListAssignments",
+               pb.AssignmentListRequest, pb.AssignmentList),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "ReleaseAssignment",
+               pb.TokenRequest, pb.DeviceAssignment, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "CreateArea",
+               pb.Area, pb.Area, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.DeviceManagement", "ListAreas",
+               pb.Paging, pb.AreaList),
+    # EventManagement
+    MethodSpec("sitewhere.grpc.EventManagement", "ListMeasurements",
+               pb.MeasurementQuery, pb.MeasurementList, AUTH_EVENT_VIEW),
+    MethodSpec("sitewhere.grpc.EventManagement", "AddMeasurements",
+               pb.AddMeasurementsRequest, pb.AddMeasurementsResponse,
+               AUTH_DEVICE_MANAGE),
+    # TenantManagement (instance-scoped)
+    MethodSpec("sitewhere.grpc.TenantManagement", "CreateTenant",
+               pb.TenantCreateRequest, pb.Tenant, AUTH_TENANT_ADMIN, False),
+    MethodSpec("sitewhere.grpc.TenantManagement", "GetTenant",
+               pb.TokenRequest, pb.Tenant, None, False),
+    MethodSpec("sitewhere.grpc.TenantManagement", "ListTenants",
+               pb.Empty, pb.TenantList, None, False),
+    MethodSpec("sitewhere.grpc.TenantManagement", "UpdateTenant",
+               pb.TenantUpdateRequest, pb.Tenant, AUTH_TENANT_ADMIN, False),
+    MethodSpec("sitewhere.grpc.TenantManagement", "DeleteTenant",
+               pb.TokenRequest, pb.Empty, AUTH_TENANT_ADMIN, False),
+)
+
+SERVICERS = {
+    "sitewhere.grpc.DeviceManagement": DeviceManagementServicer,
+    "sitewhere.grpc.EventManagement": EventManagementServicer,
+    "sitewhere.grpc.TenantManagement": TenantManagementServicer,
+}
+
+
+def build_rpc_handlers(instance) -> list:
+    """Generic handlers for grpc.aio.Server — the hand-written analog of
+    protoc-generated ``add_*Servicer_to_server`` glue, plus the auth +
+    tenant-resolution wrapper every method shares."""
+    servicers = {name: cls(instance) for name, cls in SERVICERS.items()}
+    by_service: Dict[str, Dict[str, grpc.RpcMethodHandler]] = {}
+
+    def make_handler(spec: MethodSpec, bound: Callable):
+        async def handler(request, context):
+            md = dict(context.invocation_metadata() or ())
+            auth = md.get("authorization", "")
+            if not auth.startswith("Bearer "):
+                await context.abort(
+                    grpc.StatusCode.UNAUTHENTICATED, "missing bearer token"
+                )
+            try:
+                claims = instance.users.validate_token(auth[7:])
+                if spec.authority is not None:
+                    instance.users.require_authority(claims, spec.authority)
+            except AuthError as exc:
+                code = (
+                    grpc.StatusCode.PERMISSION_DENIED
+                    if "authority" in str(exc)
+                    else grpc.StatusCode.UNAUTHENTICATED
+                )
+                await context.abort(code, str(exc))
+            runtime = None
+            if spec.tenant_scoped:
+                tenant = md.get("tenant", "")
+                runtime = instance.tenants.get(tenant)
+                if runtime is None:
+                    await context.abort(
+                        grpc.StatusCode.NOT_FOUND, f"unknown tenant '{tenant}'"
+                    )
+            try:
+                return await bound(request, _Ctx(claims, runtime))
+            except KeyError as exc:
+                await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+            except ValueError as exc:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=spec.request_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    for spec in METHODS:
+        bound = getattr(servicers[spec.service], spec.name)
+        by_service.setdefault(spec.service, {})[spec.name] = make_handler(
+            spec, bound
+        )
+    return [
+        grpc.method_handlers_generic_handler(service, methods)
+        for service, methods in by_service.items()
+    ]

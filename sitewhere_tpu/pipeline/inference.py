@@ -198,8 +198,9 @@ class TpuInferenceEngine(TenantEngine):
             if scorer is not None and svc.checkpoints is not None:
                 # save this tenant's (possibly trained) weights BEFORE the
                 # slot wipe below destroys them. Materialize to numpy ON
-                # THIS (loop) thread — jax materialization on the executor
-                # thread races the runtime (heap corruption)
+                # THIS (loop) thread: the reset_slot below DONATES the
+                # stacked params buffer, and a worker-thread zero-copy view
+                # into it would be a use-after-free (see host_copy_params)
                 from sitewhere_tpu.runtime.checkpoint import host_copy_params
 
                 params = host_copy_params(scorer.slot_params(slot))
@@ -488,7 +489,12 @@ class TpuInferenceService(MultitenantService):
         return moved
 
     async def _deliver(self, scores_dev, taken) -> None:
-        """Materialize one flush's scores off the loop and resolve rows."""
+        """Materialize one flush's scores off the loop and resolve rows.
+
+        Worker-thread materialization is safe HERE because ``scores_dev``
+        is a jit output nothing ever donates — unlike param trees, whose
+        buffers later loop-thread calls donate (see
+        ``checkpoint.host_copy_params`` for the full invariant)."""
         try:
             scores_np = await asyncio.get_running_loop().run_in_executor(
                 self._deliver_pool, np.asarray, scores_dev

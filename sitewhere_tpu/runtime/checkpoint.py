@@ -34,10 +34,18 @@ import numpy as np
 def host_copy_params(params: Any) -> Any:
     """Materialize a (possibly jax) param pytree into COPIED numpy arrays
     on the calling thread. Call this ON THE EVENT-LOOP THREAD before
-    handing params to an executor: ``np.asarray`` of jax CPU arrays from a
-    worker thread races the jax runtime and corrupts the heap (observed as
-    intermittent segfaults surfacing later inside unrelated pyarrow
-    calls)."""
+    handing params to an executor.
+
+    The precise hazard: on the CPU backend ``np.asarray`` of a jax array
+    is a ZERO-COPY view, and param buffers get DONATED by subsequent
+    loop-thread work (``set_slot``/``reset_slot``/``train_resident`` all
+    donate) — a worker thread reading the view after donation is a
+    use-after-free (observed as intermittent segfaults surfacing later
+    inside unrelated pyarrow calls). Jit OUTPUTS that nothing ever
+    donates (e.g. the scoring step's scores array) are safe to
+    materialize on worker threads — that's the deliver pipeline's whole
+    design — the copy is only mandatory for donation-exposed trees like
+    params/opt-state."""
     import jax
 
     # numpy leaves pass through (already host-side, typically pre-copied by
@@ -119,28 +127,66 @@ class CheckpointManager:
         return True
 
     # -- device model + events -------------------------------------------
-    def snapshot_tenant_stores(self, dm, store) -> dict:
-        """Capture + SERIALIZE a consistent cut of one tenant's device
-        model + events (synchronous, no awaits — safe on a live instance).
-
-        All native serialization (the arrow table build + parquet encode)
-        happens HERE on the calling (event-loop) thread: constructing a
+    @staticmethod
+    def _encode_parquet(cols: Dict[str, "np.ndarray"]) -> bytes:
+        """Columns → parquet bytes ON THE CALLING THREAD. Native
+        serialization must run on the event-loop thread: constructing a
         ParquetWriter on an executor thread while the jax runtime is live
-        segfaults intermittently in this image, so the snapshot hands the
-        executor nothing but ready-to-write bytes."""
+        segfaults intermittently in this image."""
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        cols = store.measurements.columns()
         table = pa.table({
             k: pa.array([str(x) for x in v] if v.dtype == object else v)
             for k, v in cols.items()
         })
         sink = pa.BufferOutputStream()
         pq.write_table(table, sink)
+        return sink.getvalue().to_pybytes()
+
+    def _seg_meta_path(self, tenant: str) -> Path:
+        return self.root / "events" / f"segments-{tenant}.json"
+
+    def snapshot_tenant_stores(self, dm, store) -> dict:
+        """Capture + serialize a consistent cut of one tenant's device
+        model + events (synchronous, no awaits — safe on a live instance).
+
+        Events persist as LOG-STRUCTURED PARQUET SEGMENTS: each sealed
+        64k-row chunk encodes exactly once, ever (the chunks are
+        immutable), so the steady-state loop-thread cost per checkpoint is
+        bounded by the live tail — not by total stored rows. A segment
+        manifest (row counts) detects a data_dir that belongs to a
+        different store lineage and forces a full rewrite."""
+        chunks = store.measurements.sealed_chunks()
+        counts = [int(len(c["value"])) for c in chunks]
+        meta = self._load_seg_meta(store.tenant) or {}
+        on_disk = meta.get("counts", [])
+        gen = int(meta.get("gen", 0)) + 1
+        reuse = (
+            meta.get("lineage") == store.lineage
+            and len(on_disk) <= len(counts)
+            and counts[: len(on_disk)] == on_disk
+        )
+        segments = []
+        for i, ch in enumerate(chunks):
+            if reuse and i < len(on_disk):
+                continue  # already on disk, immutable
+            segments.append((i, self._encode_parquet(ch)))
+        tail = self._encode_parquet(store.measurements._tail_arrays())
+        tail_name = f"measurements-{store.tenant}-tail{gen:08d}.parquet"
         return {
             "devices": json.dumps(dm.snapshot(), default=str),
-            "parquet": sink.getvalue().to_pybytes(),
+            "segments": segments,
+            # meta is the COMMIT POINT: it names the consistent file set
+            # (segment count + the generationed tail), so a crash anywhere
+            # mid-write leaves the previous meta pointing at the previous
+            # complete set — no duplicated and no missing rows on load
+            "seg_meta": json.dumps(
+                {"counts": counts, "tail": tail_name, "gen": gen,
+                 "lineage": store.lineage}
+            ),
+            "tail_name": tail_name,
+            "tail": tail,
             "other": "\n".join(
                 json.dumps(e.to_dict())
                 for lst in store._other.values()
@@ -148,16 +194,59 @@ class CheckpointManager:
             ),
         }
 
+    def _load_seg_meta(self, tenant: str) -> Optional[dict]:
+        p = self._seg_meta_path(tenant)
+        if not p.exists():
+            return None
+        try:
+            return json.loads(p.read_text())
+        except ValueError:
+            return None
+
+    def _seg_path(self, tenant: str, i: int) -> Path:
+        return self.root / "events" / f"measurements-{tenant}-seg{i:06d}.parquet"
+
     def write_tenant_stores(self, tenant: str, snap: dict) -> None:
-        """Pure file IO — safe on an executor thread (bytes in, disk out)."""
+        """Pure file IO — safe on an executor thread (bytes in, disk out).
+
+        Write order is the commit protocol: segment files, then the
+        generationed tail, then the meta manifest (atomic replace = the
+        commit), then stale-file cleanup. A crash at any point leaves the
+        previously committed set fully readable."""
         (self.root / "devices" / f"{tenant}.json").write_text(snap["devices"])
-        path = self.root / "events" / f"measurements-{tenant}.parquet"
-        tmp = path.with_suffix(".tmp")
-        tmp.write_bytes(snap["parquet"])
-        tmp.replace(path)  # atomic: no torn parquet on crash mid-write
+        for i, data in snap["segments"]:
+            path = self._seg_path(tenant, i)
+            tmp = path.with_suffix(".tmp")
+            tmp.write_bytes(data)
+            tmp.replace(path)
+        tail_path = self.root / "events" / snap["tail_name"]
+        tmp = tail_path.with_suffix(".tmp")
+        tmp.write_bytes(snap["tail"])
+        tmp.replace(tail_path)
         (self.root / "events" / f"events-{tenant}.jsonl").write_text(
             snap["other"]
         )
+        mp = self._seg_meta_path(tenant)
+        tmp = mp.with_suffix(".tmp")
+        tmp.write_text(snap["seg_meta"])
+        tmp.replace(mp)  # ── commit ──
+        # post-commit cleanup: old tails + (on lineage rewrite) orphan segs
+        meta = json.loads(snap["seg_meta"])
+        keep_segs = len(meta["counts"])
+        for old in (self.root / "events").glob(
+            f"measurements-{tenant}-tail*.parquet"
+        ):
+            if old.name != snap["tail_name"]:
+                old.unlink(missing_ok=True)
+        for old in (self.root / "events").glob(
+            f"measurements-{tenant}-seg*.parquet"
+        ):
+            try:
+                idx = int(old.stem.rsplit("seg", 1)[-1])
+            except ValueError:
+                continue
+            if idx >= keep_segs:
+                old.unlink(missing_ok=True)
 
     def save_tenant_stores(self, tenant: str, dm, store) -> None:
         self.write_tenant_stores(tenant, self.snapshot_tenant_stores(dm, store))
@@ -171,12 +260,55 @@ class CheckpointManager:
         return DeviceManagement.load(path)
 
     def load_event_store(self, tenant: str):
+        """Rebuild a store from its parquet segments + tail: columns load
+        straight into sealed chunks (no per-row object rebuild). Falls
+        back to the legacy single-file layout."""
+        from sitewhere_tpu.core.events import event_from_dict
         from sitewhere_tpu.services.event_store import EventStore
 
-        path = self.root / "events" / f"measurements-{tenant}.parquet"
-        if not path.exists():
+        meta = self._load_seg_meta(tenant)
+        if meta is None:
+            legacy = self.root / "events" / f"measurements-{tenant}.parquet"
+            if legacy.exists():
+                return EventStore.load_parquet(legacy, tenant)
             return None
-        return EventStore.load_parquet(path, tenant)
+        # the committed set is exactly what meta names — stray files from a
+        # torn write are ignored
+        seg_files = [
+            self._seg_path(tenant, i) for i in range(len(meta["counts"]))
+        ]
+        tail_path = self.root / "events" / meta["tail"]
+
+        import pyarrow.parquet as pq
+
+        dtypes = {"value": np.float32, "score": np.float32,
+                  "event_ts": np.int64, "received_ts": np.int64}
+
+        def read_chunk(path: Path) -> dict:
+            t = pq.read_table(path)
+            return {
+                name: (
+                    t[name].to_numpy(zero_copy_only=False).astype(dtypes[name])
+                    if name in dtypes
+                    else t[name].to_numpy(zero_copy_only=False).astype(object)
+                )
+                for name in t.column_names
+            }
+
+        store = EventStore(tenant)
+        # restored store CONTINUES the on-disk lineage: future checkpoints
+        # may extend these segments incrementally
+        store.lineage = meta.get("lineage", store.lineage)
+        for p in list(seg_files) + ([tail_path] if tail_path.exists() else []):
+            ch = read_chunk(p)
+            if len(ch["value"]):
+                store.measurements.add_sealed_chunk(ch)
+        jsonl = self.root / "events" / f"events-{tenant}.jsonl"
+        if jsonl.exists():
+            for line in jsonl.read_text().splitlines():
+                if line.strip():
+                    store.add_event(event_from_dict(json.loads(line)))
+        return store
 
     # -- manifest ---------------------------------------------------------
     def save_manifest(self, tenants: List[dict]) -> None:

@@ -181,6 +181,18 @@ class _MeasurementColumns:
         self._materialized = out
         return out
 
+    def add_sealed_chunk(self, chunk: Dict[str, np.ndarray]) -> None:
+        """Adopt a pre-built column chunk (restore path): zero per-row
+        work. Caller guarantees the chunk's columns are parallel arrays
+        in this store's schema."""
+        self._sealed_cache = None
+        self._materialized = None
+        self._chunks.append(chunk)
+
+    def sealed_chunks(self) -> List[Dict[str, np.ndarray]]:
+        """The immutable sealed chunks (checkpoint segment contract)."""
+        return self._chunks
+
     def __len__(self) -> int:
         return (
             sum(len(ch["value"]) for ch in self._chunks)
@@ -193,7 +205,14 @@ class EventStore:
     """Per-tenant event persistence (the IDeviceEventManagement surface)."""
 
     def __init__(self, tenant: str = "default") -> None:
+        import uuid
+
         self.tenant = tenant
+        # lineage id: identifies THIS store's data history across
+        # checkpoint/restore cycles — a checkpoint dir written by a
+        # different lineage must never be incrementally extended (row
+        # counts alone can't distinguish lineages)
+        self.lineage = uuid.uuid4().hex
         self.measurements = _MeasurementColumns()
         # non-measurement events are object-shaped (low volume)
         self._other: Dict[EventType, List[DeviceEvent]] = {

@@ -200,3 +200,100 @@ def test_tenant_config_dict_round_trip():
         max_streams=123, decoder="binary", shared_input=True,
     )
     assert tenant_config_from_dict(tenant_config_to_dict(cfg)) == cfg
+
+
+def test_segmented_event_checkpoint_incremental_and_torn_write(tmp_path):
+    """Sealed chunks encode once (incremental segments); a torn write
+    (crash before the meta commit) must load the PREVIOUS consistent set —
+    no duplicated, no missing rows."""
+    import json as _json
+
+    from sitewhere_tpu.core.batch import MeasurementBatch
+    from sitewhere_tpu.services.device_management import DeviceManagement
+    from sitewhere_tpu.services.event_store import EventQuery, EventStore
+
+    ck = CheckpointManager(tmp_path)
+    dm = DeviceManagement("seg")
+    store = EventStore("seg")
+
+    def add_rows(n, base):
+        store.add_measurement_batch(MeasurementBatch.from_column_chunks(
+            "seg",
+            [("d1", "t", np.arange(base, base + n).astype(np.float32),
+              np.arange(base, base + n).astype(np.float64) + 1)],
+        ))
+
+    add_rows(100, 0)
+    store.measurements._seal()      # chunk 0
+    add_rows(50, 100)               # tail
+    snap1 = ck.snapshot_tenant_stores(dm, store)
+    assert len(snap1["segments"]) == 1  # chunk 0 encoded
+    ck.write_tenant_stores("seg", snap1)
+
+    add_rows(30, 150)
+    snap2 = ck.snapshot_tenant_stores(dm, store)
+    assert snap2["segments"] == []  # chunk 0 NOT re-encoded
+    ck.write_tenant_stores("seg", snap2)
+
+    got = ck.load_event_store("seg")
+    assert len(got.measurements) == 180
+    _, total = got.list_measurements(EventQuery(page_size=1))
+    assert total == 180
+
+    # torn write: new snapshot whose files land but whose meta does NOT
+    add_rows(999, 180)
+    store.measurements._seal()      # chunk 1 (tail rows sealed into it)
+    snap3 = ck.snapshot_tenant_stores(dm, store)
+    assert len(snap3["segments"]) == 1
+    # simulate crash: write the segment + tail files but skip the meta
+    i, data = snap3["segments"][0]
+    ck._seg_path("seg", i).write_bytes(data)
+    (tmp_path / "events" / snap3["tail_name"]).write_bytes(snap3["tail"])
+    got = ck.load_event_store("seg")
+    # previous committed set: exactly 180 rows, no dup/missing
+    assert len(got.measurements) == 180
+    ids = got.measurements.columns()["event_id"]
+    assert len(set(ids)) == 180
+
+    # completing the commit makes the new set visible
+    ck.write_tenant_stores("seg", snap3)
+    got = ck.load_event_store("seg")
+    assert len(got.measurements) == 180 + 999
+
+
+def test_segment_lineage_mismatch_forces_full_rewrite(tmp_path):
+    """A DIFFERENT store (new lineage) over the same data_dir must not
+    reuse the previous lineage's segments even when row counts line up."""
+    from sitewhere_tpu.core.batch import MeasurementBatch
+    from sitewhere_tpu.services.device_management import DeviceManagement
+    from sitewhere_tpu.services.event_store import EventStore
+
+    ck = CheckpointManager(tmp_path)
+    dm = DeviceManagement("seg")
+
+    def store_with(vals):
+        s = EventStore("seg")
+        s.add_measurement_batch(MeasurementBatch.from_column_chunks(
+            "seg",
+            [("d1", "t", np.asarray(vals, np.float32),
+              np.ones(len(vals), np.float64))],
+        ))
+        s.measurements._seal()
+        return s
+
+    s1 = store_with([1.0, 2.0, 3.0])
+    ck.write_tenant_stores("seg", ck.snapshot_tenant_stores(dm, s1))
+    # new lineage, identical chunk counts, different data
+    s2 = store_with([7.0, 8.0, 9.0])
+    snap = ck.snapshot_tenant_stores(dm, s2)
+    assert len(snap["segments"]) == 1  # re-encoded despite matching counts
+    ck.write_tenant_stores("seg", snap)
+    got = ck.load_event_store("seg")
+    assert sorted(got.measurements.columns()["value"].tolist()) == [7.0, 8.0, 9.0]
+    # and the restored store continues the lineage (incremental reuse works)
+    got.add_measurement_batch(MeasurementBatch.from_column_chunks(
+        "seg", [("d1", "t", np.asarray([10.0], np.float32),
+                 np.asarray([2.0]))],
+    ))
+    snap2 = ck.snapshot_tenant_stores(dm, got)
+    assert snap2["segments"] == []  # sealed segment reused across restore

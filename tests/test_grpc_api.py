@@ -1,0 +1,204 @@
+"""gRPC plane: auth, device/event/tenant services over a real localhost
+socket — mirrors tests/test_rest_api.py for the second API surface."""
+
+import asyncio
+from contextlib import asynccontextmanager
+
+import grpc
+import pytest
+
+from sitewhere_tpu.grpcapi import sitewhere_pb2 as pb
+from sitewhere_tpu.grpcapi.client import SiteWhereGrpcClient
+from sitewhere_tpu.grpcapi.server import GrpcServer
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+from sitewhere_tpu.services.user_management import (
+    AUTH_EVENT_VIEW,
+)
+
+
+@asynccontextmanager
+async def grpc_ctx():
+    inst = SiteWhereInstance(
+        InstanceConfig(
+            instance_id="gapi",
+            mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+        )
+    )
+    await inst.start()
+    try:
+        await inst.bootstrap(default_tenant="default", dataset_devices=5)
+        for _ in range(100):
+            if "default" in inst.tenants:
+                break
+            await asyncio.sleep(0.02)
+        srv = GrpcServer(inst, port=0)
+        await srv.initialize()
+        await srv.start()
+        token = inst.users.issue_token("admin", "password")
+        client = SiteWhereGrpcClient(
+            f"127.0.0.1:{srv.bound_port}", token=token, tenant="default"
+        )
+        await client.connect()
+        try:
+            yield client, inst
+        finally:
+            await client.close()
+            await srv.terminate()
+    finally:
+        await inst.terminate()
+
+
+async def test_auth_required_and_authority_enforced():
+    async with grpc_ctx() as (client, inst):
+        # no token → UNAUTHENTICATED
+        anon = SiteWhereGrpcClient(client.target, token="", tenant="default")
+        await anon.connect()
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await anon.call("DeviceManagement", "ListDevices",
+                            pb.DeviceListRequest())
+        assert exc.value.code() is grpc.StatusCode.UNAUTHENTICATED
+        await anon.close()
+        # viewer (no device-manage authority) → PERMISSION_DENIED on mutate
+        inst.users.create_user("viewer", "pw", [AUTH_EVENT_VIEW])
+        vtok = inst.users.issue_token("viewer", "pw")
+        viewer = SiteWhereGrpcClient(client.target, token=vtok, tenant="default")
+        await viewer.connect()
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await viewer.call("DeviceManagement", "CreateDevice",
+                              pb.Device(name="x"))
+        assert exc.value.code() is grpc.StatusCode.PERMISSION_DENIED
+        # ...but reads work
+        got = await viewer.call("DeviceManagement", "ListDevices",
+                                pb.DeviceListRequest())
+        assert got.total >= 5
+        await viewer.close()
+
+
+async def test_unknown_tenant_not_found():
+    async with grpc_ctx() as (client, _inst):
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await client.call("DeviceManagement", "ListDevices",
+                              pb.DeviceListRequest(), tenant="nope")
+        assert exc.value.code() is grpc.StatusCode.NOT_FOUND
+
+
+async def test_device_crud_round_trip():
+    async with grpc_ctx() as (client, inst):
+        dt = await client.call(
+            "DeviceManagement", "CreateDeviceType",
+            pb.DeviceType(name="sensor-x", container_policy="standalone"),
+        )
+        assert dt.token
+        dev = await client.call(
+            "DeviceManagement", "CreateDevice",
+            pb.Device(name="dev-x", device_type_token=dt.token,
+                      metadata={"site": "roof"}),
+        )
+        assert dev.token and dev.status == "active"
+        got = await client.call("DeviceManagement", "GetDevice",
+                                pb.TokenRequest(token=dev.token))
+        assert got.name == "dev-x" and got.metadata["site"] == "roof"
+        lst = await client.call(
+            "DeviceManagement", "ListDevices",
+            pb.DeviceListRequest(device_type_token=dt.token),
+        )
+        assert lst.total == 1 and lst.devices[0].token == dev.token
+        # assignment lifecycle
+        asg = await client.call(
+            "DeviceManagement", "CreateAssignment",
+            pb.DeviceAssignment(device_token=dev.token),
+        )
+        assert asg.status == "active"
+        rel = await client.call("DeviceManagement", "ReleaseAssignment",
+                                pb.TokenRequest(token=asg.token))
+        assert rel.status == "released"
+        await client.call("DeviceManagement", "DeleteDevice",
+                          pb.TokenRequest(token=dev.token))
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await client.call("DeviceManagement", "GetDevice",
+                              pb.TokenRequest(token=dev.token))
+        assert exc.value.code() is grpc.StatusCode.NOT_FOUND
+
+
+async def test_event_ingest_flows_through_pipeline_and_query():
+    async with grpc_ctx() as (client, inst):
+        # bootstrap fleet device dev-00000 exists with an active assignment
+        req = pb.AddMeasurementsRequest(measurements=[
+            pb.DeviceMeasurement(device_token="dev-00000", name="temperature",
+                                 value=21.5 + i)
+            for i in range(8)
+        ])
+        resp = await client.call("EventManagement", "AddMeasurements", req)
+        assert resp.accepted == 8
+        # the pipeline scores + persists them; query via gRPC until visible
+        for _ in range(200):
+            lst = await client.call(
+                "EventManagement", "ListMeasurements",
+                pb.MeasurementQuery(device_token="dev-00000"),
+            )
+            if lst.total >= 8:
+                break
+            await asyncio.sleep(0.05)
+        assert lst.total >= 8
+        m = lst.measurements[0]
+        assert m.assignment_token  # inbound enrichment attached identity
+        assert m.name == "temperature"
+
+
+async def test_tenant_management_round_trip():
+    async with grpc_ctx() as (client, inst):
+        t = await client.call(
+            "TenantManagement", "CreateTenant",
+            pb.TenantCreateRequest(token="acme", name="Acme",
+                                   template="iot-temperature"),
+        )
+        assert t.token == "acme" and t.template == "iot-temperature"
+        assert "acme" in inst.tenants  # engine actually built
+        lst = await client.call("TenantManagement", "ListTenants", pb.Empty())
+        assert {x.token for x in lst.tenants} >= {"default", "acme"}
+        up = await client.call(
+            "TenantManagement", "UpdateTenant",
+            pb.TenantUpdateRequest(token="acme", name="Acme Corp"),
+        )
+        assert up.name == "Acme Corp"
+        await client.call("TenantManagement", "DeleteTenant",
+                          pb.TokenRequest(token="acme"))
+        assert "acme" not in inst.tenants
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await client.call("TenantManagement", "GetTenant",
+                              pb.TokenRequest(token="acme"))
+        assert exc.value.code() is grpc.StatusCode.NOT_FOUND
+
+
+async def test_grpc_and_rest_see_the_same_platform():
+    """The two API planes front one instance: a device created over gRPC
+    is visible over REST."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from sitewhere_tpu.api.rest import make_app
+
+    async with grpc_ctx() as (client, inst):
+        dt = await client.call("DeviceManagement", "CreateDeviceType",
+                               pb.DeviceType(name="xplane-type"))
+        dev = await client.call(
+            "DeviceManagement", "CreateDevice",
+            pb.Device(name="xplane", device_type_token=dt.token),
+        )
+        rest = TestClient(TestServer(make_app(inst)))
+        await rest.start_server()
+        try:
+            resp = await rest.post(
+                "/api/authapi/jwt",
+                json={"username": "admin", "password": "password"},
+            )
+            token = (await resp.json())["token"]
+            r = await rest.get(
+                f"/api/devices/{dev.token}",
+                headers={"Authorization": f"Bearer {token}",
+                         "X-Tenant": "default"},
+            )
+            assert r.status == 200
+            assert (await r.json())["name"] == "xplane"
+        finally:
+            await rest.close()

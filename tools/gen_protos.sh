@@ -1,0 +1,11 @@
+#!/bin/sh
+# Regenerate sitewhere_tpu/grpcapi/sitewhere_pb2.py from the proto.
+# Messages only: this image has protoc but no grpc python plugin — the
+# service stubs are hand-written (grpcapi/service.py, server.py, client.py).
+set -e
+cd "$(dirname "$0")/.."
+protoc \
+  --proto_path=sitewhere_tpu/grpcapi/protos \
+  --python_out=sitewhere_tpu/grpcapi \
+  sitewhere_tpu/grpcapi/protos/sitewhere.proto
+echo "generated sitewhere_tpu/grpcapi/sitewhere_pb2.py"
