@@ -43,6 +43,10 @@ def device_from_proto(p: pb.Device) -> Device:
     kw = {}
     if p.token:
         kw["token"] = p.token
+    if p.created_ts:
+        kw["created_ts"] = p.created_ts
+    if p.updated_ts:
+        kw["updated_ts"] = p.updated_ts
     return Device(
         name=p.name,
         description=p.description,

@@ -30,6 +30,7 @@ from sitewhere_tpu.services.user_management import (
     AUTH_EVENT_VIEW,
     AUTH_TENANT_ADMIN,
     AuthError,
+    AuthorityError,
 )
 
 
@@ -309,13 +310,14 @@ def build_rpc_handlers(instance) -> list:
                 claims = instance.users.validate_token(auth[7:])
                 if spec.authority is not None:
                     instance.users.require_authority(claims, spec.authority)
-            except AuthError as exc:
-                code = (
-                    grpc.StatusCode.PERMISSION_DENIED
-                    if "authority" in str(exc)
-                    else grpc.StatusCode.UNAUTHENTICATED
+            except AuthorityError as exc:
+                await context.abort(
+                    grpc.StatusCode.PERMISSION_DENIED, str(exc)
                 )
-                await context.abort(code, str(exc))
+            except AuthError as exc:
+                await context.abort(
+                    grpc.StatusCode.UNAUTHENTICATED, str(exc)
+                )
             runtime = None
             if spec.tenant_scoped:
                 tenant = md.get("tenant", "")

@@ -115,12 +115,15 @@ class SiteWhereInstance(LifecycleComponent):
         config: Optional[InstanceConfig] = None,
         mesh: Optional[MeshManager] = None,
         metrics: Optional[MetricsRegistry] = None,
+        bus=None,
     ) -> None:
         cfg = config or InstanceConfig()
         super().__init__(f"instance[{cfg.instance_id}]")
         self.config = cfg
         self.metrics = metrics or MetricsRegistry()
-        self.bus = EventBus(TopicNaming(cfg.instance_id), cfg.bus_retention)
+        # pluggable bus backend: default in-proc; pass e.g. a connected
+        # netbus.RemoteEventBus to run every service over a socket broker
+        self.bus = bus or EventBus(TopicNaming(cfg.instance_id), cfg.bus_retention)
         self.broker = SimBroker()  # in-proc MQTT; external broker swaps in
         self.mesh = mesh or MeshManager(
             tenant=cfg.mesh.tenant_axis if cfg.mesh.tenant_axis > 1 else 0,
@@ -368,7 +371,14 @@ class SiteWhereInstance(LifecycleComponent):
         # the executor thread races the jax runtime (heap corruption)
         from sitewhere_tpu.runtime.checkpoint import host_copy_params
 
-        bus_bytes = ck.snapshot_bus(self.bus)
+        # bus durability belongs to whoever OWNS the log: the in-proc bus
+        # is ours to snapshot; an external broker (RemoteEventBus) owns its
+        # own durable state — exactly the reference's posture toward Kafka
+        bus_bytes = (
+            ck.snapshot_bus(self.bus)
+            if isinstance(self.bus, EventBus)
+            else None
+        )
         param_snaps = {
             key: host_copy_params(tree)
             for key, tree in self.inference.snapshot_params().items()
@@ -388,7 +398,8 @@ class SiteWhereInstance(LifecycleComponent):
 
         # phase 2 — serialization/IO off the loop
         def _write() -> None:
-            ck.write_bus(bus_bytes)
+            if bus_bytes is not None:
+                ck.write_bus(bus_bytes)
             for (token, family), params in param_snaps.items():
                 ck.save_params(token, family, params)
             for token, snap in tenant_snaps.items():
@@ -406,9 +417,10 @@ class SiteWhereInstance(LifecycleComponent):
         ck = self.checkpoints
         if ck is None or not ck.exists():
             return 0
-        await asyncio.get_running_loop().run_in_executor(
-            None, ck.load_bus, self.bus
-        )
+        if isinstance(self.bus, EventBus):  # external brokers own their log
+            await asyncio.get_running_loop().run_in_executor(
+                None, ck.load_bus, self.bus
+            )
         manifest = ck.load_manifest() or []
         for entry in manifest:
             if entry["token"] in self.tenants:

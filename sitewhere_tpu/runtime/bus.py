@@ -335,6 +335,9 @@ class EventBus:
     def clear_faults(self, topic: str) -> None:
         self.topic(topic).fault = None
 
+    def seek(self, topic: str, group: str, offset: int) -> None:
+        self.topic(topic).seek(group, offset)
+
     def snapshot_offsets(self) -> Dict[str, Dict[str, int]]:
         """Offsets for persistence → crash-resume (SURVEY.md §5 checkpoint)."""
         return {
@@ -346,3 +349,14 @@ class EventBus:
             t = self.topic(name)
             for g, off in groups.items():
                 t.seek(g, off)
+
+    # -- durable state (the checkpoint seam) ------------------------------
+    def snapshot_state(self) -> Dict[str, dict]:
+        """Full durable bus state by topic name — retained entries +
+        cursors. Checkpointing goes through THIS (every backend exposes
+        it), never through a backend's internals."""
+        return {name: t.snapshot_state() for name, t in self._topics.items()}
+
+    def restore_state(self, state: Dict[str, dict]) -> None:
+        for name, st in state.items():
+            self.topic(name).restore_state(st)

@@ -13,7 +13,10 @@ Layout under ``data_dir``::
     bus.ckpt                           pickled topic logs + group cursors
     params/<tenant>.<family>.ckpt      pickled param pytree (numpy leaves)
     devices/<tenant>.json              device-model snapshot
-    events/measurements-<tenant>.parquet + events-<tenant>.jsonl
+    events/measurements-<tenant>-seg*-g*.parquet   sealed 64k-row segments
+    events/measurements-<tenant>-tail*.parquet     generationed live tail
+    events/events-<tenant>-g*.jsonl                non-measurement events
+    events/segments-<tenant>.json                  commit-point manifest
 
 Format note: pickle is used ONLY for self-written files inside the
 instance's own data_dir (same trust domain as the process); the device
@@ -100,10 +103,9 @@ class CheckpointManager:
         even on a live instance; the bytes then go to ``write_bus`` on an
         executor thread. Uses the Topic snapshot contract — never backend
         internals."""
-        state: Dict[str, dict] = {
-            name: bus.topic(name).snapshot_state() for name in bus.topics()
-        }
-        return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        return pickle.dumps(
+            bus.snapshot_state(), protocol=pickle.HIGHEST_PROTOCOL
+        )
 
     def write_bus(self, data: bytes) -> Path:
         path = self.root / "bus.ckpt"
@@ -122,8 +124,7 @@ class CheckpointManager:
             return False
         with path.open("rb") as fh:
             state = pickle.load(fh)
-        for name, st in state.items():
-            bus.topic(name).restore_state(st)
+        bus.restore_state(state)
         return True
 
     # -- device model + events -------------------------------------------
@@ -157,9 +158,10 @@ class CheckpointManager:
         bounded by the live tail — not by total stored rows. A segment
         manifest (row counts) detects a data_dir that belongs to a
         different store lineage and forces a full rewrite."""
+        tenant = store.tenant
         chunks = store.measurements.sealed_chunks()
         counts = [int(len(c["value"])) for c in chunks]
-        meta = self._load_seg_meta(store.tenant) or {}
+        meta = self._load_seg_meta(tenant) or {}
         on_disk = meta.get("counts", [])
         gen = int(meta.get("gen", 0)) + 1
         reuse = (
@@ -167,26 +169,35 @@ class CheckpointManager:
             and len(on_disk) <= len(counts)
             and counts[: len(on_disk)] == on_disk
         )
+        # every file this snapshot WRITES carries the new generation in its
+        # name — committed files are never overwritten in place, so a crash
+        # before the meta commit cannot corrupt the previous set even on a
+        # full lineage rewrite
+        seg_names: List[str] = list(meta.get("seg_names", [])) if reuse else []
         segments = []
         for i, ch in enumerate(chunks):
             if reuse and i < len(on_disk):
-                continue  # already on disk, immutable
-            segments.append((i, self._encode_parquet(ch)))
+                continue  # already committed, immutable, name kept
+            name = f"measurements-{tenant}-seg{i:06d}-g{gen:08d}.parquet"
+            seg_names.append(name)
+            segments.append((name, self._encode_parquet(ch)))
         tail = self._encode_parquet(store.measurements._tail_arrays())
-        tail_name = f"measurements-{store.tenant}-tail{gen:08d}.parquet"
+        tail_name = f"measurements-{tenant}-tail{gen:08d}.parquet"
+        other_name = f"events-{tenant}-g{gen:08d}.jsonl"
         return {
             "devices": json.dumps(dm.snapshot(), default=str),
             "segments": segments,
-            # meta is the COMMIT POINT: it names the consistent file set
-            # (segment count + the generationed tail), so a crash anywhere
-            # mid-write leaves the previous meta pointing at the previous
-            # complete set — no duplicated and no missing rows on load
+            # meta is the COMMIT POINT: it names the exact consistent file
+            # set, so a crash anywhere mid-write leaves the previous meta
+            # pointing at the previous complete set — no duplicated, no
+            # missing, no mixed-lineage rows on load
             "seg_meta": json.dumps(
-                {"counts": counts, "tail": tail_name, "gen": gen,
-                 "lineage": store.lineage}
+                {"counts": counts, "seg_names": seg_names, "tail": tail_name,
+                 "other": other_name, "gen": gen, "lineage": store.lineage}
             ),
             "tail_name": tail_name,
             "tail": tail,
+            "other_name": other_name,
             "other": "\n".join(
                 json.dumps(e.to_dict())
                 for lst in store._other.values()
@@ -203,8 +214,6 @@ class CheckpointManager:
         except ValueError:
             return None
 
-    def _seg_path(self, tenant: str, i: int) -> Path:
-        return self.root / "events" / f"measurements-{tenant}-seg{i:06d}.parquet"
 
     def write_tenant_stores(self, tenant: str, snap: dict) -> None:
         """Pure file IO — safe on an executor thread (bytes in, disk out).
@@ -214,38 +223,33 @@ class CheckpointManager:
         commit), then stale-file cleanup. A crash at any point leaves the
         previously committed set fully readable."""
         (self.root / "devices" / f"{tenant}.json").write_text(snap["devices"])
-        for i, data in snap["segments"]:
-            path = self._seg_path(tenant, i)
+        ev_dir = self.root / "events"
+
+        def put(name: str, data: bytes | str) -> None:
+            path = ev_dir / name
             tmp = path.with_suffix(".tmp")
-            tmp.write_bytes(data)
+            if isinstance(data, bytes):
+                tmp.write_bytes(data)
+            else:
+                tmp.write_text(data)
             tmp.replace(path)
-        tail_path = self.root / "events" / snap["tail_name"]
-        tmp = tail_path.with_suffix(".tmp")
-        tmp.write_bytes(snap["tail"])
-        tmp.replace(tail_path)
-        (self.root / "events" / f"events-{tenant}.jsonl").write_text(
-            snap["other"]
-        )
-        mp = self._seg_meta_path(tenant)
-        tmp = mp.with_suffix(".tmp")
+
+        for name, data in snap["segments"]:
+            put(name, data)
+        put(snap["tail_name"], snap["tail"])
+        put(snap["other_name"], snap["other"])
+        put_meta = self._seg_meta_path(tenant)
+        tmp = put_meta.with_suffix(".tmp")
         tmp.write_text(snap["seg_meta"])
-        tmp.replace(mp)  # ── commit ──
-        # post-commit cleanup: old tails + (on lineage rewrite) orphan segs
+        tmp.replace(put_meta)  # ── commit ──
+        # post-commit cleanup: every file the committed meta does NOT name
         meta = json.loads(snap["seg_meta"])
-        keep_segs = len(meta["counts"])
-        for old in (self.root / "events").glob(
-            f"measurements-{tenant}-tail*.parquet"
-        ):
-            if old.name != snap["tail_name"]:
+        keep = set(meta["seg_names"]) | {meta["tail"], meta["other"]}
+        for old in ev_dir.glob(f"measurements-{tenant}-*.parquet"):
+            if old.name not in keep:
                 old.unlink(missing_ok=True)
-        for old in (self.root / "events").glob(
-            f"measurements-{tenant}-seg*.parquet"
-        ):
-            try:
-                idx = int(old.stem.rsplit("seg", 1)[-1])
-            except ValueError:
-                continue
-            if idx >= keep_segs:
+        for old in ev_dir.glob(f"events-{tenant}-g*.jsonl"):
+            if old.name not in keep:
                 old.unlink(missing_ok=True)
 
     def save_tenant_stores(self, tenant: str, dm, store) -> None:
@@ -274,8 +278,13 @@ class CheckpointManager:
             return None
         # the committed set is exactly what meta names — stray files from a
         # torn write are ignored
+        legacy_names = [
+            f"measurements-{tenant}-seg{i:06d}.parquet"
+            for i in range(len(meta["counts"]))
+        ]
         seg_files = [
-            self._seg_path(tenant, i) for i in range(len(meta["counts"]))
+            self.root / "events" / n
+            for n in meta.get("seg_names", legacy_names)
         ]
         tail_path = self.root / "events" / meta["tail"]
 
@@ -303,11 +312,18 @@ class CheckpointManager:
             ch = read_chunk(p)
             if len(ch["value"]):
                 store.measurements.add_sealed_chunk(ch)
-        jsonl = self.root / "events" / f"events-{tenant}.jsonl"
+        jsonl = self.root / "events" / meta.get(
+            "other", f"events-{tenant}.jsonl"
+        )
         if jsonl.exists():
             for line in jsonl.read_text().splitlines():
-                if line.strip():
+                if not line.strip():
+                    continue
+                try:
                     store.add_event(event_from_dict(json.loads(line)))
+                except (ValueError, KeyError):
+                    # a torn trailing line must not fail the whole restore
+                    continue
         return store
 
     # -- manifest ---------------------------------------------------------

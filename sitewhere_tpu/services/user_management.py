@@ -34,6 +34,11 @@ class AuthError(PermissionError):
     pass
 
 
+class AuthorityError(AuthError):
+    """Authenticated but lacking the required authority — callers map
+    this to 403/PERMISSION_DENIED vs AuthError's 401/UNAUTHENTICATED."""
+
+
 def _b64url(data: bytes) -> str:
     return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
 
@@ -139,4 +144,4 @@ class UserManagement:
     def require_authority(self, claims: Dict, authority: str) -> None:
         auths = claims.get("auth", [])
         if AUTH_ADMIN not in auths and authority not in auths:
-            raise AuthError(f"missing authority {authority}")
+            raise AuthorityError(f"missing authority {authority}")

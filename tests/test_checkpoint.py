@@ -246,8 +246,8 @@ def test_segmented_event_checkpoint_incremental_and_torn_write(tmp_path):
     snap3 = ck.snapshot_tenant_stores(dm, store)
     assert len(snap3["segments"]) == 1
     # simulate crash: write the segment + tail files but skip the meta
-    i, data = snap3["segments"][0]
-    ck._seg_path("seg", i).write_bytes(data)
+    name, data = snap3["segments"][0]
+    (tmp_path / "events" / name).write_bytes(data)
     (tmp_path / "events" / snap3["tail_name"]).write_bytes(snap3["tail"])
     got = ck.load_event_store("seg")
     # previous committed set: exactly 180 rows, no dup/missing
