@@ -98,13 +98,17 @@ class TenantRuntime:
     batch: BatchOperationManager
     schedules: ScheduleManager
     broker_handler: object = None  # tenant input handler (for unsubscribe)
+    media_pipeline: object = None  # MediaClassificationPipeline | None
 
     def components(self) -> List[LifecycleComponent]:
-        return [
+        out = [
             self.source, self.inbound, self.persistence, self.rules,
             self.outbound, self.state, self.registration, self.commands,
             self.batch, self.schedules,
         ]
+        if self.media_pipeline is not None:
+            out.append(self.media_pipeline)
+        return out
 
 
 class SiteWhereInstance(LifecycleComponent):
@@ -226,6 +230,14 @@ class SiteWhereInstance(LifecycleComponent):
             ],
             self.metrics,
         )
+        media = StreamingMedia(tenant)
+        media_pipe = None
+        if cfg.media_pipeline:
+            from sitewhere_tpu.pipeline.media import MediaClassificationPipeline
+
+            media_pipe = MediaClassificationPipeline(
+                tenant, self.bus, media, self.metrics, tiny=cfg.media_tiny
+            )
         return TenantRuntime(
             tenant=tenant,
             config=cfg,
@@ -233,7 +245,8 @@ class SiteWhereInstance(LifecycleComponent):
             event_store=store,
             asset_management=AssetManagement(tenant),
             labels=LabelGeneration(tenant),
-            media=StreamingMedia(tenant),
+            media=media,
+            media_pipeline=media_pipe,
             source=source,
             inbound=InboundProcessor(tenant, self.bus, dm, self.metrics),
             persistence=EventPersistence(tenant, self.bus, store, self.metrics),

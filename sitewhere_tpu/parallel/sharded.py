@@ -23,7 +23,7 @@ resident tenants' events against its resident window state.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +122,18 @@ class ShardedScorer:
         self.active = jax.device_put(
             jnp.zeros((self.n_slots,), bool), t_shard
         )
+        # which slots may TRAIN (tenants opt in via TrainingConfig): slots
+        # sharing the stack with training disabled score normally but are
+        # masked out of train_resident's gradient step
+        self.train_mask = jax.device_put(
+            jnp.zeros((self.n_slots,), bool), t_shard
+        )
+        # per-slot learning rate: tenants sharing a family stack keep
+        # their OWN lr (the optimizer is scale_by_adam; the lr multiplies
+        # the transformed update per slot inside the train step)
+        self.slot_lr = jax.device_put(
+            jnp.ones((self.n_slots,), jnp.float32), t_shard
+        )
         self._step = self._build_step()
 
     # -- compiled step ---------------------------------------------------
@@ -184,21 +196,32 @@ class ShardedScorer:
         return scores
 
     # -- slot management -------------------------------------------------
-    def activate(self, global_slot: int, params: Params = None) -> None:
+    def activate(
+        self,
+        global_slot: int,
+        params: Params = None,
+        trainable: bool = True,
+        lr: Optional[float] = None,
+    ) -> None:
         if params is not None:
             self.params = jax.jit(set_slot, static_argnums=1, donate_argnums=0)(
                 self.params, global_slot, params
             )
         self.active = self.active.at[global_slot].set(True)
+        self.train_mask = self.train_mask.at[global_slot].set(trainable)
+        if lr is not None:
+            self.slot_lr = self.slot_lr.at[global_slot].set(lr)
 
     def deactivate(self, global_slot: int) -> None:
         self.active = self.active.at[global_slot].set(False)
+        self.train_mask = self.train_mask.at[global_slot].set(False)
 
     def reset_slot(self, global_slot: int) -> None:
         """Wipe a slot's window state + params + optimizer moments back to
         pristine — a recycled slot must not leak the previous tenant's
         history, trained weights, or Adam momentum."""
         self.deactivate(global_slot)
+        self.slot_lr = self.slot_lr.at[global_slot].set(1.0)
         self.params = set_slot(self.params, global_slot, self._base_params)
         self.state = WindowState(
             values=self.state.values.at[global_slot].set(0.0),
@@ -216,17 +239,30 @@ class ShardedScorer:
         return unstack_slot(self.params, global_slot)
 
     # -- training (per-tenant divergence) --------------------------------
-    def init_optimizer(self, optimizer) -> None:
-        """Attach an optax-style optimizer; opt state is stacked per slot
-        and sharded along the tenant axis like the params."""
+    def init_optimizer(self, optimizer=None) -> None:
+        """Attach an optimizer; opt state is stacked per slot and sharded
+        along the tenant axis like the params.
+
+        Default (None): ``optax.scale_by_adam`` with the PER-SLOT learning
+        rates in ``self.slot_lr`` applied inside the train step — tenants
+        sharing a family stack each train at their own lr. A custom
+        optimizer is also accepted (its update already encodes -lr);
+        ``slot_lr`` then acts as a per-slot multiplier (default 1.0)."""
+        import optax
+
+        if optimizer is None:
+            optimizer = optax.scale_by_adam()
+            lr_sign = -1.0   # update is gradient-signed: descend
+        else:
+            lr_sign = 1.0    # update already encodes the step direction
         self._optimizer = optimizer
         opt_state = jax.vmap(optimizer.init)(self.params)
         t_shard = self.mm.tenant_stacked()
         self._opt_state = jax.device_put(opt_state, t_shard)
         self._fresh_opt = optimizer.init(self._base_params)  # for reset_slot
-        self._train = self._build_train_step(optimizer)
+        self._train = self._build_train_step(optimizer, lr_sign)
 
-    def _build_train_step(self, optimizer) -> Callable:
+    def _build_train_step(self, optimizer, lr_sign: float = 1.0) -> Callable:
         """Train every slot on its RESIDENT window state — the windows
         already live sharded on device, so training moves ZERO bytes over
         host↔device; grads ride ICI via a single pmean over the data axis
@@ -234,9 +270,9 @@ class ShardedScorer:
         mesh = self.mm.mesh
         spec, cfg, window = self.spec, self.cfg, self.window
 
-        def local_step(params, opt_state, values, pos, count, active):
+        def local_step(params, opt_state, values, pos, count, active, lr):
             # params/opt [T_loc, ...], values [T_loc, S_loc, W], active [T_loc]
-            def one(p, o, vals, ps, cnt, act):
+            def one(p, o, vals, ps, cnt, act, lr1):
                 st = WindowState(values=vals, pos=ps, count=cnt)
                 ids = jnp.arange(vals.shape[0], dtype=jnp.int32)
                 windows, n = gather_windows(st, ids)
@@ -259,8 +295,10 @@ class ShardedScorer:
                 # gradient is the SUM of the shards' partials
                 grads = jax.lax.psum(grads, AXIS_DATA)
                 updates, o2 = optimizer.update(grads, o, p)
+                step_scale = lr_sign * lr1  # per-slot lr (see init_optimizer)
                 p2 = jax.tree_util.tree_map(
-                    lambda a, u: (a + u).astype(a.dtype), p, updates
+                    lambda a, u: (a + step_scale * u).astype(a.dtype),
+                    p, updates,
                 )
                 # inactive slots keep pristine params AND optimizer state
                 # (an advancing Adam step count would skew bias correction
@@ -273,7 +311,9 @@ class ShardedScorer:
                 )
                 return p2, o2, l
             act_f = active.astype(jnp.float32)
-            return jax.vmap(one)(params, opt_state, values, pos, count, act_f)
+            return jax.vmap(one)(
+                params, opt_state, values, pos, count, act_f, lr
+            )
 
         smapped = jax.shard_map(
             local_step,
@@ -285,18 +325,28 @@ class ShardedScorer:
                 P(AXIS_TENANT, AXIS_DATA),   # pos
                 P(AXIS_TENANT, AXIS_DATA),   # count
                 P(AXIS_TENANT),              # active mask
+                P(AXIS_TENANT),              # per-slot lr
             ),
             out_specs=(P(AXIS_TENANT), P(AXIS_TENANT), P(AXIS_TENANT)),
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
 
-    def train_resident(self) -> jnp.ndarray:
-        """One optimizer step for every active slot on its resident window
-        state; returns per-slot loss f32[T]. Call ``init_optimizer`` first."""
+    def train_resident(
+        self, slots_mask: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        """One optimizer step for every trainable active slot on its
+        resident window state; returns per-slot loss f32[T]. Call
+        ``init_optimizer`` first. ``slots_mask`` (bool[T]) further
+        restricts which slots step — per-tenant training CADENCE in a
+        shared stack rides this."""
         if getattr(self, "_train", None) is None:
-            raise RuntimeError("call init_optimizer(optax_optimizer) first")
+            raise RuntimeError("call init_optimizer() first")
+        mask = self.active & self.train_mask
+        if slots_mask is not None:
+            mask = mask & slots_mask
         self.params, self._opt_state, losses = self._train(
             self.params, self._opt_state,
-            self.state.values, self.state.pos, self.state.count, self.active,
+            self.state.values, self.state.pos, self.state.count,
+            mask, self.slot_lr,
         )
         return losses

@@ -188,7 +188,11 @@ class TpuInferenceEngine(TenantEngine):
                 None, svc.checkpoints.load_params,
                 self.tenant, self.config.model,
             )
-        scorer.activate(svc.router.global_slot(self.placement), params=params)
+        scorer.activate(
+            svc.router.global_slot(self.placement), params=params,
+            trainable=self.config.training.enabled,
+            lr=self.config.training.lr,
+        )
 
     async def on_stop(self) -> None:
         svc = self.service
@@ -259,6 +263,9 @@ class TpuInferenceService(MultitenantService):
         # batch registry: seq → [batch, rows_awaiting_scores]
         self._batches: Dict[int, list] = {}
         self._next_seq = 0
+        # live-training cadence: per-family {slot: flush-tick} + last losses
+        self._train_ticks: Dict[str, Dict[int, int]] = {}
+        self.last_train_losses: Dict[str, object] = {}  # device arrays
         self._inflight = asyncio.Semaphore(max_inflight)
         self._deliver_tasks: set = set()
         self.max_inflight = max_inflight
@@ -475,6 +482,7 @@ class TpuInferenceService(MultitenantService):
             return 0
 
         scores_dev = scorer.step(ids, vals, valid)  # async dispatch
+        self._train_tick(family, scorer, engine_cfgs)
         taken = (
             np.concatenate(tk_slots),
             np.concatenate(tk_cols),
@@ -487,6 +495,45 @@ class TpuInferenceService(MultitenantService):
         self._deliver_tasks.add(task)
         task.add_done_callback(self._deliver_tasks.discard)
         return moved
+
+    def _train_tick(
+        self, family: str, scorer: ShardedScorer,
+        engine_cfgs: Dict[int, TenantEngineConfig],
+    ) -> int:
+        """Live training cadence: every Nth scoring flush dispatches ONE
+        optimizer step for every active slot on its resident window state
+        (zero host<->device traffic — see ShardedScorer.train_resident).
+        The jit dispatch is async, so the scoring loop never blocks on the
+        gradient computation; tenants in the same family stack with
+        training disabled are excluded by the scorer's per-slot train
+        mask."""
+        enabled = {
+            slot: c.training
+            for slot, c in engine_cfgs.items()
+            if c.training.enabled
+        }
+        if not enabled:
+            return 0
+        # per-TENANT cadence: each slot matures on its own every_n_flushes
+        # (and trains at its own lr — see ShardedScorer.slot_lr)
+        ticks = self._train_ticks.setdefault(family, {})
+        mature = []
+        for slot, tc in enabled.items():
+            n = ticks.get(slot, 0) + 1
+            if n >= tc.every_n_flushes:
+                mature.append(slot)
+                ticks[slot] = 0
+            else:
+                ticks[slot] = n
+        if not mature:
+            return 0
+        if getattr(scorer, "_train", None) is None:
+            scorer.init_optimizer()  # scale_by_adam + per-slot lr
+        mask = np.zeros((scorer.n_slots,), bool)
+        mask[mature] = True
+        self.last_train_losses[family] = scorer.train_resident(mask)
+        self.metrics.counter("tpu_inference.train_steps").inc()
+        return 1
 
     async def _deliver(self, scores_dev, taken) -> None:
         """Materialize one flush's scores off the loop and resolve rows.
@@ -627,6 +674,26 @@ class TpuInferenceService(MultitenantService):
             mb = engine.config.microbatch
             sizes = [min(b, mb.max_batch) for b in mb.buckets] + [mb.max_batch]
             scorer.prewarm(sizes)
+
+    def params_source(self, tenant: str):
+        """A zero-arg callable yielding the tenant's CURRENT slot params
+        (live-trained, or checkpoint-restored after a restart) — the
+        CEP→TPU bridge binds ModelUdf evaluation to this so rule verdicts
+        track the tenant's actual model, never a fresh init. Returns None
+        while the tenant has no placement (caller falls back)."""
+
+        def source():
+            engine = self.engines.get(tenant)
+            if engine is None or engine.placement is None:
+                return None
+            scorer = self.scorers.get(engine.config.model)
+            if scorer is None:
+                return None
+            return scorer.slot_params(
+                self.router.global_slot(engine.placement)
+            )
+
+        return source
 
     def snapshot_params(self) -> Dict[Tuple[str, str], object]:
         """Live param cut for checkpointing: (tenant, family) → param

@@ -1,0 +1,179 @@
+"""Streaming-media classification pipeline: camera chunks → frame decode
+→ micro-batched ViT classification → classification events on the bus.
+
+Closes the north-star media loop (BASELINE.json:11; SURVEY.md §2.2
+streaming-media [U]; reference mount empty, see provenance banner): the
+reference's service only STORES stream chunks — the rebuild adds the TPU
+leg, reusing the micro-batching playbook from ``pipeline.inference``
+(bucketed static shapes, collect deadline, pipelined materialization off
+the event loop).
+
+Chunk kinds:
+- ``raw-rgb8``: H*W*3 uint8 bytes (raw camera feed) — np.frombuffer, no
+  per-pixel Python;
+- ``jpeg``/``png``: decoded via PIL on an executor thread (CPU-bound).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.streaming_media import StreamingMedia
+
+
+def media_classifications_topic(bus: EventBus, tenant: str) -> str:
+    return bus.naming.tenant_topic(tenant, "media-classifications")
+
+
+class MediaClassificationPipeline(LifecycleComponent):
+    """Per-tenant micro-batched frame classifier over the media service."""
+
+    def __init__(
+        self,
+        tenant: str,
+        bus: EventBus,
+        media: StreamingMedia,
+        metrics: Optional[MetricsRegistry] = None,
+        max_batch: int = 16,
+        deadline_ms: float = 30.0,
+        top_k: int = 5,
+        tiny: bool = False,          # tiny ViT for CI; B/16 in prod/bench
+        max_inflight: int = 4,
+        store_chunks: bool = True,
+    ) -> None:
+        super().__init__(f"media-pipeline[{tenant}]")
+        self.tenant = tenant
+        self.bus = bus
+        self.media = media
+        self.metrics = metrics or MetricsRegistry()
+        self.max_batch = max_batch
+        self.deadline_ms = deadline_ms
+        self.top_k = top_k
+        self.tiny = tiny
+        self.store_chunks = store_chunks
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self._task: Optional[asyncio.Task] = None
+        self._inflight = asyncio.Semaphore(max_inflight)
+        self._deliver_tasks: set = set()
+
+    # -- ingest -----------------------------------------------------------
+    @property
+    def image_size(self) -> int:
+        from sitewhere_tpu.models.vit import VIT_B16, VIT_TINY_TEST
+
+        return (VIT_TINY_TEST if self.tiny else VIT_B16).image_size
+
+    async def submit_chunk(
+        self,
+        stream_id: str,
+        seq: int,
+        data: bytes,
+        kind: str = "raw-rgb8",
+    ) -> None:
+        """One camera chunk: persisted to the stream store (playback
+        parity) and queued for classification."""
+        if self.store_chunks:
+            self.media.append_chunk(stream_id, seq, data)
+        size = self.image_size
+        if kind == "raw-rgb8":
+            frame = self._decode_raw(data, size)
+        else:  # jpeg/png: PIL decode is CPU-bound — off the loop. u8 so
+            # every frame shares the on-device normalization path
+            frame = await asyncio.get_running_loop().run_in_executor(
+                None, self.media.decode_frame, data, size, "u8"
+            )
+        await self._queue.put((stream_id, seq, frame, time.monotonic()))
+
+    @staticmethod
+    def _decode_raw(data: bytes, size: int) -> np.ndarray:
+        n = size * size * 3
+        if len(data) < n:
+            raise ValueError(f"raw chunk too short: {len(data)} < {n}")
+        # stays uint8: frames normalize ON DEVICE (classify_frames), so
+        # host→device moves 1 byte/px instead of 4
+        return np.frombuffer(data, np.uint8, n).reshape(size, size, 3)
+
+    # -- lifecycle --------------------------------------------------------
+    async def on_start(self) -> None:
+        # ensure the classifier (and its jit) exists before traffic
+        self.media._get_classifier(self.tiny)
+        self._task = asyncio.create_task(self._run(), name=self.name)
+
+    async def on_stop(self) -> None:
+        await cancel_and_wait(self._task)
+        self._task = None
+        if self._deliver_tasks:
+            await asyncio.gather(*self._deliver_tasks, return_exceptions=True)
+
+    def prewarm(self) -> None:
+        """Compile the classification batch shape before timed traffic."""
+        size = self.image_size
+        self.media.classify_frames(
+            np.zeros((self.max_batch, size, size, 3), np.uint8),
+            top_k=self.top_k, tiny=self.tiny,
+        )
+
+    # -- batching loop ----------------------------------------------------
+    async def _run(self) -> None:
+        topic = media_classifications_topic(self.bus, self.tenant)
+        frames_ctr = self.metrics.counter("media.frames_classified")
+        lat = self.metrics.histogram("media.latency", unit="s")
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = time.monotonic() + self.deadline_ms / 1000.0
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self._queue.get(), timeout)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            await self._inflight.acquire()
+            task = asyncio.create_task(
+                self._classify_and_publish(batch, topic, frames_ctr, lat)
+            )
+            self._deliver_tasks.add(task)
+            task.add_done_callback(self._deliver_tasks.discard)
+
+    async def _classify_and_publish(
+        self, batch: List[Tuple], topic: str, frames_ctr, lat
+    ) -> None:
+        try:
+            frames = np.stack([b[2] for b in batch])
+            # jit dispatch + materialization off the loop (the classify
+            # output is a jit result nothing donates — worker-thread
+            # materialization is safe, see checkpoint.host_copy_params)
+            results = await asyncio.get_running_loop().run_in_executor(
+                None, self.media.classify_frames, frames, self.top_k, self.tiny
+            )
+            now_mono = time.monotonic()
+            now = time.time() * 1000.0
+            for (stream_id, seq, _f, t0), top in zip(batch, results):
+                await self.bus.publish(topic, {
+                    "type": "media_classification",
+                    "tenant": self.tenant,
+                    "stream_id": stream_id,
+                    "seq": seq,
+                    "top_k": top,
+                    "ts": now,
+                })
+                lat.record(now_mono - t0)
+            frames_ctr.inc(len(batch))
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - one bad batch must not
+            # kill the classification loop
+            self._record_error("classify", exc)
+        finally:
+            self._inflight.release()

@@ -316,7 +316,13 @@ class ModelUdf:
     bridge [B:5]): wraps a model-zoo forecaster/scorer; evaluates on the
     rule window's values under jit."""
 
-    def __init__(self, family: str, model_config: Optional[Dict[str, Any]] = None, seed: int = 0):
+    def __init__(
+        self,
+        family: str,
+        model_config: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        params_source: Optional[Callable[[], Any]] = None,
+    ):
         import jax
 
         from sitewhere_tpu.models import get_model, make_config
@@ -324,8 +330,23 @@ class ModelUdf:
         self.spec = get_model(family)
         self.cfg = make_config(family, model_config)
         self.params = self.spec.init(jax.random.PRNGKey(seed), self.cfg)
+        # live binding: evaluate with the tenant's CURRENT slot params
+        # (trained/restored) instead of the fresh init above — wire via
+        # TpuInferenceService.params_source(tenant)
+        self.params_source = params_source
         self._jit_cache: Dict[Tuple[str, int], Callable] = {}
         self._key = jax.random.PRNGKey(seed + 1)
+
+    def bind_params_source(self, source: Callable[[], Any]) -> "ModelUdf":
+        self.params_source = source
+        return self
+
+    def _live_params(self):
+        if self.params_source is not None:
+            live = self.params_source()
+            if live is not None:
+                return live
+        return self.params
 
     def _padded(self, values: np.ndarray, target: int) -> np.ndarray:
         v = values[-target:]
@@ -347,7 +368,7 @@ class ModelUdf:
             self._jit_cache[("forecast", ctx)] = fn
         self._key, sub = jax.random.split(self._key)
         window = jnp.asarray(self._padded(values, ctx))[None]
-        _, mean = fn(self.params, self.cfg, window, sub)
+        _, mean = fn(self._live_params(), self.cfg, window, sub)
         return np.asarray(mean[0])
 
     def score(self, values: np.ndarray) -> float:
@@ -362,7 +383,7 @@ class ModelUdf:
             self._jit_cache[("score", w)] = fn
         window = jnp.asarray(self._padded(values, w))[None]
         n = jnp.asarray([min(len(values), w)], jnp.int32)
-        return float(fn(self.params, self.cfg, window, n)[0])
+        return float(fn(self._live_params(), self.cfg, window, n)[0])
 
 
 def forecast_breach_rule(

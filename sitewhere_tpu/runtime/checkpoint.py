@@ -172,8 +172,16 @@ class CheckpointManager:
         # every file this snapshot WRITES carries the new generation in its
         # name — committed files are never overwritten in place, so a crash
         # before the meta commit cannot corrupt the previous set even on a
-        # full lineage rewrite
-        seg_names: List[str] = list(meta.get("seg_names", [])) if reuse else []
+        # full lineage rewrite. A meta from the pre-seg_names layout keeps
+        # its segments via the legacy naming scheme (they must re-enter the
+        # new meta or cleanup would delete committed rows).
+        legacy = [
+            f"measurements-{tenant}-seg{i:06d}.parquet"
+            for i in range(len(on_disk))
+        ]
+        seg_names: List[str] = (
+            list(meta.get("seg_names") or legacy) if reuse else []
+        )
         segments = []
         for i, ch in enumerate(chunks):
             if reuse and i < len(on_disk):

@@ -38,14 +38,30 @@ class MicroBatchConfig:
 
 
 @dataclass(frozen=True)
+class TrainingConfig:
+    """Live on-device training cadence (rebuild-only: per-tenant models
+    diverge by training on their RESIDENT window state — zero bytes move
+    host<->device; see parallel.sharded.train_resident)."""
+
+    enabled: bool = False
+    every_n_flushes: int = 50   # one optimizer step per N scoring flushes
+    lr: float = 1e-3
+
+
+@dataclass(frozen=True)
 class TenantEngineConfig:
     tenant: str = "default"
     template: str = "default"       # template this config was built from
     model: str = "lstm_ad"          # model-zoo key for the scoring model
     model_config: Dict[str, Any] = field(default_factory=dict)
     microbatch: MicroBatchConfig = field(default_factory=MicroBatchConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
     max_streams: int = 4096         # window-state capacity (series slots)
     decoder: str = "json"
+    # streaming-media classification leg (chunks → ViT → events); tiny
+    # uses the test-sized ViT so CI exercises the full flow cheaply
+    media_pipeline: bool = False
+    media_tiny: bool = False
     # opt-in to the instance-shared 'sitewhere/input/+' broker pattern; the
     # tenant-scoped 'sitewhere/{tenant}/input/+' pattern is always active.
     # With >1 tenant and no flag, shared-input routes to NO tenant (isolation)
@@ -99,9 +115,10 @@ TENANT_TEMPLATES: Dict[str, Dict[str, Any]] = {
         "datasets": ["empty"],
     },
     "media": {
-        "model": "vit_b16",
-        "model_config": {},
+        "model": "lstm_ad",   # telemetry still scores; frames ride the
+        "model_config": {},   # media pipeline (vit) beside it
         "datasets": ["empty"],
+        "media_pipeline": True,
     },
 }
 
@@ -111,11 +128,17 @@ def tenant_config_from_template(
 ) -> TenantEngineConfig:
     resolved = template if template in TENANT_TEMPLATES else "default"
     tpl = TENANT_TEMPLATES[resolved]
+    known = TenantEngineConfig.__dataclass_fields__
+    extra = {
+        k: v for k, v in tpl.items()
+        if k in known and k not in ("model", "model_config")
+    }
     cfg = TenantEngineConfig(
         tenant=tenant,
         template=resolved,  # record what was APPLIED, not what was asked for
         model=tpl["model"],
         model_config=dict(tpl["model_config"]),
+        **extra,
     )
     if overrides:
         cfg = replace(cfg, **overrides)
@@ -144,17 +167,26 @@ def tenant_config_to_dict(cfg: TenantEngineConfig) -> Dict[str, Any]:
 def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
     d = dict(d)
     mb = d.pop("microbatch", None) or {}
+    tr = d.pop("training", None) or {}
     if "buckets" in mb:
         mb["buckets"] = tuple(mb["buckets"])
-    # drop unknown keys at BOTH levels: a manifest written by a newer build
+    # drop unknown keys at EVERY level: a manifest written by a newer build
     # (extra knobs) must degrade gracefully, not abort the whole restore
     mb_known = MicroBatchConfig.__dataclass_fields__
+    tr_known = TrainingConfig.__dataclass_fields__
     known = TenantEngineConfig.__dataclass_fields__
     return TenantEngineConfig(
         microbatch=MicroBatchConfig(
             **{k: v for k, v in mb.items() if k in mb_known}
         ),
-        **{k: v for k, v in d.items() if k in known and k != "microbatch"},
+        training=TrainingConfig(
+            **{k: v for k, v in tr.items() if k in tr_known}
+        ),
+        **{
+            k: v
+            for k, v in d.items()
+            if k in known and k not in ("microbatch", "training")
+        },
     )
 
 

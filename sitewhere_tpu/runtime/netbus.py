@@ -134,8 +134,12 @@ class BusBrokerServer(LifecycleComponent):
             return bus.publish_nowait(*args)
         if op == "consume":
             # cap server-side waits so a vanished client can't pin a poll
-            # forever; the client re-issues long polls
+            # forever; the client re-issues long polls. A dropped
+            # (tombstoned) topic returns None so the client can stop
+            # re-issuing instead of hot-looping on instant empty replies
             topic, group, max_items, timeout_s = args
+            if bus.topic(topic).dropped:
+                return None
             if timeout_s is None or timeout_s > 30.0:
                 timeout_s = 30.0
             return await bus.consume(topic, group, max_items, timeout_s)
@@ -273,6 +277,8 @@ class RemoteEventBus:
             items = await self._call(
                 "consume", topic, group, max_items, remaining
             )
+            if items is None:
+                return []  # topic dropped (tenant teardown) — stop polling
             if items:
                 return items
             if remaining is not None and remaining <= 30.0:
