@@ -148,6 +148,7 @@ class SiteWhereInstance(LifecycleComponent):
         self.add_child(self.inference)
         self.tenants: Dict[str, TenantRuntime] = {}
         self._updates_task: Optional[asyncio.Task] = None
+        self._autosave_task: Optional[asyncio.Task] = None
         # ONE instance-level subscription for the shared input pattern; it
         # routes to opted-in tenants (cfg.shared_input) or — if none opted
         # in — to the sole tenant. With >=2 tenants and no flag it routes
@@ -349,17 +350,46 @@ class SiteWhereInstance(LifecycleComponent):
         self._updates_task = asyncio.create_task(
             self._updates_loop(), name=f"{self.name}-tenant-updates"
         )
+        if self.checkpoints is not None and self.config.checkpoint_interval_s > 0:
+            self._autosave_task = asyncio.create_task(
+                self._autosave_loop(), name=f"{self.name}-autosave"
+            )
+
+    async def _autosave_loop(self) -> None:
+        """Periodic live checkpoint: bounds the loss window of a HARD kill
+        (no polite stop) to one interval (VERDICT r2 item 7)."""
+        interval = self.config.checkpoint_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.checkpoint()
+                self.metrics.counter("instance.autosaves").inc()
+            except Exception as exc:  # noqa: BLE001 - an autosave failure
+                # must not kill the loop; the next tick retries
+                self._record_error("autosave", exc)
 
     async def stop(self) -> None:
-        # quiesce the updates loop FIRST: it mutates the child tree
-        # (add/remove tenant runtimes), so it must not race the cascade
+        was_started = self.state is LifecycleState.STARTED
+        # quiesce the updates + autosave loops FIRST: they mutate the
+        # child tree / snapshot it, so they must not race the cascade
         await cancel_and_wait(self._updates_task)
         self._updates_task = None
+        await cancel_and_wait(self._autosave_task)
+        self._autosave_task = None
         await super().stop()
+        # checkpoint-on-stop: a clean shutdown always leaves a current
+        # snapshot (engines already saved their params in the cascade)
+        if was_started and self.checkpoints is not None:
+            try:
+                await self.checkpoint()
+            except Exception as exc:  # noqa: BLE001
+                self._record_error("checkpoint-on-stop", exc)
 
     async def on_stop(self) -> None:
         await cancel_and_wait(self._updates_task)
         self._updates_task = None
+        await cancel_and_wait(getattr(self, "_autosave_task", None))
+        self._autosave_task = None
 
     async def _updates_loop(self) -> None:
         while True:

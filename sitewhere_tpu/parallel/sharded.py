@@ -183,6 +183,10 @@ class ShardedScorer:
             valid = _np.zeros((t, d * b), bool)
             _np.asarray(self.step(ids, vals, valid))
 
+    # chaos knob: >0 makes the next N step() calls raise (fault-injection
+    # hook for the auto-failover path, like the bus FaultPlan)
+    fault_steps: int = 0
+
     def step(
         self,
         stream_ids: jnp.ndarray,  # i32[T, B] LOCAL ids per data shard lane
@@ -190,6 +194,9 @@ class ShardedScorer:
         valid: jnp.ndarray,       # bool[T, B]
     ) -> jnp.ndarray:
         """Score one stacked micro-batch; returns f32[T, B] scores."""
+        if self.fault_steps > 0:
+            self.fault_steps -= 1
+            raise RuntimeError("injected scorer fault (chaos)")
         self.state, scores = self._step(
             self.params, self.state, self.active, stream_ids, values, valid
         )

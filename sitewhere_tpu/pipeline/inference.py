@@ -48,7 +48,11 @@ from sitewhere_tpu.parallel.sharded import ShardedScorer
 from sitewhere_tpu.parallel.tenant_router import TenantRouter
 from sitewhere_tpu.runtime.bus import EventBus
 from sitewhere_tpu.runtime.config import TenantEngineConfig
-from sitewhere_tpu.runtime.lifecycle import LifecycleState, cancel_and_wait
+from sitewhere_tpu.runtime.lifecycle import (
+    LifecycleState,
+    SupervisedTask,
+    cancel_and_wait,
+)
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
@@ -259,13 +263,19 @@ class TpuInferenceService(MultitenantService):
         self.scorers: Dict[str, ShardedScorer] = {}
         self._lanes: Dict[str, Dict[Tuple[int, int], _Lane]] = {}
         self._first_pending_ts: Dict[str, float] = {}
-        self._loop_task: Optional[asyncio.Task] = None
+        self._loop_super: Optional[SupervisedTask] = None
         # batch registry: seq → [batch, rows_awaiting_scores]
         self._batches: Dict[int, list] = {}
         self._next_seq = 0
         # live-training cadence: per-family {slot: flush-tick} + last losses
         self._train_ticks: Dict[str, Dict[int, int]] = {}
         self.last_train_losses: Dict[str, object] = {}  # device arrays
+        # auto-failover: consecutive scorer errors per family; at the
+        # threshold every tenant of the family re-places onto a different
+        # mesh shard (SURVEY.md §5: "tenant-engine failover to a different
+        # mesh shard")
+        self.failover_threshold = 3
+        self._consec_errors: Dict[str, int] = {}
         self._inflight = asyncio.Semaphore(max_inflight)
         self._deliver_tasks: set = set()
         self.max_inflight = max_inflight
@@ -308,13 +318,19 @@ class TpuInferenceService(MultitenantService):
         self._deliver_pool = ThreadPoolExecutor(
             max_workers=self.max_inflight, thread_name_prefix="tpu-deliver"
         )
-        self._loop_task = asyncio.create_task(
-            self._scoring_loop(), name="tpu-inference-loop"
+        # SUPERVISED scoring loop: a persistent loop error restarts it
+        # with backoff instead of silently killing all scoring (the k8s
+        # liveness-probe-restart analog, in-process)
+        self._loop_super = SupervisedTask(
+            "tpu-inference-loop", self._scoring_loop, max_restarts=5
         )
+        await self._loop_super.initialize()
+        await self._loop_super.start()
 
     async def on_stop(self) -> None:
-        await cancel_and_wait(self._loop_task)
-        self._loop_task = None
+        if getattr(self, "_loop_super", None) is not None:
+            await self._loop_super.terminate()
+            self._loop_super = None
         # let in-flight deliveries finish (they hold rows already popped
         # from lanes — cancelling would strand their batches unpublished);
         # only force-cancel if the device never comes back
@@ -481,20 +497,107 @@ class TpuInferenceService(MultitenantService):
             self._inflight.release()
             return 0
 
-        scores_dev = scorer.step(ids, vals, valid)  # async dispatch
-        self._train_tick(family, scorer, engine_cfgs)
         taken = (
             np.concatenate(tk_slots),
             np.concatenate(tk_cols),
             np.concatenate(tk_seqs),
             np.concatenate(tk_rows),
         )
+        try:
+            scores_dev = scorer.step(ids, vals, valid)  # async dispatch
+        except Exception as exc:  # noqa: BLE001 - a failing scorer must
+            # not strand popped rows or kill the loop; repeated failures
+            # trigger shard failover
+            self._record_error("step", exc)
+            self._inflight.release()
+            await self._resolve_rows(taken[2], taken[3], None)
+            await self._note_scorer_error(family)
+            return moved
+        self._train_tick(family, scorer, engine_cfgs)
         task = asyncio.create_task(
-            self._deliver(scores_dev, taken), name=f"tpu-deliver-{family}"
+            self._deliver(scores_dev, taken, family), name=f"tpu-deliver-{family}"
         )
         self._deliver_tasks.add(task)
         task.add_done_callback(self._deliver_tasks.discard)
         return moved
+
+    # -- auto-failover ----------------------------------------------------
+    async def _note_scorer_error(self, family: str) -> None:
+        """Count consecutive scorer failures for a family; at the
+        threshold, every tenant of the family fails over to a DIFFERENT
+        mesh shard (reference analog: tenant engines restarting on another
+        replica after repeated probe failures [U])."""
+        n = self._consec_errors.get(family, 0) + 1
+        self._consec_errors[family] = n
+        if n < self.failover_threshold:
+            return
+        self._consec_errors[family] = 0
+        for tenant, engine in list(self.engines.items()):
+            if (
+                isinstance(engine, TpuInferenceEngine)
+                and engine.placement is not None
+                and engine.config.model == family
+            ):
+                await self._failover_tenant(engine)
+
+    async def _failover_tenant(self, engine: "TpuInferenceEngine") -> bool:
+        """Re-place one tenant onto another shard: carry its params (live
+        copy if the old shard still answers, else last checkpoint, else
+        pristine), wipe + free the old slot, re-key pending lanes. Stream
+        → data-shard assignments are placement-independent, so no rows and
+        no window routing are lost."""
+        from sitewhere_tpu.parallel.tenant_router import PlacementError
+        from sitewhere_tpu.runtime.checkpoint import host_copy_params
+
+        tenant = engine.tenant
+        family = engine.config.model
+        scorer = self.scorers.get(family)
+        if scorer is None:
+            return False
+        old_slot = self.router.global_slot(engine.placement)
+        params = None
+        try:  # live params may be unreachable on a sick shard
+            params = host_copy_params(scorer.slot_params(old_slot))
+        except Exception:  # noqa: BLE001
+            if self.checkpoints is not None:
+                try:
+                    params = await asyncio.get_running_loop().run_in_executor(
+                        None, self.checkpoints.load_params, tenant, family
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    self._record_error("failover-params", exc)
+        try:
+            new_p = self.router.failover(tenant)
+        except PlacementError as exc:
+            self._record_error("failover", exc)
+            return False
+        try:
+            scorer.reset_slot(old_slot)
+        except Exception as exc:  # noqa: BLE001 - the old shard may be dead
+            self._record_error("failover-reset", exc)
+        engine.placement = new_p
+        new_slot = self.router.global_slot(new_p)
+        scorer.activate(
+            new_slot, params=params,
+            trainable=engine.config.training.enabled,
+            lr=engine.config.training.lr,
+        )
+        # pending rows keyed by the old slot ride over to the new one
+        lanes = self._lanes.get(family, {})
+        for d in range(self.mm.n_data_shards):
+            lane = lanes.pop((old_slot, d), None)
+            if lane is not None and lane.count:
+                dst = lanes.get((new_slot, d))
+                if dst is None:
+                    lanes[(new_slot, d)] = lane
+                else:
+                    dst.ids += lane.ids
+                    dst.vals += lane.vals
+                    dst.seqs += lane.seqs
+                    dst.rows += lane.rows
+                    dst.count += lane.count
+        self.metrics.counter("tpu_inference.failovers").inc()
+        return True
 
     def _train_tick(
         self, family: str, scorer: ShardedScorer,
@@ -535,7 +638,7 @@ class TpuInferenceService(MultitenantService):
         self.metrics.counter("tpu_inference.train_steps").inc()
         return 1
 
-    async def _deliver(self, scores_dev, taken) -> None:
+    async def _deliver(self, scores_dev, taken, family: str = "") -> None:
         """Materialize one flush's scores off the loop and resolve rows.
 
         Worker-thread materialization is safe HERE because ``scores_dev``
@@ -548,6 +651,7 @@ class TpuInferenceService(MultitenantService):
             )
             slots, cols, seqs, rows = taken
             await self._resolve_rows(seqs, rows, scores_np[slots, cols])
+            self._consec_errors.pop(family, None)  # healthy again
         except asyncio.CancelledError:
             # cancelled mid-flight (forced teardown): the rows were already
             # popped from lanes, so resolve them unscored or they're lost
@@ -559,6 +663,8 @@ class TpuInferenceService(MultitenantService):
             self._record_error("deliver", exc)
             _, _, seqs, rows = taken
             await self._resolve_rows(seqs, rows, None)
+            if family:
+                await self._note_scorer_error(family)
         finally:
             self._inflight.release()
 

@@ -23,7 +23,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.lifecycle import (
+    LifecycleComponent,
+    LifecycleState,
+    cancel_and_wait,
+)
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.services.streaming_media import StreamingMedia
 
@@ -102,15 +106,26 @@ class MediaClassificationPipeline(LifecycleComponent):
 
     # -- lifecycle --------------------------------------------------------
     async def on_start(self) -> None:
-        # ensure the classifier (and its jit) exists before traffic
-        self.media._get_classifier(self.tiny)
+        # classifier init (86M params for real B/16) runs OFF the loop —
+        # a synchronous init would freeze every other tenant's pipeline
+        # for its duration
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.media._get_classifier, self.tiny
+        )
         self._task = asyncio.create_task(self._run(), name=self.name)
 
     async def on_stop(self) -> None:
         await cancel_and_wait(self._task)
         self._task = None
         if self._deliver_tasks:
-            await asyncio.gather(*self._deliver_tasks, return_exceptions=True)
+            # bounded grace, then force-cancel: an in-flight publish
+            # against a full topic whose consumer is already stopped
+            # would otherwise hang the whole stop cascade
+            _done, pending = await asyncio.wait(
+                list(self._deliver_tasks), timeout=5.0
+            )
+            for t in pending:
+                await cancel_and_wait(t)
 
     def prewarm(self) -> None:
         """Compile the classification batch shape before timed traffic."""
@@ -151,6 +166,17 @@ class MediaClassificationPipeline(LifecycleComponent):
     ) -> None:
         try:
             frames = np.stack([b[2] for b in batch])
+            # pad partial batches to the ONE compiled shape (XLA recompile
+            # avoidance — same playbook as the inference flush buckets);
+            # padded rows are sliced off the results
+            n = len(batch)
+            if n < self.max_batch:
+                frames = np.concatenate([
+                    frames,
+                    np.zeros(
+                        (self.max_batch - n,) + frames.shape[1:], frames.dtype
+                    ),
+                ])
             # jit dispatch + materialization off the loop (the classify
             # output is a jit result nothing donates — worker-thread
             # materialization is safe, see checkpoint.host_copy_params)
@@ -159,17 +185,21 @@ class MediaClassificationPipeline(LifecycleComponent):
             )
             now_mono = time.monotonic()
             now = time.time() * 1000.0
-            for (stream_id, seq, _f, t0), top in zip(batch, results):
-                await self.bus.publish(topic, {
+            for (stream_id, seq, _f, t0), top in zip(batch, results[:n]):
+                payload = {
                     "type": "media_classification",
                     "tenant": self.tenant,
                     "stream_id": stream_id,
                     "seq": seq,
                     "top_k": top,
                     "ts": now,
-                })
+                }
+                if self.state is LifecycleState.STARTED:
+                    await self.bus.publish(topic, payload)
+                else:  # teardown: the consumer may already be gone
+                    self.bus.publish_nowait(topic, payload)
                 lat.record(now_mono - t0)
-            frames_ctr.inc(len(batch))
+            frames_ctr.inc(n)
         except asyncio.CancelledError:
             raise
         except Exception as exc:  # noqa: BLE001 - one bad batch must not

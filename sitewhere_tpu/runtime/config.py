@@ -93,6 +93,10 @@ class InstanceConfig:
     # opt-in durability: per-tenant params on engine stop/start, bus
     # offsets+logs, device model + event stores under data_dir
     checkpointing: bool = False
+    # >0: a supervised autosave task checkpoints the live instance every
+    # interval (plus once inside stop()) — a hard kill loses at most one
+    # interval's worth of un-snapshotted state
+    checkpoint_interval_s: float = 0.0
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

@@ -1,0 +1,174 @@
+"""Automatic checkpointing: periodic autosave + checkpoint-on-stop, and
+the headline guarantee — a HARD-KILLED process (SIGKILL, no polite stop)
+restarts from the autosave with a loss window bounded by one interval and
+exactly-once persistence intact (VERDICT r2 item 7)."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+from sitewhere_tpu.services.event_store import EventQuery
+from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+_CHILD = r"""
+import asyncio, json, sys
+
+async def main():
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+    from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+    data_dir, progress_path = sys.argv[1], sys.argv[2]
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="hk", data_dir=data_dir, checkpointing=True,
+        checkpoint_interval_s=0.3,
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+    ))
+    await inst.start()
+    await inst.bootstrap(default_tenant="acme", dataset_devices=6)
+    for _ in range(200):
+        if "acme" in inst.tenants:
+            break
+        await asyncio.sleep(0.02)
+    sim = DeviceSimulator(
+        inst.broker, SimProfile(n_devices=6, seed=9),
+        topic_pattern="sitewhere/input/{device}",
+    )
+    persisted = inst.metrics.counter("event_management.persisted")
+    autosaves = inst.metrics.counter("instance.autosaves")
+    step = 0
+    while True:  # runs until SIGKILLed by the parent
+        await sim.publish_round(float(step))
+        step += 1
+        await asyncio.sleep(0.01)
+        with open(progress_path, "w") as fh:
+            json.dump({
+                "sent": sim.sent,
+                "persisted": int(persisted.value),
+                "autosaves": int(autosaves.value),
+            }, fh)
+
+asyncio.run(main())
+"""
+
+
+def test_hard_kill_recovers_within_one_autosave_interval(tmp_path):
+    data_dir = tmp_path / "data"
+    progress = tmp_path / "progress.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(data_dir), str(progress)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    history = []
+    try:
+        # wait until real progress AND at least two autosaves happened
+        deadline = time.time() + 120
+        snap = {}
+        while time.time() < deadline:
+            if child.poll() is not None:
+                raise AssertionError(
+                    f"child died early: {child.stderr.read().decode()[-800:]}"
+                )
+            if progress.exists():
+                try:
+                    snap = json.loads(progress.read_text())
+                    history.append(snap)
+                except ValueError:
+                    snap = {}
+                if snap.get("autosaves", 0) >= 2 and snap.get("persisted", 0) > 50:
+                    break
+            time.sleep(0.05)
+        assert snap.get("autosaves", 0) >= 2, f"no autosaves: {snap}"
+        os.kill(child.pid, signal.SIGKILL)  # the crash — no polite stop
+        child.wait(timeout=10)
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+    # the LAST autosave captured at least everything persisted while the
+    # autosave count was still lower — that's the recovery lower bound
+    final_saves = snap["autosaves"]
+    bound = max(
+        (h["persisted"] for h in history if h["autosaves"] < final_saves),
+        default=0,
+    )
+    assert bound > 0, f"no pre-autosave progress observed: {history[:3]}"
+
+    # restart from the autosaved checkpoint in THIS process
+    async def restore_and_check():
+        inst = SiteWhereInstance(InstanceConfig(
+            instance_id="hk", data_dir=str(data_dir), checkpointing=True,
+            mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+        ))
+        await inst.start()
+        try:
+            restored = await inst.restore()
+            assert restored == 1 and "acme" in inst.tenants
+            store = inst.tenants["acme"].event_store
+            # the bus backlog captured at the last autosave drains in;
+            # wait until the count is stable for a second
+            last, stable_since = -1, time.time()
+            for _ in range(400):
+                evs, total = store.list_measurements(EventQuery(page_size=10**6))
+                if total != last:
+                    last, stable_since = total, time.time()
+                elif time.time() - stable_since > 1.0 and total >= bound:
+                    break
+                await asyncio.sleep(0.05)
+            evs, total = store.list_measurements(EventQuery(page_size=10**6))
+            # loss bounded by one autosave interval: everything persisted
+            # BEFORE the last autosave is recovered
+            assert total >= bound, (total, bound, snap)
+            # exactly-once: no event persisted twice across the crash
+            assert len({e.id for e in evs}) == total
+        finally:
+            await inst.terminate()
+
+    asyncio.run(restore_and_check())
+
+
+async def test_stop_checkpoints_automatically(tmp_path):
+    cfg = InstanceConfig(
+        instance_id="cs", data_dir=str(tmp_path), checkpointing=True,
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+    )
+    inst = SiteWhereInstance(cfg)
+    await inst.start()
+    await inst.bootstrap(default_tenant="acme", dataset_devices=4)
+    for _ in range(100):
+        if "acme" in inst.tenants:
+            break
+        await asyncio.sleep(0.02)
+    sim = DeviceSimulator(
+        inst.broker, SimProfile(n_devices=4, seed=3),
+        topic_pattern="sitewhere/input/{device}",
+    )
+    for r in range(5):
+        await sim.publish_round(float(r))
+    persisted = inst.metrics.counter("event_management.persisted")
+    for _ in range(200):
+        if persisted.value >= sim.sent:
+            break
+        await asyncio.sleep(0.02)
+    # NO manual checkpoint() call — stop() must leave a usable snapshot
+    await inst.terminate()
+
+    inst2 = SiteWhereInstance(cfg)
+    await inst2.start()
+    try:
+        assert await inst2.restore() == 1
+        store = inst2.tenants["acme"].event_store
+        _, total = store.list_measurements(EventQuery(page_size=10**6))
+        assert total == sim.sent
+    finally:
+        await inst2.terminate()
