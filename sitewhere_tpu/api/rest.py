@@ -73,7 +73,10 @@ class RestApi:
 
     # -- auth ------------------------------------------------------------
     PUBLIC = {("POST", "/api/authapi/jwt"), ("GET", "/api/health"),
-              ("GET", "/metrics"), ("GET", "/api/openapi.json")}
+              ("GET", "/metrics"), ("GET", "/api/openapi.json"),
+              # device-facing ingest authenticates with the TENANT auth
+              # token (devices don't hold user JWTs) — see http_ingest
+              ("POST", "/api/input")}
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
@@ -116,6 +119,7 @@ class RestApi:
     def _routes(self) -> None:
         r = self.app.router
         r.add_post("/api/authapi/jwt", self.login)
+        r.add_post("/api/input", self.http_ingest)
         r.add_get("/api/health", self.health)
         r.add_get("/metrics", self.metrics)
         r.add_get("/api/openapi.json", self.openapi)
@@ -177,6 +181,36 @@ class RestApi:
         except AuthError as exc:
             return web.json_response({"error": str(exc)}, status=401)
         return web.json_response({"token": token})
+
+    async def http_ingest(self, request: web.Request) -> web.Response:
+        """HTTP transport termination (reference: HTTP/WebSocket event
+        receivers [U]): raw wire payload (the tenant's configured decoder
+        format — JSON or binary) enters the tenant's event source exactly
+        like an MQTT message. Devices authenticate with the TENANT auth
+        token, not a user JWT."""
+        import hmac as _hmac
+
+        tenant_token = request.headers.get("X-SiteWhere-Tenant", "default")
+        rt = self.instance.tenants.get(tenant_token)
+        tenant_rec = self.instance.tenant_management.get_tenant(tenant_token)
+        supplied = request.headers.get("X-SiteWhere-Tenant-Auth", "")
+        # uniform 401 whether the tenant is unknown or the secret is wrong
+        # (an unauthenticated public route must not enumerate tenants),
+        # constant-time compare on the device-facing secret
+        expected = tenant_rec.auth_token if tenant_rec is not None else ""
+        if (
+            rt is None
+            or tenant_rec is None
+            or not _hmac.compare_digest(supplied, expected)
+        ):
+            return web.json_response({"error": "unauthorized"}, status=401)
+        payload = await request.read()
+        if not payload:
+            return web.json_response({"error": "empty payload"}, status=400)
+        await rt.source.receiver.submit(
+            payload, topic=f"http/{tenant_token}/input"
+        )
+        return web.json_response({"accepted": True}, status=202)
 
     async def health(self, request) -> web.Response:
         return web.json_response(

@@ -1,0 +1,3 @@
+"""Communication/transport layer (reference: sitewhere-communication —
+MQTT/AMQP/CoAP transport helpers, SURVEY.md §2.1 [U]): real network
+protocol terminations for event sources and command destinations."""

@@ -99,6 +99,7 @@ class TenantRuntime:
     schedules: ScheduleManager
     broker_handler: object = None  # tenant input handler (for unsubscribe)
     media_pipeline: object = None  # MediaClassificationPipeline | None
+    mqtt_source: object = None     # EventSource over a real MQTT socket
 
     def components(self) -> List[LifecycleComponent]:
         out = [
@@ -108,6 +109,8 @@ class TenantRuntime:
         ]
         if self.media_pipeline is not None:
             out.append(self.media_pipeline)
+        if self.mqtt_source is not None:
+            out.append(self.mqtt_source)
         return out
 
 
@@ -231,6 +234,22 @@ class SiteWhereInstance(LifecycleComponent):
             ],
             self.metrics,
         )
+        mqtt_source = None
+        if cfg.mqtt_ingest:
+            from sitewhere_tpu.pipeline.sources import MqttReceiver
+
+            mq = dict(cfg.mqtt_ingest)
+            mqtt_source = EventSource(
+                f"mqtt-net[{tenant}]", tenant, self.bus,
+                MqttReceiver(
+                    f"mqtt-recv[{tenant}]",
+                    host=mq.get("host", "127.0.0.1"),
+                    port=int(mq.get("port", 1883)),
+                    topics=list(mq.get("topics", ["sitewhere/input/#"])),
+                    qos=int(mq.get("qos", 0)),
+                ),
+                cfg.decoder, self.metrics,
+            )
         media = StreamingMedia(tenant)
         media_pipe = None
         if cfg.media_pipeline:
@@ -248,6 +267,7 @@ class SiteWhereInstance(LifecycleComponent):
             labels=LabelGeneration(tenant),
             media=media,
             media_pipeline=media_pipe,
+            mqtt_source=mqtt_source,
             source=source,
             inbound=InboundProcessor(tenant, self.bus, dm, self.metrics),
             persistence=EventPersistence(tenant, self.bus, store, self.metrics),

@@ -245,6 +245,65 @@ class ShardedScorer:
     def slot_params(self, global_slot: int) -> Params:
         return unstack_slot(self.params, global_slot)
 
+    def rebuild_runtime(self) -> None:
+        """Recover from a poisoned device runtime: re-materialize params
+        host-side if they still answer (else pristine), allocate FRESH
+        window/opt state (the step donates its state buffer, so a failed
+        dispatch can leave ``self.state`` invalidated), and re-build the
+        jitted step. Window history is lost — it rebuilds from live
+        traffic; correctness (exactly-once, routing) is unaffected."""
+        import numpy as np
+
+        t_shard = self.mm.tenant_stacked()
+
+        def rematerialize(tree, fallback):
+            try:
+                host = jax.tree_util.tree_map(
+                    lambda x: np.array(x, copy=True), tree
+                )
+                return jax.device_put(host, t_shard)
+            except Exception:  # noqa: BLE001 - buffers may be dead
+                return fallback()
+
+        def pristine_params():
+            stacked = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(
+                    x[None], (self.n_slots,) + x.shape
+                ).copy(),
+                self._base_params,
+            )
+            return jax.device_put(stacked, t_shard)
+
+        self.params = rematerialize(self.params, pristine_params)
+        self.active = rematerialize(
+            self.active,
+            lambda: jax.device_put(jnp.zeros((self.n_slots,), bool), t_shard),
+        )
+        self.train_mask = rematerialize(
+            self.train_mask,
+            lambda: jax.device_put(jnp.zeros((self.n_slots,), bool), t_shard),
+        )
+        self.slot_lr = rematerialize(
+            self.slot_lr,
+            lambda: jax.device_put(
+                jnp.ones((self.n_slots,), jnp.float32), t_shard
+            ),
+        )
+        state = init_stacked_state(self.n_slots, self.max_streams, self.window)
+        st_sharding = self.mm.sharding(AXIS_TENANT, AXIS_DATA)
+        self.state = WindowState(
+            values=jax.device_put(state.values, st_sharding),
+            pos=jax.device_put(state.pos, st_sharding),
+            count=jax.device_put(state.count, st_sharding),
+        )
+        self._step = self._build_step()
+        if getattr(self, "_optimizer", None) is not None:
+            opt_state = jax.vmap(self._optimizer.init)(self.params)
+            self._opt_state = jax.device_put(opt_state, t_shard)
+            self._train = self._build_train_step(
+                self._optimizer, self._lr_sign
+            )
+
     # -- training (per-tenant divergence) --------------------------------
     def init_optimizer(self, optimizer=None) -> None:
         """Attach an optimizer; opt state is stacked per slot and sharded
@@ -267,6 +326,7 @@ class ShardedScorer:
         t_shard = self.mm.tenant_stacked()
         self._opt_state = jax.device_put(opt_state, t_shard)
         self._fresh_opt = optimizer.init(self._base_params)  # for reset_slot
+        self._lr_sign = lr_sign
         self._train = self._build_train_step(optimizer, lr_sign)
 
     def _build_train_step(self, optimizer, lr_sign: float = 1.0) -> Callable:

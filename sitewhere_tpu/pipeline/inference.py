@@ -197,6 +197,9 @@ class TpuInferenceEngine(TenantEngine):
             trainable=self.config.training.enabled,
             lr=self.config.training.lr,
         )
+        # a tenant lifecycle event is the unpark signal for its family
+        svc._parked.discard(self.config.model)
+        svc._failover_rounds.pop(self.config.model, None)
 
     async def on_stop(self) -> None:
         svc = self.service
@@ -276,6 +279,13 @@ class TpuInferenceService(MultitenantService):
         # mesh shard")
         self.failover_threshold = 3
         self._consec_errors: Dict[str, int] = {}
+        # escalation: failover rounds without an intervening healthy
+        # delivery; past max_failover_rounds the family PARKS — events
+        # flow through unscored (degraded, never lost) until a tenant
+        # lifecycle event clears it
+        self.max_failover_rounds = 3
+        self._failover_rounds: Dict[str, int] = {}
+        self._parked: set = set()
         self._inflight = asyncio.Semaphore(max_inflight)
         self._deliver_tasks: set = set()
         self.max_inflight = max_inflight
@@ -455,6 +465,18 @@ class TpuInferenceService(MultitenantService):
         and hand score materialization to a pipelined delivery task."""
         scorer = self.scorers[family]
         lanes = self._lanes[family]
+        if family in self._parked:
+            # degraded mode: resolve pending rows unscored so events keep
+            # flowing to persistence/rules while the scorer is parked
+            drained = 0
+            for key in list(lanes):
+                lane = lanes.pop(key)
+                if lane.count:
+                    _i, _v, seqs, rows = lane.pop(lane.count)
+                    await self._resolve_rows(seqs, rows, None)
+                    drained += len(seqs)
+            self._first_pending_ts.pop(family, None)
+            return drained
         if not any(l.count for l in lanes.values()):
             self._first_pending_ts.pop(family, None)
             return 0
@@ -513,7 +535,12 @@ class TpuInferenceService(MultitenantService):
             await self._resolve_rows(taken[2], taken[3], None)
             await self._note_scorer_error(family)
             return moved
-        self._train_tick(family, scorer, engine_cfgs)
+        try:
+            self._train_tick(family, scorer, engine_cfgs)
+        except Exception as exc:  # noqa: BLE001 - a training fault must not
+            # leak the inflight permit or strand the step's rows (the
+            # scoring step itself succeeded; delivery proceeds below)
+            self._record_error("train", exc)
         task = asyncio.create_task(
             self._deliver(scores_dev, taken, family), name=f"tpu-deliver-{family}"
         )
@@ -524,14 +551,43 @@ class TpuInferenceService(MultitenantService):
     # -- auto-failover ----------------------------------------------------
     async def _note_scorer_error(self, family: str) -> None:
         """Count consecutive scorer failures for a family; at the
-        threshold, every tenant of the family fails over to a DIFFERENT
-        mesh shard (reference analog: tenant engines restarting on another
-        replica after repeated probe failures [U])."""
+        threshold, rebuild the scorer runtime (a failed dispatch can
+        invalidate the donated state buffer) and fail every tenant of the
+        family over to a DIFFERENT mesh shard (reference analog: tenant
+        engines restarting on another replica after repeated probe
+        failures [U]). Repeated rounds without a healthy delivery PARK
+        the family: events pass through unscored rather than churning
+        failovers forever — degraded, never lost.
+
+        Scope note: within ONE process the scoring step is a single
+        shard_map over the whole mesh, so re-placement heals slot-level
+        poisoning; an entire dead device additionally needs the runtime
+        rebuild below, and if the fault persists the family parks. In a
+        multi-host deployment each host runs its own scorer over its mesh
+        slice, and re-placement moves tenants off the sick host."""
         n = self._consec_errors.get(family, 0) + 1
         self._consec_errors[family] = n
-        if n < self.failover_threshold:
+        if n < self.failover_threshold or family in self._parked:
             return
         self._consec_errors[family] = 0
+        rounds = self._failover_rounds.get(family, 0) + 1
+        self._failover_rounds[family] = rounds
+        if rounds > self.max_failover_rounds:
+            self._parked.add(family)
+            self._record_error(
+                "park", RuntimeError(
+                    f"family '{family}' parked after {rounds - 1} failover "
+                    f"rounds; events pass through unscored"
+                ),
+            )
+            self.metrics.counter("tpu_inference.parked").inc()
+            return
+        scorer = self.scorers.get(family)
+        if scorer is not None:
+            try:
+                scorer.rebuild_runtime()
+            except Exception as exc:  # noqa: BLE001 - device may be gone
+                self._record_error("rebuild", exc)
         for tenant, engine in list(self.engines.items()):
             if (
                 isinstance(engine, TpuInferenceEngine)
@@ -652,6 +708,7 @@ class TpuInferenceService(MultitenantService):
             slots, cols, seqs, rows = taken
             await self._resolve_rows(seqs, rows, scores_np[slots, cols])
             self._consec_errors.pop(family, None)  # healthy again
+            self._failover_rounds.pop(family, None)
         except asyncio.CancelledError:
             # cancelled mid-flight (forced teardown): the rows were already
             # popped from lanes, so resolve them unscored or they're lost

@@ -127,13 +127,27 @@ class MediaClassificationPipeline(LifecycleComponent):
             for t in pending:
                 await cancel_and_wait(t)
 
+    def _buckets(self) -> List[int]:
+        """Static batch-shape ladder (XLA recompile avoidance, same
+        playbook as the inference flush buckets): light traffic classifies
+        at the smallest fitting shape instead of paying a full max_batch
+        forward per frame."""
+        out = [1]
+        b = 4
+        while b < self.max_batch:
+            out.append(b)
+            b *= 4
+        out.append(self.max_batch)
+        return out
+
     def prewarm(self) -> None:
-        """Compile the classification batch shape before timed traffic."""
+        """Compile every bucket shape before timed traffic."""
         size = self.image_size
-        self.media.classify_frames(
-            np.zeros((self.max_batch, size, size, 3), np.uint8),
-            top_k=self.top_k, tiny=self.tiny,
-        )
+        for b in self._buckets():
+            self.media.classify_frames(
+                np.zeros((b, size, size, 3), np.uint8),
+                top_k=self.top_k, tiny=self.tiny,
+            )
 
     # -- batching loop ----------------------------------------------------
     async def _run(self) -> None:
@@ -166,16 +180,14 @@ class MediaClassificationPipeline(LifecycleComponent):
     ) -> None:
         try:
             frames = np.stack([b[2] for b in batch])
-            # pad partial batches to the ONE compiled shape (XLA recompile
-            # avoidance — same playbook as the inference flush buckets);
-            # padded rows are sliced off the results
+            # pad to the smallest fitting bucket shape; padded rows are
+            # sliced off the results
             n = len(batch)
-            if n < self.max_batch:
+            bucket = next(b for b in self._buckets() if b >= n)
+            if n < bucket:
                 frames = np.concatenate([
                     frames,
-                    np.zeros(
-                        (self.max_batch - n,) + frames.shape[1:], frames.dtype
-                    ),
+                    np.zeros((bucket - n,) + frames.shape[1:], frames.dtype),
                 ])
             # jit dispatch + materialization off the loop (the classify
             # output is a jit result nothing donates — worker-thread

@@ -9,9 +9,9 @@ Redesign: receivers push raw payloads into an asyncio queue; an
 ``EventSource`` drains the queue, decodes, dedups, and publishes request
 dicts to the tenant's decoded-events topic (failed decodes go to the
 failed-decode topic with the raw payload attached). Network receivers are
-pluggable; in this image the canonical receiver is the in-proc queue the
-MQTT simulator (``sim.devices``) feeds — a real paho-mqtt receiver slots in
-behind the same 3-method interface when a broker exists.
+pluggable: the in-proc queue the MQTT simulator (``sim.devices``) feeds,
+and ``MqttReceiver`` — a real-socket MQTT 3.1.1 subscriber built on the
+in-repo wire-protocol client (``comm.mqtt``).
 """
 
 from __future__ import annotations
@@ -53,42 +53,34 @@ class QueueReceiver(InboundReceiver):
 
 
 class MqttReceiver(InboundReceiver):
-    """MQTT receiver shell: connects via paho-mqtt when available; parked
-    in INITIALIZATION_ERROR otherwise (no broker/paho in this image)."""
+    """MQTT receiver over a REAL socket: connects to any MQTT 3.1.1
+    broker (external, or the in-repo ``comm.mqtt.MqttBroker``) with the
+    in-repo wire-protocol client — no third-party MQTT stack needed."""
 
     def __init__(self, name: str, host: str = "localhost", port: int = 1883,
-                 topics: Optional[List[str]] = None) -> None:
+                 topics: Optional[List[str]] = None, qos: int = 0) -> None:
         super().__init__(name)
         self.host, self.port = host, port
         self.topics = topics or ["sitewhere/input/#"]
+        self.qos = qos
         self._client = None
 
-    async def on_initialize(self) -> None:
-        try:
-            import paho.mqtt.client as mqtt  # type: ignore
-        except ImportError as exc:  # gated: not in this image
-            raise RuntimeError(
-                "paho-mqtt not installed; use QueueReceiver or the simulator"
-            ) from exc
-        loop = asyncio.get_running_loop()
-        client = mqtt.Client()
+    async def on_start(self) -> None:
+        from sitewhere_tpu.comm.mqtt import MqttClient
 
-        def on_message(_client, _userdata, msg):
-            loop.call_soon_threadsafe(
-                self.submit_nowait, msg.payload, topic=msg.topic
-            )
+        client = MqttClient(self.host, self.port, client_id=self.name)
+        await client.connect()
 
-        client.on_message = on_message
-        client.connect(self.host, self.port)
+        async def on_message(topic: str, payload: bytes) -> None:
+            await self.submit(payload, topic=topic)
+
         for t in self.topics:
-            client.subscribe(t)
-        client.loop_start()
+            await client.subscribe(t, on_message, qos=self.qos)
         self._client = client
 
     async def on_stop(self) -> None:
         if self._client is not None:
-            self._client.loop_stop()
-            self._client.disconnect()
+            await self._client.disconnect()
             self._client = None
 
 

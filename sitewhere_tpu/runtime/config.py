@@ -62,6 +62,9 @@ class TenantEngineConfig:
     # uses the test-sized ViT so CI exercises the full flow cheaply
     media_pipeline: bool = False
     media_tiny: bool = False
+    # real-socket MQTT ingest: {"host": ..., "port": ..., "topics": [...]}
+    # adds an MqttReceiver-backed event source beside the in-proc one
+    mqtt_ingest: Optional[Dict[str, Any]] = None
     # opt-in to the instance-shared 'sitewhere/input/+' broker pattern; the
     # tenant-scoped 'sitewhere/{tenant}/input/+' pattern is always active.
     # With >1 tenant and no flag, shared-input routes to NO tenant (isolation)

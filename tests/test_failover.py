@@ -161,3 +161,51 @@ async def test_supervised_scoring_loop_restarts_after_crash():
     finally:
         inst.inference.bus.consume = orig
         await inst.terminate()
+
+
+async def test_persistent_faults_park_family_but_events_still_flow():
+    """When failover can't heal (fault persists), the family parks and
+    events pass through UNSCORED — degraded, never lost."""
+    inst = await _instance()
+    try:
+        svc = inst.inference
+        scorer = svc.scorers["lstm_ad"]
+        scorer.fault_steps = 10**9  # permanent fault
+        sim = DeviceSimulator(
+            inst.broker, SimProfile(n_devices=6, seed=6, samples_per_message=5),
+            topic_pattern="sitewhere/input/{device}",
+        )
+        for r in range(40):
+            await sim.publish_round(float(r))
+            await asyncio.sleep(0.01)
+        parked = inst.metrics.counter("tpu_inference.parked")
+        for _ in range(400):
+            if parked.value >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert parked.value >= 1, "family never parked"
+        # events still flow end-to-end (unscored)
+        before = inst.metrics.counter("event_management.persisted").value
+        for r in range(5):
+            await sim.publish_round(100.0 + r)
+        persisted = inst.metrics.counter("event_management.persisted")
+        for _ in range(300):
+            if persisted.value >= sim.sent:
+                break
+            await asyncio.sleep(0.02)
+        assert persisted.value >= sim.sent, (persisted.value, sim.sent)
+        # tenant restart clears the fault (rebuild) and unparks
+        scorer.fault_steps = 0
+        await inst.restart_tenant("acme")
+        assert "lstm_ad" not in svc._parked
+        before = inst.metrics.counter("tpu_inference.scored_total").value
+        for r in range(5):
+            await sim.publish_round(200.0 + r)
+        scored = inst.metrics.counter("tpu_inference.scored_total")
+        for _ in range(300):
+            if scored.value - before >= 5 * 6 * 5:
+                break
+            await asyncio.sleep(0.02)
+        assert scored.value - before >= 5 * 6 * 5, "scoring did not resume"
+    finally:
+        await inst.terminate()

@@ -64,7 +64,14 @@ async def test_full_pipeline_scores_and_persists():
             break
         await asyncio.sleep(0.1)
     assert scored >= sim.sent * 0.9
-    evs, total = rt.event_store.list_measurements(EventQuery(page_size=5))
+    # scored counts at publish-time; persistence consumes asynchronously —
+    # poll the store too
+    total = 0
+    for _ in range(300):
+        evs, total = rt.event_store.list_measurements(EventQuery(page_size=5))
+        if total >= sim.sent * 0.9:
+            break
+        await asyncio.sleep(0.05)
     assert total >= sim.sent * 0.9
     assert evs[0].score is not None
     # device state rolled up
