@@ -76,7 +76,7 @@ class RestApi:
               ("GET", "/metrics"), ("GET", "/api/openapi.json"),
               # device-facing ingest authenticates with the TENANT auth
               # token (devices don't hold user JWTs) — see http_ingest
-              ("POST", "/api/input")}
+              ("POST", "/api/input"), ("GET", "/api/ws/input")}
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
@@ -120,6 +120,7 @@ class RestApi:
         r = self.app.router
         r.add_post("/api/authapi/jwt", self.login)
         r.add_post("/api/input", self.http_ingest)
+        r.add_get("/api/ws/input", self.ws_ingest)
         r.add_get("/api/health", self.health)
         r.add_get("/metrics", self.metrics)
         r.add_get("/api/openapi.json", self.openapi)
@@ -188,29 +189,49 @@ class RestApi:
         format — JSON or binary) enters the tenant's event source exactly
         like an MQTT message. Devices authenticate with the TENANT auth
         token, not a user JWT."""
-        import hmac as _hmac
-
-        tenant_token = request.headers.get("X-SiteWhere-Tenant", "default")
-        rt = self.instance.tenants.get(tenant_token)
-        tenant_rec = self.instance.tenant_management.get_tenant(tenant_token)
-        supplied = request.headers.get("X-SiteWhere-Tenant-Auth", "")
-        # uniform 401 whether the tenant is unknown or the secret is wrong
-        # (an unauthenticated public route must not enumerate tenants),
-        # constant-time compare on the device-facing secret
-        expected = tenant_rec.auth_token if tenant_rec is not None else ""
-        if (
-            rt is None
-            or tenant_rec is None
-            or not _hmac.compare_digest(supplied, expected)
-        ):
+        rt = self._authenticate_device(request)
+        if rt is None:
             return web.json_response({"error": "unauthorized"}, status=401)
         payload = await request.read()
         if not payload:
             return web.json_response({"error": "empty payload"}, status=400)
         await rt.source.receiver.submit(
-            payload, topic=f"http/{tenant_token}/input"
+            payload, topic=f"http/{rt.tenant}/input"
         )
         return web.json_response({"accepted": True}, status=202)
+
+    def _authenticate_device(self, request: web.Request):
+        """Header adapter over the ONE device-facing auth check
+        (SiteWhereInstance.authenticate_device — shared with CoAP)."""
+        return self.instance.authenticate_device(
+            request.headers.get("X-SiteWhere-Tenant", "default"),
+            request.headers.get("X-SiteWhere-Tenant-Auth", ""),
+        )
+
+    async def ws_ingest(self, request: web.Request) -> web.StreamResponse:
+        """WebSocket transport termination (reference: WebSocket event
+        receivers in service-event-sources [U]): each binary/text frame is
+        one wire payload for the tenant's decoder, exactly like an MQTT
+        message; the socket stays open for the device's session."""
+        rt = self._authenticate_device(request)
+        if rt is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        ws = web.WebSocketResponse(heartbeat=30.0)
+        await ws.prepare(request)
+        tenant = rt.tenant
+        frames = self.instance.metrics.counter("ingest.ws_frames")
+        async for msg in ws:
+            if msg.type == web.WSMsgType.BINARY:
+                payload = msg.data
+            elif msg.type == web.WSMsgType.TEXT:
+                payload = msg.data.encode()
+            else:
+                continue
+            await rt.source.receiver.submit(
+                payload, topic=f"ws/{tenant}/input"
+            )
+            frames.inc()
+        return ws
 
     async def health(self, request) -> web.Response:
         return web.json_response(

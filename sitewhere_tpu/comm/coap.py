@@ -1,0 +1,240 @@
+"""CoAP (RFC 7252) over UDP: ingest server + minimal client.
+
+Capability parity with the reference's CoAP transport (Californium-based
+receivers in service-event-sources — SURVEY.md §2.2 [U]; reference mount
+empty, see provenance banner). This image ships no CoAP stack, so the
+wire format is implemented here: 4-byte header (version/type/TKL, code,
+message id), token, delta-encoded options, 0xFF payload marker.
+
+Scope: CON/NON requests with piggybacked ACK responses — the
+constrained-device telemetry POST pattern. Blockwise transfer, observe,
+and DTLS are out of scope (the reference's CoAP usage is the same simple
+request/response ingest).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, List, Optional, Tuple
+
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+# message types
+CON, NON, ACK, RST = 0, 1, 2, 3
+# method / response codes (class.detail → byte)
+POST = 0x02
+CREATED_201 = 0x41       # 2.01
+CHANGED_204 = 0x44       # 2.04
+BAD_REQUEST_400 = 0x80   # 4.00
+UNAUTHORIZED_401 = 0x81  # 4.01
+NOT_FOUND_404 = 0x84     # 4.04
+OPT_URI_PATH = 11
+OPT_URI_QUERY = 15
+
+
+def encode_message(
+    mtype: int,
+    code: int,
+    message_id: int,
+    token: bytes = b"",
+    options: Optional[List[Tuple[int, bytes]]] = None,
+    payload: bytes = b"",
+) -> bytes:
+    out = bytearray()
+    out.append((1 << 6) | (mtype << 4) | len(token))
+    out.append(code)
+    out += message_id.to_bytes(2, "big")
+    out += token
+    prev = 0
+    for num, val in sorted(options or []):
+        delta = num - prev
+        prev = num
+
+        def nibble(n: int) -> Tuple[int, bytes]:
+            if n < 13:
+                return n, b""
+            if n < 269:
+                return 13, bytes([n - 13])
+            return 14, (n - 269).to_bytes(2, "big")
+
+        dn, dext = nibble(delta)
+        ln, lext = nibble(len(val))
+        out.append((dn << 4) | ln)
+        out += dext + lext + val
+    if payload:
+        out.append(0xFF)
+        out += payload
+    return bytes(out)
+
+
+def decode_message(data: bytes) -> dict:
+    if len(data) < 4 or (data[0] >> 6) != 1:
+        raise ValueError("not a CoAP 1.0 message")
+    mtype = (data[0] >> 4) & 0x3
+    tkl = data[0] & 0x0F
+    code = data[1]
+    mid = int.from_bytes(data[2:4], "big")
+    off = 4
+    token = data[off:off + tkl]
+    off += tkl
+    options: List[Tuple[int, bytes]] = []
+    num = 0
+    while off < len(data) and data[off] != 0xFF:
+        b = data[off]
+        off += 1
+        dn, ln = b >> 4, b & 0x0F
+
+        def ext(n: int) -> int:
+            nonlocal off
+            if n == 13:
+                v = data[off] + 13
+                off += 1
+                return v
+            if n == 14:
+                v = int.from_bytes(data[off:off + 2], "big") + 269
+                off += 2
+                return v
+            if n == 15:
+                raise ValueError("reserved option nibble")
+            return n
+
+        num += ext(dn)
+        length = ext(ln)
+        options.append((num, data[off:off + length]))
+        off += length
+    payload = b""
+    if off < len(data) and data[off] == 0xFF:
+        payload = data[off + 1:]
+    return {
+        "type": mtype, "code": code, "message_id": mid,
+        "token": token, "options": options, "payload": payload,
+    }
+
+
+def uri_path(options: List[Tuple[int, bytes]]) -> str:
+    return "/".join(
+        v.decode() for n, v in options if n == OPT_URI_PATH
+    )
+
+
+def uri_queries(options: List[Tuple[int, bytes]]) -> dict:
+    out = {}
+    for n, v in options:
+        if n == OPT_URI_QUERY:
+            k, _, val = v.decode().partition("=")
+            out[k] = val
+    return out
+
+
+class CoapIngestServer(LifecycleComponent):
+    """UDP CoAP endpoint: ``POST /input?tenant=...&auth=...`` with a wire
+    payload body → the submit callback (the event-source insertion
+    point). CON requests get a piggybacked ACK."""
+
+    def __init__(
+        self,
+        submit: Callable,        # async (tenant, payload, context) -> bool
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        super().__init__("coap-ingest")
+        self._submit = submit
+        self.host, self.port = host, port
+        self.bound_port: Optional[int] = None
+        self._transport = None
+
+    async def on_start(self) -> None:
+        loop = asyncio.get_running_loop()
+        server = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                asyncio.ensure_future(server._handle(data, addr, self.transport))
+
+        self._transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=(self.host, self.port)
+        )
+        self.bound_port = self._transport.get_extra_info("sockname")[1]
+
+    async def on_stop(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    async def _handle(self, data: bytes, addr, transport) -> None:
+        try:
+            msg = decode_message(data)
+        except (ValueError, IndexError):
+            # not CoAP, or truncated options/extension bytes — UDP is
+            # spoofable, so malformed datagrams drop silently
+            return
+        if msg["code"] != POST or uri_path(msg["options"]) != "input":
+            code = NOT_FOUND_404
+        else:
+            q = uri_queries(msg["options"])
+            try:
+                ok = await self._submit(
+                    q.get("tenant", "default"), msg["payload"],
+                    {"auth": q.get("auth", ""), "addr": str(addr)},
+                )
+                code = CHANGED_204 if ok else UNAUTHORIZED_401
+            except Exception as exc:  # noqa: BLE001 - a bad datagram must
+                # not kill the endpoint
+                self._record_error("submit", exc)
+                code = BAD_REQUEST_400
+        if msg["type"] == CON:  # piggybacked ACK
+            transport.sendto(
+                encode_message(ACK, code, msg["message_id"], msg["token"]),
+                addr,
+            )
+
+
+class CoapClient:
+    """Minimal CON/POST client (device side + tests)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._mid = 0
+
+    async def post(
+        self, path: str, payload: bytes, queries: Optional[dict] = None,
+        timeout_s: float = 5.0,
+    ) -> int:
+        """POST; returns the response code byte (e.g. 0x44 = 2.04)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._mid = (self._mid + 1) & 0xFFFF
+        mid = self._mid
+
+        class _Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                try:
+                    msg = decode_message(data)
+                except ValueError:
+                    return
+                if msg["message_id"] == mid and not fut.done():
+                    fut.set_result(msg["code"])
+
+        options = [
+            (OPT_URI_PATH, seg.encode())
+            for seg in path.strip("/").split("/")
+        ] + [
+            (OPT_URI_QUERY, f"{k}={v}".encode())
+            for k, v in (queries or {}).items()
+        ]
+        transport, _ = await loop.create_datagram_endpoint(
+            _Proto, remote_addr=(self.host, self.port)
+        )
+        try:
+            transport.sendto(
+                encode_message(CON, POST, mid, b"\x01", options, payload)
+            )
+            return await asyncio.wait_for(fut, timeout_s)
+        finally:
+            transport.close()

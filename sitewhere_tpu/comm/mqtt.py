@@ -4,15 +4,17 @@ Capability parity with the reference's MQTT transport (Paho/fuse client
 against HiveMQ/ActiveMQ brokers — SURVEY.md §2.2 event-sources [U];
 reference mount empty, see provenance banner). This image ships no MQTT
 stack at all, so both ends are implemented here against the MQTT 3.1.1
-spec: CONNECT/CONNACK, PUBLISH (QoS 0/1 with PUBACK),
-SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT,
-standard fixed header with varint remaining-length, UTF-8 topics, and
-``+``/``#`` filter matching. A conformant external client (e.g. paho)
-can talk to the broker; the client can talk to an external broker.
+spec: CONNECT/CONNACK, PUBLISH (publisher QoS 0/1 — QoS 1 gets a
+PUBACK), SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP,
+DISCONNECT, standard fixed header with varint remaining-length, UTF-8
+topics, and ``+``/``#`` filter matching. A conformant external client
+(e.g. paho) can talk to the broker; the client can talk to an external
+broker.
 
-Scope notes: QoS 2, retained messages, sessions, and wills are not
-implemented (the platform's ingest/command paths use QoS 0/1 fire-and-
-acknowledge semantics).
+Scope notes: subscriber-side delivery is QoS 0 (SUBACK grants 0
+accordingly); QoS 2, retained messages, sessions, and wills are not
+implemented (the platform's ingest/command paths use QoS 0/1
+fire-and-acknowledge semantics).
 """
 
 from __future__ import annotations
@@ -170,9 +172,12 @@ class MqttBroker(LifecycleComponent):
                     codes = bytearray()
                     while b.off < len(b.data):
                         filt = b.utf8()
-                        qos = b.u8()
+                        b.u8()  # requested qos
                         subs.append(filt)
-                        codes.append(min(qos, 1))
+                        # fan-out delivery is QoS 0, so GRANT QoS 0 — a
+                        # conformant subscriber must not be promised
+                        # at-least-once the broker won't provide
+                        codes.append(0)
                     async with lock:
                         writer.write(packet(
                             SUBACK, 0, pid.to_bytes(2, "big") + bytes(codes)
@@ -211,14 +216,17 @@ class MqttBroker(LifecycleComponent):
             async with src_lock:
                 src_writer.write(packet(PUBACK, 0, pid.to_bytes(2, "big")))
                 await src_writer.drain()
-        # fan out (QoS 0 delivery) to every matching subscription
+        # fan out (QoS 0 delivery) to every matching subscription.
+        # write WITHOUT awaiting drain: one stalled subscriber must not
+        # block delivery to the others (or freeze the publisher's read
+        # loop); asyncio buffers the bytes, and a closed transport skips
         out = packet(PUBLISH, 0, _utf8(topic) + payload)
-        for subs, writer, lock in list(self._entries.values()):
+        for subs, writer, _lock in list(self._entries.values()):
             if any(topic_matches(f, topic) for f in subs):
+                if writer.transport is None or writer.transport.is_closing():
+                    continue
                 try:
-                    async with lock:
-                        writer.write(out)
-                        await writer.drain()
+                    writer.write(out)
                     self.messages_routed += 1
                 except (ConnectionResetError, RuntimeError):
                     continue
@@ -316,8 +324,20 @@ class MqttClient:
                         await self._writer.drain()
                     for filt, handler in list(self._handlers):
                         if topic_matches(filt, topic):
-                            await handler(topic, payload)
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+                            try:
+                                await handler(topic, payload)
+                            except asyncio.CancelledError:
+                                raise
+                            except Exception:  # noqa: BLE001 - one bad
+                                # handler call must not kill the read loop
+                                # (the client would stay connected but
+                                # deaf forever)
+                                continue
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 - connection loss OR a malformed
+            # packet (bad varint / invalid UTF-8 topic): either way the
+            # session is over — fail every waiter instead of hanging them
             for fut in self._acks.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("mqtt connection lost"))

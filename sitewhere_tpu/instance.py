@@ -150,6 +150,14 @@ class SiteWhereInstance(LifecycleComponent):
         )
         self.add_child(self.inference)
         self.tenants: Dict[str, TenantRuntime] = {}
+        self.coap: object = None
+        if cfg.coap_ingest_port is not None:
+            from sitewhere_tpu.comm.coap import CoapIngestServer
+
+            self.coap = CoapIngestServer(
+                self._coap_submit, port=cfg.coap_ingest_port
+            )
+            self.add_child(self.coap)
         self._updates_task: Optional[asyncio.Task] = None
         self._autosave_task: Optional[asyncio.Task] = None
         # ONE instance-level subscription for the shared input pattern; it
@@ -158,6 +166,30 @@ class SiteWhereInstance(LifecycleComponent):
         # nowhere: the shared pattern must never fan one device's telemetry
         # into every tenant (tenant isolation).
         self.broker.subscribe("sitewhere/input/+", self._on_shared_input)
+
+    def authenticate_device(self, tenant_token: str, supplied_auth: str):
+        """THE device-facing auth check, shared by every transport
+        (HTTP/WS via RestApi, CoAP here, future receivers): tenant token
+        + tenant auth secret → TenantRuntime or None. Constant-time
+        compare; callers answer uniformly on None so no transport can
+        enumerate tenants."""
+        import hmac
+
+        rt = self.tenants.get(tenant_token)
+        rec = self.tenant_management.get_tenant(tenant_token)
+        expected = rec.auth_token if rec is not None else ""
+        if rt is None or rec is None or not hmac.compare_digest(
+            supplied_auth, expected
+        ):
+            return None
+        return rt
+
+    async def _coap_submit(self, tenant: str, payload: bytes, ctx: dict) -> bool:
+        rt = self.authenticate_device(tenant, ctx.get("auth", ""))
+        if rt is None:
+            return False
+        await rt.source.receiver.submit(payload, topic=f"coap/{tenant}/input")
+        return True
 
     async def _on_shared_input(self, topic: str, payload: bytes) -> None:
         targets = [
@@ -245,7 +277,12 @@ class SiteWhereInstance(LifecycleComponent):
                     f"mqtt-recv[{tenant}]",
                     host=mq.get("host", "127.0.0.1"),
                     port=int(mq.get("port", 1883)),
-                    topics=list(mq.get("topics", ["sitewhere/input/#"])),
+                    # default is TENANT-SCOPED: subscribing every tenant
+                    # to the shared 'sitewhere/input/#' would fan one
+                    # device's telemetry into every tenant (isolation)
+                    topics=list(mq.get(
+                        "topics", [f"sitewhere/{tenant}/input/#"]
+                    )),
                     qos=int(mq.get("qos", 0)),
                 ),
                 cfg.decoder, self.metrics,

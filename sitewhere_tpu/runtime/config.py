@@ -100,6 +100,9 @@ class InstanceConfig:
     # interval (plus once inside stop()) — a hard kill loses at most one
     # interval's worth of un-snapshotted state
     checkpoint_interval_s: float = 0.0
+    # instance-level CoAP/UDP ingest endpoint (None = off; 0 = ephemeral
+    # port). Devices POST /input?tenant=...&auth=... with a wire payload
+    coap_ingest_port: Optional[int] = None
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

@@ -1,0 +1,115 @@
+"""Transport breadth: WebSocket and CoAP ingest from real sockets into
+the full pipeline (reference: WebSocket + CoAP receivers in
+service-event-sources, SURVEY.md §2.2)."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from sitewhere_tpu.api.rest import make_app
+from sitewhere_tpu.comm.coap import (
+    CHANGED_204,
+    UNAUTHORIZED_401,
+    CoapClient,
+    decode_message,
+    encode_message,
+)
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+
+
+async def _instance(**cfg):
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="tr",
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+        **cfg,
+    ))
+    await inst.start()
+    await inst.bootstrap(default_tenant="default", dataset_devices=3)
+    for _ in range(100):
+        if "default" in inst.tenants:
+            break
+        await asyncio.sleep(0.02)
+    return inst
+
+
+def _measurement(i=0):
+    return json.dumps({
+        "type": "measurement", "device_token": "dev-00000",
+        "name": "temperature", "value": 20.0 + i,
+    }).encode()
+
+
+def test_coap_codec_round_trip():
+    msg = encode_message(
+        0, 0x02, 1234, b"\xab",
+        [(11, b"input"), (15, b"tenant=acme"), (15, b"auth=x")],
+        b"payload",
+    )
+    d = decode_message(msg)
+    assert d["type"] == 0 and d["code"] == 0x02 and d["message_id"] == 1234
+    assert d["token"] == b"\xab" and d["payload"] == b"payload"
+    assert (11, b"input") in d["options"]
+    assert (15, b"tenant=acme") in d["options"]
+
+
+async def test_websocket_ingest_flows_through_pipeline():
+    inst = await _instance()
+    try:
+        auth = inst.tenant_management.get_tenant("default").auth_token
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            # bad auth → 401 before upgrade
+            resp = await client.get(
+                "/api/ws/input",
+                headers={"X-SiteWhere-Tenant": "default",
+                         "X-SiteWhere-Tenant-Auth": "wrong"},
+            )
+            assert resp.status == 401
+            ws = await client.ws_connect(
+                "/api/ws/input",
+                headers={"X-SiteWhere-Tenant": "default",
+                         "X-SiteWhere-Tenant-Auth": auth},
+            )
+            for i in range(8):
+                await ws.send_bytes(_measurement(i))
+            persisted = inst.metrics.counter("event_management.persisted")
+            for _ in range(300):
+                if persisted.value >= 8:
+                    break
+                await asyncio.sleep(0.02)
+            assert persisted.value >= 8
+            await ws.close()
+        finally:
+            await client.close()
+    finally:
+        await inst.terminate()
+
+
+async def test_coap_ingest_flows_through_pipeline():
+    inst = await _instance(coap_ingest_port=0)
+    try:
+        auth = inst.tenant_management.get_tenant("default").auth_token
+        port = inst.coap.bound_port
+        client = CoapClient("127.0.0.1", port)
+        # wrong auth → 4.01
+        code = await client.post(
+            "input", _measurement(), {"tenant": "default", "auth": "bad"}
+        )
+        assert code == UNAUTHORIZED_401
+        for i in range(6):
+            code = await client.post(
+                "input", _measurement(i),
+                {"tenant": "default", "auth": auth},
+            )
+            assert code == CHANGED_204
+        persisted = inst.metrics.counter("event_management.persisted")
+        for _ in range(300):
+            if persisted.value >= 6:
+                break
+            await asyncio.sleep(0.02)
+        assert persisted.value >= 6
+    finally:
+        await inst.terminate()
