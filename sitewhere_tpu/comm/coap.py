@@ -171,10 +171,17 @@ class CoapIngestServer(LifecycleComponent):
             # not CoAP, or truncated options/extension bytes — UDP is
             # spoofable, so malformed datagrams drop silently
             return
-        if msg["code"] != POST or uri_path(msg["options"]) != "input":
+        try:
+            path = uri_path(msg["options"])
+        except UnicodeDecodeError:
+            return  # malformed option bytes: drop silently like bad frames
+        if msg["code"] != POST or path != "input":
             code = NOT_FOUND_404
         else:
-            q = uri_queries(msg["options"])
+            try:
+                q = uri_queries(msg["options"])
+            except UnicodeDecodeError:
+                return
             try:
                 ok = await self._submit(
                     q.get("tenant", "default"), msg["payload"],

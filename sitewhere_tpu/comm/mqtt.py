@@ -124,6 +124,9 @@ class MqttBroker(LifecycleComponent):
         # live connections: id → (subscription filters, writer, write lock)
         self._entries: Dict[int, tuple] = {}
         self.messages_routed = 0
+        self.messages_shed = 0  # dropped for slow consumers (buffer cap)
+
+    MAX_BUFFERED = 1 << 20  # 1 MiB of un-flushed bytes per subscriber
 
     async def on_start(self) -> None:
         self._server = await asyncio.start_server(
@@ -223,7 +226,14 @@ class MqttBroker(LifecycleComponent):
         out = packet(PUBLISH, 0, _utf8(topic) + payload)
         for subs, writer, _lock in list(self._entries.values()):
             if any(topic_matches(f, topic) for f in subs):
-                if writer.transport is None or writer.transport.is_closing():
+                transport = writer.transport
+                if transport is None or transport.is_closing():
+                    continue
+                # bounded buffering replaces drain-backpressure: a slow
+                # consumer sheds messages (QoS 0 permits loss) instead of
+                # growing broker memory without limit
+                if transport.get_write_buffer_size() > self.MAX_BUFFERED:
+                    self.messages_shed += 1
                     continue
                 try:
                     writer.write(out)

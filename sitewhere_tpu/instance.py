@@ -178,8 +178,10 @@ class SiteWhereInstance(LifecycleComponent):
         rt = self.tenants.get(tenant_token)
         rec = self.tenant_management.get_tenant(tenant_token)
         expected = rec.auth_token if rec is not None else ""
+        # compare BYTES: compare_digest on str raises TypeError for
+        # non-ASCII input, which would turn a bad credential into a 500
         if rt is None or rec is None or not hmac.compare_digest(
-            supplied_auth, expected
+            supplied_auth.encode(), expected.encode()
         ):
             return None
         return rt

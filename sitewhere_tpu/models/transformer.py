@@ -71,6 +71,103 @@ def _backbone(params: Params, normed: jnp.ndarray, cfg) -> jnp.ndarray:
     return layernorm(params["ln_f"], x)
 
 
+# -- sequence-parallel long-context path ----------------------------------
+
+def _backbone_local(params: Params, normed_local, cfg, axis_name: str):
+    """Per-device body of the sequence-sharded backbone: token-local ops
+    (embed/LN/MLP/projections) run on the local block; only attention
+    mixes across devices, via ring attention (``ops.ring_attention``)."""
+    from jax import lax
+
+    from sitewhere_tpu.models.common import dense, layernorm, mlp
+    from sitewhere_tpu.ops.ring_attention import ring_attention_local
+
+    dtype = cfg.compute_dtype
+    tl = normed_local.shape[1]
+    idx = lax.axis_index(axis_name)
+    x = dense(params["embed"], normed_local[..., None].astype(dtype), dtype)
+    pos = lax.dynamic_slice_in_dim(params["pos"], idx * tl, tl, 0)
+    x = x + pos.astype(dtype)[None]
+    heads = cfg.heads
+    for blk in params["blocks"]:
+        h = layernorm(blk["ln1"], x)
+        d = h.shape[-1]
+        hd = d // heads
+
+        def split(a):
+            return a.reshape(*a.shape[:-1], heads, hd)
+
+        ap = blk["attn"]
+        q = split(dense(ap["wq"], h, dtype)).astype(jnp.float32)
+        k = split(dense(ap["wk"], h, dtype)).astype(jnp.float32)
+        v = split(dense(ap["wv"], h, dtype)).astype(jnp.float32)
+        attn = ring_attention_local(q, k, v, axis_name, causal=True)
+        attn = attn.reshape(*attn.shape[:-2], d).astype(dtype)
+        x = x + dense(ap["wo"], attn, dtype)
+        x = x + mlp(blk["mlp"], layernorm(blk["ln2"], x), dtype=dtype)
+    return layernorm(params["ln_f"], x)
+
+
+def backbone_sharded(
+    params: Params,
+    cfg: TransformerForecasterConfig,
+    normed: jnp.ndarray,   # f32[B, T] — T divisible by the axis size
+    mesh,
+    axis_name: str = "data",
+) -> jnp.ndarray:
+    """Sequence-parallel backbone: the context shards over ``axis_name``
+    (each device holds T/n tokens + the full params), attention runs as a
+    ring, and features come back sharded the same way. Numerically
+    identical to ``_backbone`` — the long-context escape hatch when a
+    history exceeds one chip (SURVEY.md §5)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    t = normed.shape[1]
+    n = mesh.shape[axis_name]
+    if t > cfg.context:
+        # fail loudly: dynamic_slice would silently CLAMP the positional
+        # slice for trailing shards (wrong features, no error)
+        raise ValueError(
+            f"context {t} exceeds cfg.context {cfg.context}; truncate first"
+        )
+    if t % n:
+        raise ValueError(
+            f"context {t} must divide across {n} '{axis_name}' shards"
+        )
+
+    fn = jax.shard_map(
+        partial(_backbone_local, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=P(None, axis_name, None),
+    )
+    return fn(params, normed)
+
+
+def forecast_seed_sharded(
+    params: Params,
+    cfg: TransformerForecasterConfig,
+    windows: jnp.ndarray,   # f32[B, T] raw history (long)
+    mesh,
+    axis_name: str = "data",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(mu, sigma) for the NEXT step after a long sharded context, in
+    RAW units — the forecast seed distribution computed without ever
+    materializing the full context on one device."""
+    windows = windows[:, -cfg.context:]  # same guard as forecast()
+    normed, mu_n, sigma_n = normalize_windows(windows)
+    feats = backbone_sharded(params, cfg, normed, mesh, axis_name)
+    mu, sigma = _emit(params, feats[:, -1:], cfg)
+    # back to raw units (the model works in normalized space);
+    # normalize_windows returns [B, 1] stats
+    return (
+        mu[:, 0] * sigma_n[:, 0] + mu_n[:, 0],
+        sigma[:, 0] * sigma_n[:, 0],
+    )
+
+
 def _emit(params: Params, feats: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     out = dense(params["head"], feats, cfg.compute_dtype).astype(jnp.float32)
     mu = out[..., 0]
