@@ -58,6 +58,27 @@ def mha_init(key, dim: int, heads: int) -> Params:
     }
 
 
+def attn_core(
+    q: jnp.ndarray,   # [..., T, H, hd]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool,
+    dtype,
+) -> jnp.ndarray:
+    """THE attention math (scaled QK^T, optional causal mask, f32
+    softmax, AV) — shared by the single-device and tensor-parallel
+    blocks so their numerics can't diverge. Returns [..., T, H*hd]."""
+    t, hd = q.shape[-3], q.shape[-1]
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    attn = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    out = jnp.einsum("...hqk,...khd->...qhd", attn, v)
+    return out.reshape(*out.shape[:-2], out.shape[-2] * out.shape[-1])
+
+
 def mha(
     p: Params,
     x: jnp.ndarray,                      # [..., T, D]
@@ -66,7 +87,7 @@ def mha(
     dtype=jnp.bfloat16,
 ) -> jnp.ndarray:
     """Multi-head self-attention. Softmax in f32; QK^T/AV are MXU matmuls."""
-    t, d = x.shape[-2], x.shape[-1]
+    d = x.shape[-1]
     hd = d // heads
 
     def split(a):
@@ -75,15 +96,7 @@ def mha(
     q = split(dense(p["wq"], x, dtype))
     k = split(dense(p["wk"], x, dtype))
     v = split(dense(p["wv"], x, dtype))
-    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
-    logits = logits / math.sqrt(hd)
-    if causal:
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        logits = jnp.where(mask, logits, -1e30)
-    attn = jax.nn.softmax(logits, axis=-1).astype(dtype)
-    out = jnp.einsum("...hqk,...khd->...qhd", attn, v)
-    out = out.reshape(*out.shape[:-2], d)
-    return dense(p["wo"], out, dtype)
+    return dense(p["wo"], attn_core(q, k, v, causal, dtype), dtype)
 
 
 def mlp_init(key, dim: int, hidden: int) -> Params:
@@ -141,3 +154,94 @@ def normalize_windows(windows: jnp.ndarray, eps: float = 1e-6):
 
 def param_count(params: Params) -> int:
     return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+
+
+# -- tensor parallelism (Megatron-style, over the mesh 'model' axis) -------
+#
+# Column-parallel Q/K/V and fc1 (each device owns heads/n heads and
+# hidden/n MLP units), row-parallel wo and fc2 with ONE psum each — two
+# collectives per block, the standard TP recipe, expressed with shard_map
+# over a named axis so it composes with the tenant/data axes
+# (SURVEY.md §2 parallelism census: "pjit/shard_map for intra-model
+# parallelism of the larger models").
+
+def shard_block_params_tp(blk: Params, n: int, idx: int) -> Params:
+    """Slice one transformer block's params for TP rank ``idx`` of ``n``.
+
+    Column-parallel weights split on the OUTPUT dim (wq/wk/wv, fc1 — and
+    their biases); row-parallel weights split on the INPUT dim (wo, fc2 —
+    bias kept whole, added once after the psum on rank 0's addend).
+
+    Every split dimension must divide by ``n`` — silent truncation would
+    be silently-wrong outputs."""
+    dim = blk["attn"]["wq"]["w"].shape[1]
+    hidden = blk["mlp"]["fc1"]["w"].shape[1]
+    if dim % n or hidden % n:
+        raise ValueError(
+            f"TP degree {n} must divide model dim {dim} and MLP hidden "
+            f"{hidden}"
+        )
+
+    def col(p):
+        w, b = p["w"], p["b"]
+        o = w.shape[1] // n
+        return {"w": w[:, idx * o:(idx + 1) * o], "b": b[idx * o:(idx + 1) * o]}
+
+    def row(p):
+        w, b = p["w"], p["b"]
+        i = w.shape[0] // n
+        # bias must be added exactly once across the psum: zero it on
+        # every rank but 0
+        bias = jnp.where(idx == 0, b, jnp.zeros_like(b))
+        return {"w": w[idx * i:(idx + 1) * i], "b": bias}
+
+    return {
+        "ln1": blk["ln1"],
+        "ln2": blk["ln2"],
+        "attn": {
+            "wq": col(blk["attn"]["wq"]),
+            "wk": col(blk["attn"]["wk"]),
+            "wv": col(blk["attn"]["wv"]),
+            "wo": row(blk["attn"]["wo"]),
+        },
+        "mlp": {
+            "fc1": col(blk["mlp"]["fc1"]),
+            "fc2": row(blk["mlp"]["fc2"]),
+        },
+    }
+
+
+def transformer_block_tp(
+    p: Params,
+    x: jnp.ndarray,          # [..., T, D] REPLICATED activations
+    heads: int,              # GLOBAL head count (local = heads / n)
+    axis_name: str,
+    causal: bool = False,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Tensor-parallel transformer block body (run under shard_map with
+    the block params pre-sliced by ``shard_block_params_tp``). Activations
+    stay replicated; each device computes its head/hidden slice; the two
+    row-parallel projections psum partial results."""
+    import jax.lax as lax
+
+    n = lax.psum(1, axis_name)
+    if heads % n:
+        raise ValueError(f"TP degree {n} must divide head count {heads}")
+    local_heads = heads // n
+    h = layernorm(p["ln1"], x)
+    ap = p["attn"]
+    hd = ap["wq"]["w"].shape[1] // local_heads
+
+    def split(a):
+        return a.reshape(*a.shape[:-1], local_heads, hd)
+
+    q = split(dense(ap["wq"], h, dtype))
+    k = split(dense(ap["wk"], h, dtype))
+    v = split(dense(ap["wv"], h, dtype))
+    out = attn_core(q, k, v, causal, dtype)
+    x = x + lax.psum(dense(ap["wo"], out, dtype), axis_name)   # collective 1
+    h2 = layernorm(p["ln2"], x)
+    part = dense(p["mlp"]["fc2"], jax.nn.gelu(dense(p["mlp"]["fc1"], h2, dtype)), dtype)
+    x = x + lax.psum(part, axis_name)                          # collective 2
+    return x

@@ -103,3 +103,67 @@ def train_step(params, opt_state, batch, cfg: ViTConfig, optimizer):
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
     return params, opt_state, l
+
+
+# -- tensor-parallel inference (mesh 'model' axis) -------------------------
+
+def shard_params_tp(params: Params, n: int):
+    """Pre-slice the blocks for n TP ranks → (blocks_stacked, rest).
+
+    ``blocks_stacked``: per-rank block slices stacked on a leading rank
+    dim (shard over the model axis with P(axis)); ``rest``: the
+    replicated leaves (patch/cls/pos/ln_f/head)."""
+    from sitewhere_tpu.models.common import shard_block_params_tp
+
+    per_rank = [
+        [shard_block_params_tp(b, n, i) for b in params["blocks"]]
+        for i in range(n)
+    ]
+    blocks_stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_rank
+    )
+    rest = {k: params[k] for k in ("patch", "cls", "pos", "ln_f", "head")}
+    return blocks_stacked, rest
+
+
+def apply_tp(
+    blocks_stacked,
+    rest: Params,
+    cfg: ViTConfig,
+    images: jnp.ndarray,
+    mesh,
+    axis_name: str = "model",
+) -> jnp.ndarray:
+    """Tensor-parallel forward: each device holds 1/n of every block's
+    heads + MLP hidden (Megatron-style column/row split, two psums per
+    block); activations and the non-block leaves stay replicated. For
+    models whose weights outgrow one chip's HBM (SURVEY.md §2
+    parallelism census)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from sitewhere_tpu.models.common import transformer_block_tp
+
+    def body(blocks_local, rest_p, imgs):
+        # shard_map leaves a leading rank dim of size 1 on the stacked tree
+        blocks = jax.tree_util.tree_map(lambda a: a[0], blocks_local)
+        dtype = cfg.compute_dtype
+        x = dense(rest_p["patch"], patchify(imgs, cfg.patch_size).astype(dtype), dtype)
+        b = x.shape[0]
+        cls = jnp.broadcast_to(rest_p["cls"].astype(dtype), (b, 1, cfg.dim))
+        x = jnp.concatenate([cls, x], axis=1) + rest_p["pos"].astype(dtype)[None]
+        for blk in blocks:
+            x = transformer_block_tp(
+                blk, x, cfg.heads, axis_name, causal=False, dtype=dtype
+            )
+        x = layernorm(rest_p["ln_f"], x)
+        return dense(rest_p["head"], x[:, 0], dtype).astype(jnp.float32)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=P(),
+    )
+    return fn(blocks_stacked, rest, images)

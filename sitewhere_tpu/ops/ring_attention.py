@@ -50,26 +50,22 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
     dim sharded over ``axis_name``). q/k/v: [B, T_local, H, D] local
     blocks; returns [B, T_local, H, D] — exact attention over the FULL
     sequence."""
-    n = lax.psum(1, axis_name)
+    n = lax.psum(1, axis_name)                   # static: the axis size
     my = lax.axis_index(axis_name)
     b, tl, h, d = q.shape
     q_pos = my * tl + jnp.arange(tl)             # global query positions
 
-    # accumulators must be marked varying over the manual axis or the
-    # fori_loop carry types mismatch (the body's outputs vary)
-    if hasattr(lax, "pcast"):
-        def _vary(x):
-            return lax.pcast(x, axis_name, to="varying")
-    else:  # older jax
-        def _vary(x):
-            return lax.pvary(x, axis_name)
-    m0 = _vary(jnp.full((b, h, tl), NEG_INF, q.dtype))
-    l0 = _vary(jnp.zeros((b, h, tl), q.dtype))
-    a0 = _vary(jnp.zeros((b, h, tl, d), q.dtype))
+    m = jnp.full((b, h, tl), NEG_INF, q.dtype)
+    l = jnp.zeros((b, h, tl), q.dtype)
+    acc = jnp.zeros((b, h, tl, d), q.dtype)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def body(step, carry):
-        k_cur, v_cur, m, l, acc = carry
+    # the axis size is static, so the ring unrolls as a Python loop — the
+    # ppermute for the NEXT block overlaps this block's compute under
+    # XLA's async collectives, and the final (discarded) rotation is
+    # simply not emitted
+    k_cur, v_cur = k, v
+    for step in range(n):
         # the block arriving at step s originated s hops "behind" us
         src = (my - step) % n
         k_pos = src * tl + jnp.arange(tl)
@@ -85,12 +81,11 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
         r_blk = jnp.exp(bm - m_new)
         l = l * r_old + bl * r_blk
         acc = acc * r_old[..., None] + bacc * r_blk[..., None]
-        # rotate K/V to the next device (neighbor exchange on ICI)
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return k_next, v_next, m_new, l, acc
+        m = m_new
+        if step < n - 1:  # rotate K/V to the next device (ICI neighbors)
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
 
-    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, a0))
     # causal first rows always attend to themselves → l > 0; guard anyway
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3))     # [B, Tl, H, D]

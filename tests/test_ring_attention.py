@@ -117,3 +117,33 @@ def test_forecast_seed_sharded_runs_long_context():
     # RAW units: an (untrained) forecast of 21±4 telemetry must land in
     # the data's neighborhood, not normalized space
     assert bool((jnp.abs(mu - 21.0) < 15.0).all()), mu
+
+
+def test_vit_tensor_parallel_matches_single_device():
+    """Megatron-style TP ViT over the model axis is numerically the
+    single-device forward (two psums per block)."""
+    from sitewhere_tpu.models import vit
+
+    cfg = vit.ViTConfig(image_size=16, patch_size=8, dim=32, depth=2,
+                        heads=4, num_classes=7, dtype="float32")
+    params = vit.init(jax.random.PRNGKey(0), cfg)
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 16, 3), jnp.float32)
+    want = vit.apply(params, cfg, imgs)
+    for n in (2, 4):
+        devs = jax.devices()[:n]
+        mesh = Mesh(np.asarray(devs).reshape(n), ("model",))
+        blocks, rest = vit.shard_params_tp(params, n)
+        got = vit.apply_tp(blocks, rest, cfg, imgs, mesh, "model")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-5, atol=3e-5
+        )
+
+
+def test_tp_rejects_nondivisible_degree():
+    from sitewhere_tpu.models import vit
+
+    cfg = vit.ViTConfig(image_size=16, patch_size=8, dim=32, depth=1,
+                        heads=4, num_classes=4, dtype="float32")
+    params = vit.init(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="must divide"):
+        vit.shard_params_tp(params, 3)  # 3 ∤ dim=32
