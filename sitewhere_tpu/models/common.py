@@ -190,9 +190,9 @@ def shard_block_params_tp(blk: Params, n: int, idx: int) -> Params:
     def row(p):
         w, b = p["w"], p["b"]
         i = w.shape[0] // n
-        # bias must be added exactly once across the psum: zero it on
-        # every rank but 0
-        bias = jnp.where(idx == 0, b, jnp.zeros_like(b))
+        # bias must be added exactly once across the psum: only rank 0
+        # carries it (idx is a trace-time Python int)
+        bias = b if idx == 0 else jnp.zeros_like(b)
         return {"w": w[idx * i:(idx + 1) * i], "b": bias}
 
     return {

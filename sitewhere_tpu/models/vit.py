@@ -139,11 +139,19 @@ def apply_tp(
     block); activations and the non-block leaves stay replicated. For
     models whose weights outgrow one chip's HBM (SURVEY.md §2
     parallelism census)."""
-    from functools import partial
-
     from jax.sharding import PartitionSpec as P
 
     from sitewhere_tpu.models.common import transformer_block_tp
+
+    n_ranks = jax.tree_util.tree_leaves(blocks_stacked)[0].shape[0]
+    n = mesh.shape[axis_name]
+    if n_ranks != n:
+        # a mismatch would SILENTLY drop ranks (each psum would cover a
+        # fraction of the heads/MLP hidden)
+        raise ValueError(
+            f"params sliced for {n_ranks} TP ranks but '{axis_name}' has "
+            f"{n} devices"
+        )
 
     def body(blocks_local, rest_p, imgs):
         # shard_map leaves a leading rank dim of size 1 on the stacked tree

@@ -147,3 +147,58 @@ def test_tp_rejects_nondivisible_degree():
     params = vit.init(jax.random.PRNGKey(0), cfg)
     with pytest.raises(ValueError, match="must divide"):
         vit.shard_params_tp(params, 3)  # 3 ∤ dim=32
+
+
+def test_gpipe_pipeline_matches_sequential():
+    """GPipe over a stage axis: 4 transformer blocks, one per device,
+    microbatched — numerically the sequential stack."""
+    from sitewhere_tpu.models.common import (
+        transformer_block,
+        transformer_block_init,
+    )
+    from sitewhere_tpu.ops.pipeline_parallel import pipeline_apply
+
+    depth, dim, heads = 4, 32, 4
+    keys = jax.random.split(jax.random.PRNGKey(0), depth)
+    blocks = [transformer_block_init(k, dim, heads) for k in keys]
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 10, dim), jnp.float32)
+
+    want = x
+    for blk in blocks:
+        want = transformer_block(blk, want, heads, dtype=jnp.float32)
+
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    devs = jax.devices()[:depth]
+    mesh = Mesh(np.asarray(devs).reshape(depth), ("stage",))
+
+    def stage_fn(blk, act):
+        return transformer_block(blk, act, heads, dtype=jnp.float32)
+
+    for m in (2, 4, 8):
+        got = pipeline_apply(stacked, x, stage_fn, mesh, "stage",
+                             microbatches=m)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-5, atol=3e-5
+        )
+
+
+def test_gpipe_single_stage_degenerates():
+    from sitewhere_tpu.models.common import (
+        transformer_block,
+        transformer_block_init,
+    )
+    from sitewhere_tpu.ops.pipeline_parallel import pipeline_apply
+
+    blk = transformer_block_init(jax.random.PRNGKey(0), 16, 2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 6, 16), jnp.float32)
+    want = transformer_block(blk, x, 2, dtype=jnp.float32)
+    stacked = jax.tree_util.tree_map(lambda a: a[None], blk)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("stage",))
+    got = pipeline_apply(
+        stacked, x,
+        lambda p, a: transformer_block(p, a, 2, dtype=jnp.float32),
+        mesh, "stage", microbatches=2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=3e-5, atol=3e-5
+    )
