@@ -84,6 +84,92 @@ class MqttReceiver(InboundReceiver):
             self._client = None
 
 
+class AmqpReceiver(InboundReceiver):
+    """AMQP 0-9-1 receiver over a real socket (reference: RabbitMQ
+    receivers in service-event-sources [U]): consumes wire payloads from
+    the named queues with the in-repo protocol client (``comm.amqp``)."""
+
+    def __init__(self, name: str, host: str = "localhost", port: int = 5672,
+                 queues: Optional[List[str]] = None) -> None:
+        super().__init__(name)
+        self.host, self.port = host, port
+        self.queues = queues or ["sitewhere.input"]
+        self._client = None
+
+    async def on_start(self) -> None:
+        from sitewhere_tpu.comm.amqp import AmqpClient
+
+        client = await AmqpClient(self.host, self.port).connect()
+
+        async def on_message(body: bytes, queue: str) -> None:
+            await self.submit(body, topic=f"amqp/{queue}")
+
+        try:
+            for q in self.queues:
+                await client.queue_declare(q)
+                await client.consume(q, on_message)
+        except BaseException:
+            # a failed subscribe must not leak the connected client (a
+            # retrying supervisor would accumulate sockets)
+            await client.close()
+            raise
+        self._client = client
+
+    async def on_stop(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+
+
+class SocketReceiver(InboundReceiver):
+    """Raw TCP socket termination (reference: raw socket receivers in
+    service-event-sources [U]): devices connect and send length-prefixed
+    wire payloads (4-byte big-endian length + body, the simplest framing
+    a constrained device can emit). Each frame is one payload for the
+    tenant's decoder."""
+
+    MAX_FRAME = 16 * 1024 * 1024
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0) -> None:
+        super().__init__(name)
+        self.host, self.port = host, port
+        self.bound_port = None
+        self._server = None
+        self._conns: set = set()
+
+    async def on_start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port
+        )
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for t in list(self._conns):
+            await cancel_and_wait(t)
+
+    async def _serve(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                head = await reader.readexactly(4)
+                n = int.from_bytes(head, "big")
+                if n == 0 or n > self.MAX_FRAME:
+                    return  # malformed framing: drop the connection
+                payload = await reader.readexactly(n)
+                await self.submit(payload, topic=f"socket/{peer}")
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return
+        finally:
+            self._conns.discard(task)
+            writer.close()
+
+
 class EventSource(LifecycleComponent):
     """One (receiver, decoder) pair publishing decoded event requests."""
 
