@@ -121,6 +121,7 @@ class RestApi:
         r.add_post("/api/authapi/jwt", self.login)
         r.add_post("/api/input", self.http_ingest)
         r.add_get("/api/ws/input", self.ws_ingest)
+        r.add_get("/api/ws/events", self.ws_events)
         r.add_get("/api/health", self.health)
         r.add_get("/metrics", self.metrics)
         r.add_get("/api/openapi.json", self.openapi)
@@ -231,6 +232,64 @@ class RestApi:
                 payload, topic=f"ws/{tenant}/input"
             )
             frames.inc()
+        return ws
+
+    async def ws_events(self, request: web.Request) -> web.StreamResponse:
+        """Live event feed (reference: web-rest WebSocket topics [U]): a
+        JWT-authenticated client streams the tenant's persisted events as
+        JSON frames. JWT auth rides the standard middleware (the route is
+        NOT public). Each connection is its own consumer group starting at
+        the topic tail, so feeds don't disturb pipeline cursors and two
+        dashboards each see every event."""
+        import asyncio
+        import uuid
+
+        from sitewhere_tpu.core.batch import MeasurementBatch
+
+        rt = self._tenant(request)
+        ws = web.WebSocketResponse(heartbeat=30.0)
+        await ws.prepare(request)
+        bus = self.instance.bus
+        topic = bus.naming.persisted_events(rt.tenant)
+        group = f"ws-feed-{uuid.uuid4().hex[:8]}"
+        bus.subscribe(topic, group, at="latest")
+        sent = self.instance.metrics.counter("ws_feed.events")
+
+        async def drain_client() -> None:
+            # aiohttp only processes heartbeat PONGs (and CLOSE frames)
+            # inside receive() — without this concurrent reader every
+            # healthy connection would be force-closed after ~1.5
+            # heartbeats
+            async for _msg in ws:
+                pass
+
+        drainer = asyncio.create_task(drain_client())
+        try:
+            while not ws.closed:
+                items = await bus.consume(topic, group, 256, timeout_s=1.0)
+                for item in items:
+                    events = (
+                        item.to_events()
+                        if isinstance(item, MeasurementBatch)
+                        else [item]
+                    )
+                    for e in events:
+                        await ws.send_json(
+                            e.to_dict() if hasattr(e, "to_dict") else e
+                        )
+                        sent.inc()
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            drainer.cancel()
+            try:
+                await drainer
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            # deregister THROUGH the bus seam (works for the in-proc bus
+            # and the TCP broker alike) so a departed feed never
+            # backpressures the pipeline
+            bus.unsubscribe(topic, group)
         return ws
 
     async def health(self, request) -> web.Response:

@@ -286,6 +286,12 @@ class EventBus:
     def subscribe(self, topic: str, group: str, at: str = "earliest") -> None:
         self.topic(topic).subscribe(group, at)
 
+    def unsubscribe(self, topic: str, group: str) -> None:
+        """Deregister a group (part of the backend seam: ephemeral
+        consumers like live feeds must remove their cursor or they
+        backpressure producers forever)."""
+        self.topic(topic).unsubscribe(group)
+
     async def publish(self, topic: str, payload: Any) -> int:
         return await self.topic(topic).publish(payload)
 

@@ -145,6 +145,8 @@ class BusBrokerServer(LifecycleComponent):
             return await bus.consume(topic, group, max_items, timeout_s)
         if op == "subscribe":
             return bus.subscribe(*args)
+        if op == "unsubscribe":
+            return bus.unsubscribe(*args)
         if op == "seek":
             return bus.seek(*args)
         if op == "topics":
@@ -286,6 +288,9 @@ class RemoteEventBus:
 
     def subscribe(self, topic: str, group: str, at: str = "earliest") -> None:
         self._send_nowait("subscribe", topic, group, at)
+
+    def unsubscribe(self, topic: str, group: str) -> None:
+        self._send_nowait("unsubscribe", topic, group)
 
     def seek(self, topic: str, group: str, offset: int) -> None:
         self._send_nowait("seek", topic, group, offset)

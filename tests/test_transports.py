@@ -224,3 +224,47 @@ async def test_amqp_ingest_flows_through_pipeline():
     finally:
         await inst.terminate()
         await broker.terminate()
+
+
+async def test_ws_live_event_feed():
+    """JWT clients stream the tenant's persisted events over WebSocket;
+    each feed is an independent tail consumer (reference: web-rest
+    WebSocket topics)."""
+    inst = await _instance()
+    try:
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/api/authapi/jwt",
+                json={"username": "admin", "password": "password"},
+            )
+            token = (await resp.json())["token"]
+            # no token → 401 before upgrade
+            r = await client.get("/api/ws/events")
+            assert r.status == 401
+            feed = await client.ws_connect(
+                "/api/ws/events",
+                headers={"Authorization": f"Bearer {token}",
+                         "X-SiteWhere-Tenant": "default"},
+            )
+            auth = inst.tenant_management.get_tenant("default").auth_token
+            for i in range(5):
+                r = await client.post(
+                    "/api/input", data=_measurement(i),
+                    headers={"X-SiteWhere-Tenant": "default",
+                             "X-SiteWhere-Tenant-Auth": auth},
+                )
+                assert r.status == 202
+            got = []
+            for _ in range(5):
+                msg = await asyncio.wait_for(feed.receive_json(), 10.0)
+                got.append(msg)
+            assert len(got) == 5
+            assert all(m["device_token"] == "dev-00000" for m in got)
+            assert all("value" in m for m in got)
+            await feed.close()
+        finally:
+            await client.close()
+    finally:
+        await inst.terminate()
