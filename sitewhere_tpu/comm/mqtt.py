@@ -20,7 +20,6 @@ fire-and-acknowledge semantics).
 from __future__ import annotations
 
 import asyncio
-import itertools
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
@@ -115,9 +114,20 @@ def topic_matches(pattern: str, topic: str) -> bool:
 class MqttBroker(LifecycleComponent):
     """Minimal conformant MQTT 3.1.1 broker over asyncio TCP."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authenticator: Optional[Callable[[str, str, str], bool]] = None,
+    ) -> None:
         super().__init__("mqtt-broker")
         self.host, self.port = host, port
+        # (client_id, username, password) → accept?  With no authenticator
+        # the broker is OPEN — acceptable only inside the deployment trust
+        # boundary. The instance's embedded broker (InstanceConfig.
+        # mqtt_broker_port) passes authenticate_device here so MQTT ingest
+        # enforces the same tenant auth as the CoAP/HTTP/WS paths.
+        self.authenticator = authenticator
         self.bound_port: Optional[int] = None
         self._server = None
         self._conns: set = set()
@@ -160,8 +170,26 @@ class MqttBroker(LifecycleComponent):
                 writer.write(packet(CONNACK, 0, bytes([0, 0x01])))  # bad proto
                 await writer.drain()
                 return
-            b.u8()   # connect flags (sessions/wills unsupported → ignored)
+            cflags = b.u8()  # connect flags (sessions/wills unsupported)
             b.u16()  # keepalive (no server-side expiry enforcement)
+            client_id = b.utf8()
+            if cflags & 0x04:  # will flag: skip will topic + message
+                b.utf8()
+                n = b.u16()
+                b.off += n
+            username = b.utf8() if cflags & 0x80 else ""
+            password = ""
+            if cflags & 0x40:
+                n = b.u16()
+                password = b.data[b.off:b.off + n].decode("utf-8", "replace")
+                b.off += n
+            if self.authenticator is not None and not self.authenticator(
+                client_id, username, password
+            ):
+                # rc=4 bad user name or password (MQTT 3.1.1 §3.2.2.3)
+                writer.write(packet(CONNACK, 0, bytes([0, 0x04])))
+                await writer.drain()
+                return
             writer.write(packet(CONNACK, 0, bytes([0, 0x00])))  # accepted
             await writer.drain()
             self._entries[id(entry)] = entry
@@ -203,6 +231,12 @@ class MqttBroker(LifecycleComponent):
                 elif ptype == DISCONNECT:
                     return
         except (asyncio.IncompleteReadError, ConnectionResetError):
+            return
+        except (ValueError, IndexError, UnicodeDecodeError):
+            # malformed packet from an untrusted peer (bad varint, body
+            # truncated mid-field, invalid UTF-8 string): drop the
+            # connection instead of killing the serve task with an
+            # unhandled error
             return
         finally:
             self._conns.discard(task)
@@ -252,16 +286,19 @@ class MqttClient:
         port: int,
         client_id: str = "",
         keepalive_s: float = 30.0,
+        username: str = "",
+        password: str = "",
     ) -> None:
         self.host, self.port = host, port
         self.client_id = client_id or f"swt-{id(self):x}"
         self.keepalive_s = keepalive_s
+        self.username, self.password = username, password
         self._reader = None
         self._writer = None
         self._reply_task = None
         self._ping_task = None
         self._handlers: List[Tuple[str, Handler]] = []
-        self._pids = itertools.count(1)
+        self._pid = 0
         self._acks: Dict[int, asyncio.Future] = {}
         self._connack: Optional[asyncio.Future] = None
 
@@ -271,12 +308,27 @@ class MqttClient:
         )
         loop = asyncio.get_running_loop()
         self._connack = loop.create_future()
+        if self.password and not self.username:
+            # MQTT 3.1.1 §3.1.2.9: password flag requires username flag —
+            # silently dropping a configured credential would surface only
+            # as an opaque rc=4 at the broker
+            raise ValueError("MQTT password requires a username")
+        cflags = 0x02  # clean session
+        if self.username:
+            cflags |= 0x80
+            if self.password:
+                cflags |= 0x40
         body = (
             _utf8("MQTT") + bytes([4])           # protocol level 3.1.1
-            + bytes([0x02])                       # clean session
+            + bytes([cflags])
             + int(self.keepalive_s).to_bytes(2, "big")
             + _utf8(self.client_id)
         )
+        if self.username:
+            body += _utf8(self.username)
+            if self.password:
+                pw = self.password.encode()
+                body += len(pw).to_bytes(2, "big") + pw
         self._writer.write(packet(CONNECT, 0, body))
         await self._writer.drain()
         self._reply_task = asyncio.create_task(
@@ -353,13 +405,22 @@ class MqttClient:
                     fut.set_exception(ConnectionError("mqtt connection lost"))
             self._acks.clear()
 
+    def _next_pid(self) -> int:
+        """Nonzero 16-bit packet id (MQTT 3.1.1 §2.3.1), wrapping at 65535
+        and skipping ids whose ack is still pending."""
+        for _ in range(65535):
+            self._pid = self._pid % 65535 + 1
+            if self._pid not in self._acks:
+                return self._pid
+        raise RuntimeError("all 65535 MQTT packet ids await acks")
+
     def _await_ack(self, pid: int) -> asyncio.Future:
         fut = asyncio.get_running_loop().create_future()
         self._acks[pid] = fut
         return fut
 
     async def subscribe(self, topic_filter: str, handler: Handler, qos: int = 0) -> None:
-        pid = next(self._pids)
+        pid = self._next_pid()
         fut = self._await_ack(pid)
         self._handlers.append((topic_filter, handler))
         self._writer.write(packet(
@@ -370,7 +431,7 @@ class MqttClient:
         await asyncio.wait_for(fut, 10.0)
 
     async def unsubscribe(self, topic_filter: str) -> None:
-        pid = next(self._pids)
+        pid = self._next_pid()
         fut = self._await_ack(pid)
         self._handlers = [
             (f, h) for f, h in self._handlers if f != topic_filter
@@ -386,7 +447,7 @@ class MqttClient:
             self._writer.write(packet(PUBLISH, 0, _utf8(topic) + payload))
             await self._writer.drain()
             return
-        pid = next(self._pids)
+        pid = self._next_pid()
         fut = self._await_ack(pid)
         self._writer.write(packet(
             PUBLISH, 0x02, _utf8(topic) + pid.to_bytes(2, "big") + payload

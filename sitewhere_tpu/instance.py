@@ -158,6 +158,19 @@ class SiteWhereInstance(LifecycleComponent):
                 self._coap_submit, port=cfg.coap_ingest_port
             )
             self.add_child(self.coap)
+        self.mqtt_broker: object = None
+        if cfg.mqtt_broker_port is not None:
+            from sitewhere_tpu.comm.mqtt import MqttBroker
+
+            # embedded real-socket broker; CONNECT creds = tenant token +
+            # tenant auth secret, through the same gate as every transport
+            self.mqtt_broker = MqttBroker(
+                port=cfg.mqtt_broker_port,
+                authenticator=lambda cid, user, pw: (
+                    self.authenticate_device(user, pw) is not None
+                ),
+            )
+            self.add_child(self.mqtt_broker)
         self._updates_task: Optional[asyncio.Task] = None
         self._autosave_task: Optional[asyncio.Task] = None
         # ONE instance-level subscription for the shared input pattern; it
@@ -179,10 +192,13 @@ class SiteWhereInstance(LifecycleComponent):
         rec = self.tenant_management.get_tenant(tenant_token)
         expected = rec.auth_token if rec is not None else ""
         # compare BYTES: compare_digest on str raises TypeError for
-        # non-ASCII input, which would turn a bad credential into a 500
-        if rt is None or rec is None or not hmac.compare_digest(
-            supplied_auth.encode(), expected.encode()
-        ):
+        # non-ASCII input, which would turn a bad credential into a 500.
+        # The digest compare runs UNCONDITIONALLY (expected="" for unknown
+        # tenants) so unknown tokens take the same time as bad secrets —
+        # short-circuiting before it leaks a tenant-enumeration timing
+        # oracle through any transport.
+        ok = hmac.compare_digest(supplied_auth.encode(), expected.encode())
+        if not (ok and rt is not None and rec is not None):
             return None
         return rt
 
@@ -286,6 +302,8 @@ class SiteWhereInstance(LifecycleComponent):
                         "topics", [f"sitewhere/{tenant}/input/#"]
                     )),
                     qos=int(mq.get("qos", 0)),
+                    username=str(mq.get("username", "")),
+                    password=str(mq.get("password", "")),
                 ),
                 cfg.decoder, self.metrics,
             )

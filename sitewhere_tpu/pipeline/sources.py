@@ -58,17 +58,20 @@ class MqttReceiver(InboundReceiver):
     in-repo wire-protocol client — no third-party MQTT stack needed."""
 
     def __init__(self, name: str, host: str = "localhost", port: int = 1883,
-                 topics: Optional[List[str]] = None, qos: int = 0) -> None:
+                 topics: Optional[List[str]] = None, qos: int = 0,
+                 username: str = "", password: str = "") -> None:
         super().__init__(name)
         self.host, self.port = host, port
         self.topics = topics or ["sitewhere/input/#"]
         self.qos = qos
+        self.username, self.password = username, password
         self._client = None
 
     async def on_start(self) -> None:
         from sitewhere_tpu.comm.mqtt import MqttClient
 
-        client = MqttClient(self.host, self.port, client_id=self.name)
+        client = MqttClient(self.host, self.port, client_id=self.name,
+                            username=self.username, password=self.password)
         await client.connect()
 
         async def on_message(topic: str, payload: bytes) -> None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import pickle
+import re
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -250,14 +251,22 @@ class CheckpointManager:
         tmp = put_meta.with_suffix(".tmp")
         tmp.write_text(snap["seg_meta"])
         tmp.replace(put_meta)  # ── commit ──
-        # post-commit cleanup: every file the committed meta does NOT name
+        # post-commit cleanup: every file the committed meta does NOT name.
+        # Anchored to THIS tenant's exact file grammar — a bare prefix glob
+        # would also match tenant "prod-eu" while cleaning tenant "prod"
+        # (tenant tokens are free-form strings) and delete its live segments.
         meta = json.loads(snap["seg_meta"])
         keep = set(meta["seg_names"]) | {meta["tail"], meta["other"]}
+        t = re.escape(tenant)
+        pq_pat = re.compile(
+            rf"^measurements-{t}-(seg\d{{6}}(-g\d{{8}})?|tail\d{{8}})\.parquet$"
+        )
+        jl_pat = re.compile(rf"^events-{t}-g\d{{8}}\.jsonl$")
         for old in ev_dir.glob(f"measurements-{tenant}-*.parquet"):
-            if old.name not in keep:
+            if pq_pat.match(old.name) and old.name not in keep:
                 old.unlink(missing_ok=True)
         for old in ev_dir.glob(f"events-{tenant}-g*.jsonl"):
-            if old.name not in keep:
+            if jl_pat.match(old.name) and old.name not in keep:
                 old.unlink(missing_ok=True)
 
     def save_tenant_stores(self, tenant: str, dm, store) -> None:

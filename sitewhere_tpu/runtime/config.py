@@ -103,6 +103,10 @@ class InstanceConfig:
     # instance-level CoAP/UDP ingest endpoint (None = off; 0 = ephemeral
     # port). Devices POST /input?tenant=...&auth=... with a wire payload
     coap_ingest_port: Optional[int] = None
+    # instance-level embedded MQTT 3.1.1 broker (None = off; 0 = ephemeral
+    # port). CONNECT username/password = tenant token/auth token, checked
+    # through the same authenticate_device gate as CoAP/HTTP/WS ingest
+    mqtt_broker_port: Optional[int] = None
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

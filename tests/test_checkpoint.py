@@ -297,3 +297,42 @@ def test_segment_lineage_mismatch_forces_full_rewrite(tmp_path):
     ))
     snap2 = ck.snapshot_tenant_stores(dm, got)
     assert snap2["segments"] == []  # sealed segment reused across restore
+
+
+def test_cleanup_never_touches_prefix_sibling_tenant(tmp_path):
+    """ADVICE r4 (medium): checkpointing tenant 'prod' must NOT delete
+    tenant 'prod-eu's committed segment files — cleanup is anchored to
+    the exact per-tenant file grammar, not a bare prefix glob."""
+    from sitewhere_tpu.core.batch import MeasurementBatch
+    from sitewhere_tpu.services.device_management import DeviceManagement
+    from sitewhere_tpu.services.event_store import EventStore
+
+    ck = CheckpointManager(tmp_path)
+
+    def make(tenant, n):
+        dm = DeviceManagement(tenant)
+        store = EventStore(tenant)
+        store.add_measurement_batch(MeasurementBatch.from_column_chunks(
+            tenant,
+            [("d1", "t", np.arange(n).astype(np.float32),
+              np.arange(n).astype(np.float64) + 1)],
+        ))
+        return dm, store
+
+    dm_eu, store_eu = make("prod-eu", 40)
+    ck.save_tenant_stores("prod-eu", dm_eu, store_eu)
+    eu_files = {
+        p.name for p in (tmp_path / "events").iterdir() if "prod-eu" in p.name
+    }
+    assert eu_files  # the victim tenant has on-disk state
+
+    # checkpoint 'prod' twice (second write triggers cleanup of stale
+    # 'prod' files — which under the old glob also matched 'prod-eu-*')
+    dm_p, store_p = make("prod", 10)
+    ck.save_tenant_stores("prod", dm_p, store_p)
+    ck.save_tenant_stores("prod", dm_p, store_p)
+
+    survivors = {p.name for p in (tmp_path / "events").iterdir()}
+    assert eu_files <= survivors
+    got = ck.load_event_store("prod-eu")
+    assert len(got.measurements) == 40

@@ -201,3 +201,71 @@ async def test_http_ingest_endpoint():
             await client.close()
     finally:
         await inst.terminate()
+
+
+async def test_broker_connect_auth():
+    """With an authenticator installed, CONNECT credentials are honored:
+    good creds → CONNACK 0; bad/missing creds → CONNACK rc=4 and the
+    client raises (ADVICE r4: broker must not rest on topic secrecy)."""
+    broker = MqttBroker(
+        authenticator=lambda cid, user, pw: (user, pw) == ("tenant-a", "s3cret")
+    )
+    await broker.initialize()
+    await broker.start()
+    try:
+        ok = await MqttClient(
+            "127.0.0.1", broker.bound_port, "dev1",
+            username="tenant-a", password="s3cret",
+        ).connect()
+        await ok.disconnect()
+        with pytest.raises(ConnectionError, match="rc=4"):
+            await MqttClient(
+                "127.0.0.1", broker.bound_port, "dev2",
+                username="tenant-a", password="wrong",
+            ).connect()
+        with pytest.raises(ConnectionError, match="rc=4"):
+            await MqttClient("127.0.0.1", broker.bound_port, "dev3").connect()
+    finally:
+        await broker.terminate()
+
+
+def test_packet_ids_wrap_16bit():
+    """Packet ids stay in 1..65535 forever and skip pending ids
+    (ADVICE r4: itertools.count overflowed to_bytes after 65535)."""
+    c = MqttClient("h", 1)
+    first = [c._next_pid() for _ in range(3)]
+    assert first == [1, 2, 3]
+    c._pid = 65534
+    assert c._next_pid() == 65535
+    assert c._next_pid() == 1  # wraps, not 65536
+    # a pending ack blocks reuse of that id
+    c._pid = 65534
+    c._acks[65535] = object()
+    assert c._next_pid() == 1
+
+
+async def test_embedded_broker_uses_device_auth_gate():
+    """InstanceConfig.mqtt_broker_port starts a real-socket broker whose
+    CONNECT check IS authenticate_device: tenant token + auth secret."""
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig
+
+    inst = SiteWhereInstance(InstanceConfig(mqtt_broker_port=0))
+    await inst.initialize()
+    await inst.start()
+    try:
+        await inst.bootstrap(default_tenant="alpha")
+        port = inst.mqtt_broker.bound_port
+        secret = inst.tenant_management.get_tenant("alpha").auth_token
+        ok = await MqttClient(
+            "127.0.0.1", port, "dev", username="alpha", password=secret
+        ).connect()
+        await ok.disconnect()
+        with pytest.raises(ConnectionError, match="rc=4"):
+            await MqttClient(
+                "127.0.0.1", port, "dev", username="alpha", password="nope"
+            ).connect()
+        with pytest.raises(ConnectionError, match="rc=4"):
+            await MqttClient("127.0.0.1", port, "anon").connect()
+    finally:
+        await inst.terminate()
