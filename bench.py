@@ -1,7 +1,10 @@
 """Benchmark harness: the five BASELINE.md configs on real hardware.
 
-Prints ONE JSON line to stdout (driver contract):
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...details}
+Prints ONE COMPACT JSON line to stdout (driver contract — round 4 broke
+it by printing the full result tree, which the driver's tail capture
+truncated to "parsed": null; the headline is now < 1500 chars by
+construction and the full tree goes to BENCH_DETAILS.json):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...summary}
 Human-readable progress goes to stderr.
 
 North star (BASELINE.json:5): 1M DeviceMeasurement events/sec scored at
@@ -36,6 +39,36 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def xla_flops(lowerable, *args) -> float:
+    """FLOPs per call from XLA's own cost analysis of the compiled
+    executable (0.0 when the backend doesn't report it). Used for MFU:
+    achieved FLOP/s ÷ peak — the round-4 verdict requires the bench to
+    print achieved FLOP/s and %MFU per model config."""
+    try:
+        compiled = lowerable.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        return 0.0
+
+
+# bf16 peak of one TPU v5e chip (the bench's hardware target); the CPU
+# backend reports mfu against this same peak, so CPU mfu is ~0 by design
+PEAK_FLOPS_V5E = 197e12
+
+
+def mfu_fields(flops_per_step: float, steps: int, dt: float,
+               peak: float = PEAK_FLOPS_V5E) -> dict:
+    achieved = flops_per_step * steps / dt if dt > 0 else 0.0
+    return {
+        "tflops_per_sec": round(achieved / 1e12, 4),
+        "mfu_pct": round(100.0 * achieved / peak, 3),
+        "flops_per_step": flops_per_step,
+    }
 
 
 def measure_rtt() -> float:
@@ -105,6 +138,9 @@ def bench_engine(n_slots: int, b_per_slot: int, window: int, steps: int) -> dict
 
     s = scorer.step(*inputs[0])
     np.asarray(s)  # compile + settle
+    flops = xla_flops(
+        scorer._step, scorer.params, scorer.state, scorer.active, *inputs[0]
+    )
     t0 = time.perf_counter()
     for i in range(steps):
         s = scorer.step(*inputs[i % n_rot])
@@ -118,6 +154,7 @@ def bench_engine(n_slots: int, b_per_slot: int, window: int, steps: int) -> dict
         "events_per_step": ev,
         "steps": steps,
         "n_tenants": n_slots,
+        **mfu_fields(flops, steps, dt),
     }
 
 
@@ -158,6 +195,7 @@ def bench_deepar(n_series: int, context: int, points: int, steps: int) -> dict:
     wins_d = jax.device_put(batch)
     samples, mean = fc(params, wins_d, key)
     np.asarray(mean)  # compile
+    flops = xla_flops(fc, params, wins_d, key)
     t0 = time.perf_counter()
     for i in range(steps):
         keys = jax.random.fold_in(key, i)
@@ -172,6 +210,7 @@ def bench_deepar(n_series: int, context: int, points: int, steps: int) -> dict:
         "horizon": cfg.horizon,
         "num_samples": cfg.num_samples,
         "replay_windows_per_sec": len(windows) / replay_s if replay_s > 0 else 0.0,
+        **mfu_fields(flops, steps, dt),
     }
 
 
@@ -191,6 +230,7 @@ def bench_vit_model(batch: int, steps: int) -> dict:
         for _ in range(2)
     ]
     np.asarray(apply(params, frames[0]))  # compile
+    flops = xla_flops(apply, params, frames[0])
     t0 = time.perf_counter()
     for i in range(steps):
         logits = apply(params, frames[i % 2])
@@ -201,6 +241,8 @@ def bench_vit_model(batch: int, steps: int) -> dict:
         "frames_per_sec": batch * steps / dt,
         "step_ms": dt / steps * 1e3,
         "batch": batch,
+        "gflops_per_frame": round(flops / max(batch, 1) / 1e9, 2),
+        **mfu_fields(flops, steps, dt),
     }
 
 
@@ -501,31 +543,44 @@ def bench_e2e_cpu_subprocess(secs: float) -> dict:
     otherwise dominate the very latency being measured."""
     import os
     import subprocess
+    import tempfile
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    with tempfile.NamedTemporaryFile(
+        suffix=".json", delete=False
+    ) as tf:
+        child_details = tf.name
     try:
-        proc = subprocess.run(
-            [sys.executable, __file__, "--configs", "e2e", "--backend", "cpu",
-             "--e2e-secs", str(secs), "--e2e-wire", "binary",
-             "--e2e-slots", "1", "--e2e-max-batch", "256", "--e2e-burst", "2",
-             "--e2e-paced-rate", "4000",
-             "--e2e-hidden", "32", "--e2e-window", "16"],
-            capture_output=True, text=True, timeout=900, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-    except subprocess.TimeoutExpired:
-        # a hung child must not take down the whole bench run (the driver
-        # depends on the one-JSON-line stdout contract)
-        return {"error": "cpu-backend e2e subprocess timed out (900s)"}
-    if proc.returncode != 0:
-        return {"error": (proc.stderr or "")[-800:]}
-    try:
-        full = json.loads(proc.stdout.strip().splitlines()[-1])
-        return full["e2e_pipeline"]
-    except (ValueError, KeyError, IndexError) as exc:
-        return {"error": f"parse: {exc}; stdout tail: {proc.stdout[-400:]}"}
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--configs", "e2e",
+                 "--backend", "cpu",
+                 "--e2e-secs", str(secs), "--e2e-wire", "binary",
+                 "--e2e-slots", "1", "--e2e-max-batch", "256",
+                 "--e2e-burst", "2", "--e2e-paced-rate", "4000",
+                 "--e2e-hidden", "32", "--e2e-window", "16",
+                 "--details-out", child_details],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            # a hung child must not take down the whole bench run (the
+            # driver depends on the one-JSON-line stdout contract)
+            return {"error": "cpu-backend e2e subprocess timed out (900s)"}
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or "")[-800:]}
+        try:
+            with open(child_details) as f:
+                return json.load(f)["e2e_pipeline"]
+        except (OSError, ValueError, KeyError) as exc:
+            return {"error": f"parse: {exc}; stdout tail: {proc.stdout[-400:]}"}
+    finally:
+        try:
+            os.unlink(child_details)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------- main
@@ -552,6 +607,9 @@ def main() -> None:
                         "to the image's sitecustomize pin")
     p.add_argument("--profile", default="",
                    help="directory: capture a jax.profiler trace of config 4")
+    p.add_argument("--details-out", default="BENCH_DETAILS.json",
+                   help="path for the full result tree (stdout carries "
+                        "only the compact headline)")
     args = p.parse_args()
     which = set(args.configs.split(",")) if args.configs != "all" else {
         "e2e", "e2e-json", "e2e-cpu", "lstm", "deepar", "tenants32", "vit"
@@ -642,14 +700,50 @@ def main() -> None:
     # through the 32-tenant stacked engine (BASELINE.json:5,10)
     headline = details.get("tenants32_engine", details.get("lstm_engine"))
     value = headline["events_per_sec"] if headline else 0.0
+
+    # full tree → file; stdout gets ONLY the compact headline (< 1500
+    # chars by construction) so the driver's tail capture can't truncate it
+    with open(args.details_out, "w") as f:
+        json.dump(details, f, indent=1)
+
+    def pick(d: dict, *path, nd: int = 1):
+        for k in path:
+            d = d.get(k) if isinstance(d, dict) else None
+            if d is None:
+                return None
+        return round(d, nd) if isinstance(d, float) else d
+
     out = {
         "metric": "device_events_per_sec_scored_32tenant_engine",
         "value": round(value, 1),
         "unit": "events/s",
         "vs_baseline": round(value / 1_000_000, 4),
-        **details,
+        "platform": details["platform"],
+        "rtt_ms": round(details["rtt_ms"], 1),
+        "tenants_per_chip": pick(details, "tenants32_engine", "n_tenants"),
+        "tenants32_mfu_pct": pick(details, "tenants32_engine", "mfu_pct"),
+        "lstm_ev_s": pick(details, "lstm_engine", "events_per_sec"),
+        "e2e_ev_s": pick(details, "e2e_pipeline", "events_per_sec"),
+        "e2e_drained": pick(
+            details, "e2e_pipeline", "saturation", "drain_converged"),
+        "e2e_paced_p99_ms": pick(details, "e2e_pipeline", "paced", "p99_ms"),
+        "e2e_json_ev_s": pick(details, "e2e_pipeline_json", "events_per_sec"),
+        "e2e_cpu_p99_ms": pick(
+            details, "e2e_pipeline_cpu", "paced", "p99_ms"),
+        "deepar_fc_s": pick(details, "deepar_replay", "forecasts_per_sec"),
+        "vit_fps": pick(details, "vit_media", "frames_per_sec"),
+        "vit_model_fps": pick(
+            details, "vit_media", "model_only", "frames_per_sec"),
+        "vit_mfu_pct": pick(details, "vit_media", "model_only", "mfu_pct"),
+        "h2d_mbps": pick(details, "vit_media", "h2d_mbps"),
+        "details": args.details_out,
     }
-    print(json.dumps(out), flush=True)
+    line = json.dumps(out)
+    if len(line) > 1400:  # hard guard on the driver contract
+        out = {k: out[k] for k in
+               ("metric", "value", "unit", "vs_baseline", "details")}
+        line = json.dumps(out)
+    print(line, flush=True)
 
 
 if __name__ == "__main__":

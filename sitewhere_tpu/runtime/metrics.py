@@ -44,32 +44,53 @@ class Gauge:
         self.value = float(v)
 
 
-class Histogram:
-    """Log-bucketed latency histogram with quantile estimates.
+def _latency_edges() -> List[float]:
+    """Variable-resolution log bucket edges: coarse (ratio 1.25, ±12%)
+    below 1 ms and above 1 s, fine (ratio 1.05, ±2.5%) through the
+    1 ms–1 s band where every pipeline p99 of interest lives. ~200
+    edges total, so bisect record stays O(log n) with zero per-sample
+    storage."""
+    edges: List[float] = []
+    v = 1e-6
+    while v < 1e-3 * 0.999:
+        edges.append(v)
+        v *= 1.25
+    v = 1e-3
+    while v < 1.0 * 0.999:
+        edges.append(v)
+        v *= 1.05
+    v = 1.0
+    while v <= 100.0:
+        edges.append(v)
+        v *= 1.25
+    return edges
 
-    Buckets are exponential from 1 µs to ~100 s (ratio 1.25) — accurate to
-    ~12% at any scale, O(1) record, no per-sample storage. Good enough for
-    p99 tracking at 1M events/s (recording must never be the bottleneck).
+
+class Histogram:
+    """Log-bucketed latency histogram with interpolated quantiles.
+
+    Bucket edges come from ``_latency_edges`` (fine resolution in the
+    1 ms–1 s band); quantiles interpolate linearly WITHIN the crossing
+    bucket instead of returning its upper edge, so p50/p99 don't
+    quantize to a fixed grid (round-4 verdict: edge-reporting repeated
+    bit-identical p99s across configs at ±12% error).
     """
 
-    RATIO = 1.25
+    EDGES = _latency_edges()
     MIN = 1e-6
 
     def __init__(self, name: str, unit: str = "s") -> None:
         self.name = name
         self.unit = unit
-        n = int(math.log(1e8) / math.log(self.RATIO)) + 2
-        self._counts = [0] * n
+        self._counts = [0] * (len(self.EDGES) + 1)
         self._sum = 0.0
         self._n = 0
         self._max = 0.0
         self._lock = threading.Lock()
 
     def _bucket(self, v: float) -> int:
-        if v <= self.MIN:
-            return 0
-        b = int(math.log(v / self.MIN) / math.log(self.RATIO)) + 1
-        return min(b, len(self._counts) - 1)
+        # bucket i covers (EDGES[i-1], EDGES[i]]; 0 is (-inf, EDGES[0]]
+        return bisect.bisect_left(self.EDGES, v)
 
     def record(self, v: float) -> None:
         b = self._bucket(v)
@@ -106,10 +127,14 @@ class Histogram:
         target = q * self._n
         acc = 0
         for i, c in enumerate(self._counts):
+            if acc + c >= target and c:
+                lo = self.EDGES[i - 1] if i > 0 else 0.0
+                hi = self.EDGES[i] if i < len(self.EDGES) else self._max
+                hi = min(hi, self._max) if self._max else hi
+                # linear interpolation within the crossing bucket
+                frac = (target - acc) / c
+                return min(lo + frac * max(hi - lo, 0.0), self._max or hi)
             acc += c
-            if acc >= target:
-                # bucket upper edge
-                return self.MIN * (self.RATIO ** i)
         return self._max
 
     def summary(self) -> Dict[str, float]:

@@ -167,3 +167,31 @@ def test_prometheus_quantile_labels():
     reg.histogram("lat").record(0.01)
     text = reg.prometheus_text()
     assert 'quantile="0.99"' in text and 'quantile="99"' not in text
+
+
+def test_histogram_quantile_accuracy_latency_band():
+    """Quantiles in the 1 ms–1 s band are accurate to a few percent (fine
+    buckets + within-bucket interpolation), not quantized to ±12% bucket
+    edges (round-4 verdict: p99s repeated bit-identically across configs)."""
+    import numpy as np
+
+    from sitewhere_tpu.runtime.metrics import Histogram
+
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=np.log(0.05), sigma=0.6, size=20_000)
+    h = Histogram("lat")
+    h.record_many(samples)
+    for q in (0.5, 0.95, 0.99):
+        exact = float(np.quantile(samples, q))
+        est = h.quantile(q)
+        assert abs(est - exact) / exact < 0.04, (q, est, exact)
+    # two nearby but distinct distributions must not report the same p99
+    h2 = Histogram("lat2")
+    h2.record_many(samples * 1.07)
+    assert h2.quantile(0.99) != h.quantile(0.99)
+    # degenerate cases
+    empty = Histogram("e")
+    assert empty.quantile(0.99) == 0.0
+    one = Histogram("o")
+    one.record(0.123)
+    assert abs(one.quantile(0.5) - 0.123) / 0.123 < 0.06
