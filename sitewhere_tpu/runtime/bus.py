@@ -25,8 +25,9 @@ from __future__ import annotations
 
 import asyncio
 import random
+import zlib
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
 
 class TopicNaming:
@@ -98,6 +99,12 @@ class Topic:
         self.group_offsets: Dict[str, int] = {}
         self.fault: Optional[FaultPlan] = None
         self.dropped = False  # set by EventBus.drop_topics; pollers return []
+        # durability hook: DurableEventBus attaches a WAL here so every
+        # append lands on disk before a consumer can observe it
+        self.wal = None
+        # partition-facade hook: PartitionedTopic shares one wake event
+        # across its partitions so a cross-partition poll can block
+        self.aux_event: Optional[asyncio.Event] = None
 
     def _live_len(self) -> int:
         return len(self._log) - self._head
@@ -155,8 +162,14 @@ class Topic:
     def _append(self, payload: Any) -> int:
         off = self._next_offset
         self._next_offset += 1
+        if self.wal is not None:
+            # disk BEFORE visibility: once a consumer has seen an entry it
+            # must survive a broker kill
+            self.wal.append(off, payload)
         self._log.append((off, payload))
         self._data_event.set()
+        if self.aux_event is not None:
+            self.aux_event.set()
         return off
 
     # -- consumer side ---------------------------------------------------
@@ -185,6 +198,10 @@ class Topic:
             )
 
     def seek(self, group: str, offset: int) -> None:
+        if isinstance(offset, (tuple, list)):
+            # per-partition cursor restored into a single-log topic
+            # (partition-count reconfiguration): resume conservatively
+            offset = min(offset) if offset else 0
         self.group_offsets[group] = max(offset, 0)
         # seeking past the oldest entry may release a backpressured producer
         if not self._oldest_still_needed():
@@ -211,6 +228,20 @@ class Topic:
         }
 
     def restore_state(self, st: dict) -> None:
+        if "__parts__" in st:
+            # partitioned snapshot restored into a single-log topic
+            # (partition-count reconfiguration): keep every entry,
+            # renumbering offsets sequentially per partition order
+            entries = [p for ps in st["__parts__"] for p in ps["entries"]]
+            groups: Dict[str, int] = {}
+            for ps in st["__parts__"]:
+                for g, off in ps["groups"].items():
+                    groups[g] = min(groups.get(g, off), off)
+            st = {
+                "entries": [(i, pl) for i, (_, pl) in enumerate(entries)],
+                "next": len(entries),
+                "groups": groups,
+            }
         self._log = list(st["entries"])
         self._head = 0
         self._next_offset = st["next"]
@@ -219,6 +250,15 @@ class Topic:
 
     def lag(self, group: str) -> int:
         return self.latest_offset - self.committed(group)
+
+    def drop(self) -> None:
+        """Tombstone: publishes no-op, pollers return [], producers wake."""
+        self.dropped = True
+        self.group_offsets.clear()
+        self._space_event.set()
+        self._data_event.set()
+        if self.aux_event is not None:
+            self.aux_event.set()
 
     async def poll(
         self, group: str, max_items: int = 256, timeout_s: Optional[float] = None
@@ -259,16 +299,194 @@ class Topic:
 
 
 
+def partition_key_hash(key: Any) -> int:
+    """Stable cross-process key hash (python's builtin hash is salted
+    per-process, which would re-shuffle device→partition placement on
+    every restart)."""
+    return zlib.crc32(str(key).encode())
+
+
+class PartitionedTopic:
+    """N append-only partition logs behind one topic name — the Kafka
+    partition-parallelism analog (SURVEY.md §2 parallelism census: the
+    reference scales out via partitioned topics + consumer groups [U]).
+
+    Semantics: per-partition ordering only (like Kafka); a key pins a
+    publisher's events to one partition (device token → stable partition
+    → per-device ordering); keyless publishes round-robin. Consumer
+    groups hold ONE cursor PER PARTITION; a poll without ``partition``
+    drains any partition with data (shared-cursor competition), a poll
+    WITH ``partition`` is the scale-out seam: worker k owns partition k.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        n_partitions: int,
+        retention: int = 65536,
+        part_factory: Optional[Callable[[str, int], Topic]] = None,
+    ) -> None:
+        assert n_partitions >= 1
+        self.name = name
+        make = part_factory or (lambda n, r: Topic(n, r))
+        self.parts: List[Topic] = [
+            make(f"{name}#p{i}", retention) for i in range(n_partitions)
+        ]
+        self._any_data = asyncio.Event()
+        for p in self.parts:
+            p.aux_event = self._any_data
+        self._rr = 0
+        self._poll_rr = 0
+        self.dropped = False
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.parts)
+
+    def partition_for(self, key: Any) -> int:
+        if key is None:
+            self._rr = (self._rr + 1) % len(self.parts)
+            return self._rr
+        return partition_key_hash(key) % len(self.parts)
+
+    # -- producer ---------------------------------------------------------
+    async def publish(self, payload: Any, key: Any = None) -> int:
+        return await self.parts[self.partition_for(key)].publish(payload)
+
+    def publish_nowait(self, payload: Any, key: Any = None) -> int:
+        return self.parts[self.partition_for(key)].publish_nowait(payload)
+
+    # -- consumer ---------------------------------------------------------
+    def subscribe(self, group: str, at: str = "earliest") -> None:
+        for p in self.parts:
+            p.subscribe(group, at)
+
+    def unsubscribe(self, group: str) -> None:
+        for p in self.parts:
+            p.unsubscribe(group)
+
+    def seek(self, group: str, offset: Any) -> None:
+        """``offset`` is either one int (applied to every partition — the
+        replay-to-0 idiom) or a per-partition tuple/list."""
+        if isinstance(offset, (tuple, list)):
+            for p, off in zip(self.parts, offset):
+                p.seek(group, off)
+        else:
+            for p in self.parts:
+                p.seek(group, offset)
+
+    def committed(self, group: str) -> Tuple[int, ...]:
+        return tuple(p.committed(group) for p in self.parts)
+
+    def lag(self, group: str) -> int:
+        return sum(p.lag(group) for p in self.parts)
+
+    @property
+    def latest_offset(self) -> int:
+        return sum(p.latest_offset for p in self.parts)
+
+    @property
+    def group_offsets(self) -> Dict[str, Tuple[int, ...]]:
+        groups: set = set()
+        for p in self.parts:
+            groups.update(p.group_offsets)
+        return {g: tuple(p.group_offsets.get(g, 0) for p in self.parts)
+                for g in groups}
+
+    async def poll(
+        self,
+        group: str,
+        max_items: int = 256,
+        timeout_s: Optional[float] = None,
+        partition: Optional[int] = None,
+    ) -> List[Any]:
+        if partition is not None:
+            return await self.parts[partition].poll(group, max_items, timeout_s)
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout_s is None else loop.time() + timeout_s
+        n = len(self.parts)
+        while True:
+            if self.dropped:
+                return []
+            for k in range(n):
+                i = (self._poll_rr + k) % n
+                items = await self.parts[i].poll(group, max_items, 0)
+                if items:
+                    self._poll_rr = (i + 1) % n
+                    return items
+            self._any_data.clear()
+            # re-check after clear: an append between the empty sweep and
+            # the clear would otherwise be missed until the next one
+            if any(p.lag(group) > 0 for p in self.parts):
+                continue
+            remaining = None if deadline is None else deadline - loop.time()
+            if remaining is not None and remaining <= 0:
+                return []
+            try:
+                await asyncio.wait_for(self._any_data.wait(), remaining)
+            except asyncio.TimeoutError:
+                return []
+
+    # -- lifecycle / chaos / durability ----------------------------------
+    def drop(self) -> None:
+        self.dropped = True
+        for p in self.parts:
+            p.drop()
+
+    @property
+    def fault(self) -> Optional[FaultPlan]:
+        return self.parts[0].fault
+
+    @fault.setter
+    def fault(self, plan: Optional[FaultPlan]) -> None:
+        for p in self.parts:
+            p.fault = plan
+
+    def snapshot_state(self) -> dict:
+        return {"__parts__": [p.snapshot_state() for p in self.parts]}
+
+    def restore_state(self, st: dict) -> None:
+        parts_st = st.get("__parts__")
+        if parts_st is None:
+            # single-log state restored into a partitioned topic: land it
+            # all on partition 0 (per-partition ordering still holds)
+            self.parts[0].restore_state(st)
+            return
+        for p, ps in zip(self.parts, parts_st):
+            p.restore_state(ps)
+
+
 class EventBus:
     """Registry of topics + convenience pub/sub API."""
 
-    def __init__(self, naming: Optional[TopicNaming] = None, retention: int = 65536) -> None:
+    def __init__(
+        self,
+        naming: Optional[TopicNaming] = None,
+        retention: int = 65536,
+        partitions: Optional[Dict[str, int]] = None,
+    ) -> None:
         self.naming = naming or TopicNaming()
         self.retention = retention
+        # topic-name-suffix → partition count (e.g. {"inbound-events": 4});
+        # unlisted topics stay single-log — partitioning is a per-topic
+        # scale-out decision, exactly like Kafka partition counts
+        self.partitions = dict(partitions or {})
         self._topics: Dict[str, Topic] = {}
         self._dropped_prefixes: set = set()
         self._tombstone = Topic("<dropped>", 0)
         self._tombstone.dropped = True
+
+    def _n_partitions(self, name: str) -> int:
+        for suffix, n in self.partitions.items():
+            if name.endswith(suffix):
+                return max(1, int(n))
+        return 1
+
+    def _make_topic(self, name: str):
+        n = self._n_partitions(name)
+        if n > 1:
+            return PartitionedTopic(name, n, self.retention)
+        return Topic(name, self.retention)
 
     def topic(self, name: str) -> Topic:
         t = self._topics.get(name)
@@ -277,7 +495,7 @@ class EventBus:
             # resurrect its topics — hand back the shared tombstone instead
             if any(name.startswith(p) for p in self._dropped_prefixes):
                 return self._tombstone
-            t = self._topics[name] = Topic(name, self.retention)
+            t = self._topics[name] = self._make_topic(name)
         return t
 
     def topics(self) -> List[str]:
@@ -292,11 +510,17 @@ class EventBus:
         backpressure producers forever)."""
         self.topic(topic).unsubscribe(group)
 
-    async def publish(self, topic: str, payload: Any) -> int:
-        return await self.topic(topic).publish(payload)
+    async def publish(self, topic: str, payload: Any, key: Any = None) -> int:
+        t = self.topic(topic)
+        if isinstance(t, PartitionedTopic):
+            return await t.publish(payload, key)
+        return await t.publish(payload)
 
-    def publish_nowait(self, topic: str, payload: Any) -> int:
-        return self.topic(topic).publish_nowait(payload)
+    def publish_nowait(self, topic: str, payload: Any, key: Any = None) -> int:
+        t = self.topic(topic)
+        if isinstance(t, PartitionedTopic):
+            return t.publish_nowait(payload, key)
+        return t.publish_nowait(payload)
 
     async def consume(
         self,
@@ -304,8 +528,13 @@ class EventBus:
         group: str,
         max_items: int = 256,
         timeout_s: Optional[float] = None,
+        partition: Optional[int] = None,
     ) -> List[Any]:
-        return await self.topic(topic).poll(group, max_items, timeout_s)
+        t = self.topic(topic)
+        if isinstance(t, PartitionedTopic):
+            return await t.poll(group, max_items, timeout_s, partition)
+        # single-log topics are their own partition 0
+        return await t.poll(group, max_items, timeout_s)
 
     async def stream(
         self, topic: str, group: str, max_items: int = 256
@@ -324,11 +553,7 @@ class EventBus:
         self._dropped_prefixes.add(prefix)
         victims = [n for n in self._topics if n.startswith(prefix)]
         for name in victims:
-            t = self._topics.pop(name)
-            t.dropped = True
-            t.group_offsets.clear()
-            t._space_event.set()  # release anyone blocked in publish
-            t._data_event.set()   # wake pollers; they return [] (dropped)
+            self._topics.pop(name).drop()
         return victims
 
     def undrop(self, prefix: str) -> None:

@@ -1,0 +1,345 @@
+"""Durable bus backend: a disk-backed segmented log under the EventBus
+seam — the "pluggable Kafka shim"'s durability half (SURVEY.md §5
+distributed backend: Kafka's disk log + consumer offsets are the
+reference pipeline's crash story [U]; reference mount empty, see
+provenance banner. Round-4 verdict item 4: broker log was memory-only).
+
+Design (same segment discipline as ``runtime/checkpoint.py``):
+
+- every topic partition gets a directory of append-only segment files
+  ``seg-<first_offset>.log`` of length-prefixed pickle frames
+  ``(offset, payload)``; the append path writes + flushes BEFORE the
+  entry becomes visible to consumers, so anything a consumer has seen
+  survives a broker SIGKILL (OS page cache holds flushed bytes; fsync
+  per append is available via ``fsync=True`` for power-loss domains).
+- segments seal at ``segment_bytes``; sealed segments whose entries have
+  all aged past retention are deleted at rotation time.
+- consumer-group cursors ride a single append-only ``offsets.log``
+  journal (tiny ``(topic, group, cursor)`` frames, flushed per write),
+  compacted to a snapshot frame once it grows past a threshold.
+- recovery = scan segments (torn final frames from a mid-write kill are
+  truncated), rebuild each topic's retained tail, then replay the
+  offsets journal. Publishes that never hit disk are lost (at-most-once
+  for unflushed tail) but consumed offsets never run ahead of data:
+  the cursor journal is written only after the data it points past.
+
+Pickle is acceptable here for the same reason as ``netbus``: broker and
+clients are one deployment's processes, not an open wire protocol.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import struct
+import urllib.parse
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from sitewhere_tpu.runtime.bus import (
+    EventBus,
+    PartitionedTopic,
+    Topic,
+    TopicNaming,
+)
+
+_LEN = struct.Struct(">I")
+
+
+def _quote(name: str) -> str:
+    """Filesystem-safe topic directory name (tenant tokens are free-form)."""
+    return urllib.parse.quote(name, safe="")
+
+
+class SegmentWriter:
+    """Append-only segmented frame log for ONE topic partition."""
+
+    def __init__(
+        self,
+        root: Path,
+        segment_bytes: int = 8 << 20,
+        fsync: bool = False,
+        retention: int = 65536,
+    ) -> None:
+        self.root = root
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        self.retention = retention
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._fh: Optional[io.BufferedWriter] = None
+        self._written = 0
+        self._last_offset = -1
+
+    def _open_segment(self, first_offset: int) -> None:
+        self.close()
+        path = self.root / f"seg-{first_offset:012d}.log"
+        self._fh = open(path, "ab")
+        self._written = path.stat().st_size
+
+    def append(self, offset: int, payload: Any) -> None:
+        if self._fh is None or self._written >= self.segment_bytes:
+            self._rotate(offset)
+        data = pickle.dumps((offset, payload), pickle.HIGHEST_PROTOCOL)
+        self._fh.write(_LEN.pack(len(data)) + data)
+        self._fh.flush()  # into the OS: survives SIGKILL of this process
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        self._written += _LEN.size + len(data)
+        self._last_offset = offset
+
+    def _rotate(self, next_offset: int) -> None:
+        self._open_segment(next_offset)
+        # drop sealed segments wholly below the retention window: every
+        # entry in them is already unreachable via the in-memory topic
+        floor = next_offset - self.retention
+        segs = sorted(self.root.glob("seg-*.log"))
+        for i, seg in enumerate(segs[:-1]):  # never the active segment
+            nxt_first = int(segs[i + 1].stem.split("-")[1])
+            if nxt_first <= floor:
+                seg.unlink(missing_ok=True)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+
+
+def read_segments(root: Path) -> List[Tuple[int, Any]]:
+    """All intact frames across this partition's segments, in order. A
+    torn final frame (killed mid-write) is truncated away."""
+    out: List[Tuple[int, Any]] = []
+    for seg in sorted(root.glob("seg-*.log")):
+        data = seg.read_bytes()
+        pos = 0
+        while pos + _LEN.size <= len(data):
+            (n,) = _LEN.unpack(data[pos:pos + _LEN.size])
+            if pos + _LEN.size + n > len(data):
+                break  # torn tail
+            try:
+                out.append(pickle.loads(data[pos + _LEN.size:pos + _LEN.size + n]))
+            except Exception:  # noqa: BLE001 - corrupt frame ends the segment
+                break
+            pos += _LEN.size + n
+    return out
+
+
+class OffsetsJournal:
+    """Append-only consumer-cursor journal with snapshot compaction."""
+
+    COMPACT_EVERY = 20_000
+
+    def __init__(self, path: Path, fsync: bool = False) -> None:
+        self.path = path
+        self.fsync = fsync
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "ab")
+        self._appends = 0
+
+    def record(self, topic: str, group: str, cursor: Any) -> None:
+        self._write(("o", topic, group, cursor))
+
+    def tombstone(self, topic: str) -> None:
+        """Forget every cursor of a dropped topic — without this, a
+        re-added topic would resurrect with a stale cursor ahead of its
+        empty log and silently hide its first events."""
+        self._write(("d", topic))
+
+    def _write(self, rec: tuple) -> None:
+        data = pickle.dumps(rec, pickle.HIGHEST_PROTOCOL)
+        self._fh.write(_LEN.pack(len(data)) + data)
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        self._appends += 1
+        if self._appends >= self.COMPACT_EVERY:
+            self.compact(self.replay())
+
+    def compact(self, state: Dict[str, Dict[str, Any]]) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        data = pickle.dumps(("s", state), pickle.HIGHEST_PROTOCOL)
+        with open(tmp, "wb") as f:
+            f.write(_LEN.pack(len(data)) + data)
+            f.flush()
+            os.fsync(f.fileno())
+        self._fh.close()
+        tmp.replace(self.path)
+        self._fh = open(self.path, "ab")
+        self._appends = 0
+
+    def replay(self) -> Dict[str, Dict[str, Any]]:
+        """{topic: {group: cursor}} from snapshot + deltas."""
+        state: Dict[str, Dict[str, Any]] = {}
+        try:
+            data = self.path.read_bytes()
+        except OSError:
+            return state
+        pos = 0
+        while pos + _LEN.size <= len(data):
+            (n,) = _LEN.unpack(data[pos:pos + _LEN.size])
+            if pos + _LEN.size + n > len(data):
+                break
+            try:
+                rec = pickle.loads(data[pos + _LEN.size:pos + _LEN.size + n])
+            except Exception:  # noqa: BLE001
+                break
+            if rec[0] == "s":
+                state = {t: dict(g) for t, g in rec[1].items()}
+            elif rec[0] == "d":
+                state.pop(rec[1], None)
+            else:
+                _, topic, group, cursor = rec
+                state.setdefault(topic, {})[group] = cursor
+            pos += _LEN.size + n
+        return state
+
+    def close(self) -> None:
+        self._fh.flush()
+        self._fh.close()
+
+
+class DurableEventBus(EventBus):
+    """EventBus whose topic logs and consumer cursors live on disk.
+
+    Drop-in behind ``BusBrokerServer`` (or directly in-proc): same
+    semantics, plus recovery — construct it over an existing ``data_dir``
+    and every topic's retained tail + every group cursor are back before
+    the first poll."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        naming: Optional[TopicNaming] = None,
+        retention: int = 65536,
+        partitions: Optional[Dict[str, int]] = None,
+        segment_bytes: int = 8 << 20,
+        fsync: bool = False,
+    ) -> None:
+        super().__init__(naming, retention, partitions)
+        self.root = Path(data_dir)
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        self._journal = OffsetsJournal(
+            self.root / "offsets" / "offsets.log", fsync=fsync
+        )
+        # commit-on-next-poll (Kafka auto-commit semantics): a batch's
+        # cursor goes to the journal only when the consumer polls AGAIN —
+        # its implicit ack. A broker killed after serving a batch but
+        # before the reply lands re-delivers that batch on restart
+        # (at-least-once) instead of silently skipping it (at-most-once).
+        self._pending: Dict[Tuple[str, str], Any] = {}
+        self._recover()
+
+    # -- wiring ----------------------------------------------------------
+    def _part_dir(self, topic: str, part: int) -> Path:
+        return self.root / "topics" / _quote(topic) / f"p{part:03d}"
+
+    def _attach_wal(self, t, name: str) -> None:
+        parts = t.parts if isinstance(t, PartitionedTopic) else [t]
+        for i, p in enumerate(parts):
+            p.wal = SegmentWriter(
+                self._part_dir(name, i), self.segment_bytes,
+                self.fsync, self.retention,
+            )
+
+    def _make_topic(self, name: str):
+        t = super()._make_topic(name)
+        self._attach_wal(t, name)
+        return t
+
+    # -- recovery --------------------------------------------------------
+    def _recover(self) -> None:
+        topics_root = self.root / "topics"
+        if topics_root.is_dir():
+            for tdir in sorted(topics_root.iterdir()):
+                name = urllib.parse.unquote(tdir.name)
+                t = self.topic(name)  # attaches fresh writers
+                parts = t.parts if isinstance(t, PartitionedTopic) else [t]
+                for i, p in enumerate(parts):
+                    entries = read_segments(self._part_dir(name, i))
+                    entries = entries[-self.retention:]
+                    if not entries:
+                        continue
+                    # restore_state assigns the log directly (no _append,
+                    # so nothing re-enters the WAL)
+                    p.restore_state({
+                        "entries": entries,
+                        "next": entries[-1][0] + 1,
+                        "groups": {},
+                    })
+                    p.wal._last_offset = entries[-1][0]
+        for topic, groups in self._journal.replay().items():
+            t = self.topic(topic)
+            for group, cursor in groups.items():
+                t.seek(group, cursor)
+
+    # -- journaled cursor movements --------------------------------------
+    def _cursor_of(self, topic: str, group: str) -> Any:
+        t = self._topics.get(topic)
+        if t is None:
+            return None
+        if isinstance(t, PartitionedTopic):
+            return tuple(p.committed(group) for p in t.parts)
+        return t.committed(group)
+
+    async def consume(
+        self,
+        topic: str,
+        group: str,
+        max_items: int = 256,
+        timeout_s: Optional[float] = None,
+        partition: Optional[int] = None,
+    ) -> List[Any]:
+        key = (topic, group)
+        prev = self._pending.pop(key, None)
+        if prev is not None:
+            # the consumer polled again → previous batch is acked
+            self._journal.record(topic, group, prev)
+        items = await super().consume(topic, group, max_items, timeout_s, partition)
+        if items:
+            self._pending[key] = self._cursor_of(topic, group)
+        return items
+
+    def seek(self, topic: str, group: str, offset: Any) -> None:
+        super().seek(topic, group, offset)
+        self._pending.pop((topic, group), None)
+        cursor = self._cursor_of(topic, group)
+        if cursor is not None:
+            self._journal.record(topic, group, cursor)
+
+    def drop_topics(self, prefix: str) -> List[str]:
+        victims: List[str] = []
+        for name in [n for n in self._topics if n.startswith(prefix)]:
+            t = self._topics[name]
+            for p in (t.parts if isinstance(t, PartitionedTopic) else [t]):
+                if p.wal is not None:
+                    p.wal.close()
+                    p.wal = None
+            victims.append(name)
+        out = super().drop_topics(prefix)
+        import shutil
+
+        # tenant teardown is durable too: a dropped topic must not
+        # resurrect its events (or its stale cursors) on broker restart
+        for name in victims:
+            shutil.rmtree(self.root / "topics" / _quote(name),
+                          ignore_errors=True)
+            self._journal.tombstone(name)
+            self._pending = {
+                k: v for k, v in self._pending.items() if k[0] != name
+            }
+        return out
+
+    def close(self) -> None:
+        # clean shutdown commits every served batch (the pending ack
+        # window only re-delivers after a CRASH)
+        for (topic, group), cursor in self._pending.items():
+            self._journal.record(topic, group, cursor)
+        self._pending.clear()
+        for t in self._topics.values():
+            parts = t.parts if isinstance(t, PartitionedTopic) else [t]
+            for p in parts:
+                if p.wal is not None:
+                    p.wal.close()
+        self._journal.close()

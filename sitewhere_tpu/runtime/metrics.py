@@ -10,7 +10,6 @@ a Prometheus-format scrape endpoint is exposed by ``api.rest``.
 from __future__ import annotations
 
 import bisect
-import math
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -77,7 +76,6 @@ class Histogram:
     """
 
     EDGES = _latency_edges()
-    MIN = 1e-6
 
     def __init__(self, name: str, unit: str = "s") -> None:
         self.name = name

@@ -61,9 +61,12 @@ class BusBrokerServer(LifecycleComponent):
         retention: int = 65536,
         host: str = "127.0.0.1",
         port: int = 0,
+        bus: Optional[EventBus] = None,
     ) -> None:
         super().__init__("bus-broker")
-        self.bus = EventBus(naming, retention)
+        # pluggable backing bus: pass a dlog.DurableEventBus for a broker
+        # whose logs + cursors survive kill -9 (round-4 verdict item 4)
+        self.bus = bus if bus is not None else EventBus(naming, retention)
         self.host = host
         self.port = port
         self.bound_port: Optional[int] = None
@@ -137,12 +140,15 @@ class BusBrokerServer(LifecycleComponent):
             # forever; the client re-issues long polls. A dropped
             # (tombstoned) topic returns None so the client can stop
             # re-issuing instead of hot-looping on instant empty replies
-            topic, group, max_items, timeout_s = args
+            topic, group, max_items, timeout_s, *rest = args
+            partition = rest[0] if rest else None
             if bus.topic(topic).dropped:
                 return None
             if timeout_s is None or timeout_s > 30.0:
                 timeout_s = 30.0
-            return await bus.consume(topic, group, max_items, timeout_s)
+            return await bus.consume(
+                topic, group, max_items, timeout_s, partition
+            )
         if op == "subscribe":
             return bus.subscribe(*args)
         if op == "unsubscribe":
@@ -184,27 +190,79 @@ class RemoteEventBus:
         port: int,
         naming: Optional[TopicNaming] = None,
         retention: int = 65536,
+        reconnect_window_s: float = 20.0,
     ) -> None:
         self.naming = naming or TopicNaming()
         self.retention = retention
         self.host, self.port = host, port
+        # how long awaited calls retry against a down broker before the
+        # error propagates (0 = fail fast). A durable broker restarted on
+        # the same port within the window is transparent to the pipeline:
+        # its logs + group cursors come back from disk, so re-issued polls
+        # resume exactly where the dead broker left off.
+        self.reconnect_window_s = reconnect_window_s
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reply_task: Optional[asyncio.Task] = None
         self._futures: Dict[int, asyncio.Future] = {}
         self._ids = itertools.count(1)
+        self._subs: set = set()  # (topic, group, at) replayed on reconnect
+        self._closed = False
+        self._conn_lock: Optional[asyncio.Lock] = None
 
     # -- connection -------------------------------------------------------
     async def connect(self) -> "RemoteEventBus":
+        self._conn_lock = asyncio.Lock()
+        await self._connect_once()
+        return self
+
+    async def _connect_once(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
         self._reply_task = asyncio.create_task(
             self._reply_loop(), name="netbus-replies"
         )
-        return self
+        # re-register group cursors: a durable broker already has them on
+        # disk (subscribe is then a no-op), a fresh one needs them back
+        for topic, group, at in self._subs:
+            self._writer.write(_dump((None, "subscribe", (topic, group, at))))
+
+    async def _ensure_connected(self) -> None:
+        if self._closed:
+            raise ConnectionError("bus client closed")
+        if self._writer is not None:
+            return
+        assert self._conn_lock is not None, "RemoteEventBus not connected"
+        async with self._conn_lock:
+            if self._writer is not None or self._closed:
+                return
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.reconnect_window_s
+            while True:
+                try:
+                    await self._connect_once()
+                    return
+                except OSError:
+                    if loop.time() >= deadline:
+                        raise ConnectionError(
+                            f"bus broker unreachable at "
+                            f"{self.host}:{self.port}"
+                        )
+                    await asyncio.sleep(0.25)
+
+    def _mark_disconnected(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        self._reader = None
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("bus connection lost"))
+        self._futures.clear()
 
     async def close(self) -> None:
+        self._closed = True
         await cancel_and_wait(self._reply_task)
         self._reply_task = None
         if self._writer is not None:
@@ -220,13 +278,9 @@ class RemoteEventBus:
         while True:
             try:
                 req_id, ok, value = await _read_frame(self._reader)
-            except (asyncio.IncompleteReadError, ConnectionResetError):
-                for fut in self._futures.values():
-                    if not fut.done():
-                        fut.set_exception(
-                            ConnectionError("bus connection lost")
-                        )
-                self._futures.clear()
+            except (asyncio.IncompleteReadError, ConnectionResetError,
+                    OSError):
+                self._mark_disconnected()
                 return
             fut = self._futures.pop(req_id, None)
             if fut is not None and not fut.done():
@@ -236,26 +290,44 @@ class RemoteEventBus:
                     fut.set_exception(RuntimeError(value))
 
     async def _call(self, op: str, *args) -> Any:
-        assert self._writer is not None, "RemoteEventBus not connected"
-        req_id = next(self._ids)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._futures[req_id] = fut
-        self._writer.write(_dump((req_id, op, args)))
-        await self._writer.drain()
-        return await fut
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(self.reconnect_window_s, 0.0)
+        while True:
+            await self._ensure_connected()
+            req_id = next(self._ids)
+            fut: asyncio.Future = loop.create_future()
+            self._futures[req_id] = fut
+            try:
+                self._writer.write(_dump((req_id, op, args)))
+                await self._writer.drain()
+                return await fut
+            except ConnectionError:
+                # broker died mid-call. Retrying may re-apply a mutation
+                # whose first attempt landed before the crash (at-least-
+                # once, like any acked-after-commit bus); polls are safe
+                # to re-issue by construction.
+                self._futures.pop(req_id, None)
+                if self._closed or loop.time() >= deadline:
+                    raise
+                await asyncio.sleep(0.25)
 
     def _send_nowait(self, op: str, *args) -> None:
         """Fire-and-forget for the sync API points; StreamWriter.write is
-        synchronous, so ordering vs later calls is preserved."""
-        assert self._writer is not None, "RemoteEventBus not connected"
+        synchronous, so ordering vs later calls is preserved. During a
+        broker outage these frames are dropped (subscriptions are replayed
+        on reconnect; cursors live durably broker-side)."""
+        if op == "subscribe":
+            self._subs.add(args)
+        if self._writer is None:
+            return
         self._writer.write(_dump((None, op, args)))
 
     # -- EventBus surface -------------------------------------------------
-    async def publish(self, topic: str, payload: Any) -> int:
-        return await self._call("publish", topic, payload)
+    async def publish(self, topic: str, payload: Any, key: Any = None) -> int:
+        return await self._call("publish", topic, payload, key)
 
-    def publish_nowait(self, topic: str, payload: Any) -> int:
-        self._send_nowait("publish_nowait", topic, payload)
+    def publish_nowait(self, topic: str, payload: Any, key: Any = None) -> int:
+        self._send_nowait("publish_nowait", topic, payload, key)
         return -1  # offset unknowable without a round trip
 
     async def consume(
@@ -264,6 +336,7 @@ class RemoteEventBus:
         group: str,
         max_items: int = 256,
         timeout_s: Optional[float] = None,
+        partition: Optional[int] = None,
     ) -> List[Any]:
         # the broker caps one server-side poll at 30s; preserve the
         # in-proc semantics for ANY timeout by re-issuing capped polls
@@ -277,7 +350,7 @@ class RemoteEventBus:
             # always poll at least once: timeout 0 means "non-blocking
             # fetch of whatever is available", exactly like the in-proc bus
             items = await self._call(
-                "consume", topic, group, max_items, remaining
+                "consume", topic, group, max_items, remaining, partition
             )
             if items is None:
                 return []  # topic dropped (tenant teardown) — stop polling
@@ -290,6 +363,7 @@ class RemoteEventBus:
         self._send_nowait("subscribe", topic, group, at)
 
     def unsubscribe(self, topic: str, group: str) -> None:
+        self._subs = {s for s in self._subs if s[:2] != (topic, group)}
         self._send_nowait("unsubscribe", topic, group)
 
     def seek(self, topic: str, group: str, offset: int) -> None:
@@ -327,3 +401,60 @@ class RemoteEventBus:
 
     async def restore_offsets(self, snap: Dict[str, Dict[str, int]]) -> None:
         await self._call("restore_offsets", snap)
+
+
+# ------------------------------------------------------------------ main
+def main(argv: Optional[List[str]] = None) -> None:
+    """Standalone broker process: ``python -m sitewhere_tpu.runtime.netbus
+    --port P [--data-dir D]``. With --data-dir the broker is DURABLE
+    (segmented on-disk logs + cursor journal, dlog.DurableEventBus): kill
+    it -9, restart it on the same dir, and consumers resume from their
+    persisted offsets with no event loss."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--instance-id", default="sw")
+    ap.add_argument("--retention", type=int, default=65536)
+    ap.add_argument("--data-dir", default="",
+                    help="enable durability under this directory")
+    ap.add_argument("--partitions", default="{}",
+                    help='JSON topic-suffix → count, e.g. '
+                         '{"inbound-events": 4}')
+    args = ap.parse_args(argv)
+    naming = TopicNaming(args.instance_id)
+    parts = {k: int(v) for k, v in json.loads(args.partitions).items()}
+    if args.data_dir:
+        from sitewhere_tpu.runtime.dlog import DurableEventBus
+
+        bus = DurableEventBus(
+            args.data_dir, naming, args.retention, partitions=parts
+        )
+    else:
+        bus = EventBus(naming, args.retention, partitions=parts)
+
+    async def run() -> None:
+        broker = BusBrokerServer(
+            host=args.host, port=args.port, bus=bus
+        )
+        await broker.initialize()
+        await broker.start()
+        # READY line: parents parse the bound port from stdout
+        print(json.dumps({"ready": True, "port": broker.bound_port}),
+              flush=True)
+        try:
+            await asyncio.Event().wait()  # serve until killed
+        finally:
+            await broker.terminate()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -182,3 +182,21 @@ def test_compaction_keeps_offsets_dense():
         assert got == list(range(4984, 5000))
 
     run(go())
+
+
+async def test_partitioned_snapshot_restores_into_plain_topic():
+    """Bus-state snapshot taken under a partitioned config must restore
+    into a bus where the topic is plain (partition-count reconfiguration)
+    without losing entries — the crash-resume path cannot crash."""
+    from sitewhere_tpu.runtime.bus import EventBus
+
+    src = EventBus(partitions={"evts": 3})
+    src.subscribe("t.evts", "g")
+    for i in range(12):
+        await src.publish("t.evts", i, key=i)
+    state = src.snapshot_state()
+
+    dst = EventBus()  # no partitions configured
+    dst.restore_state(state)
+    got = await dst.consume("t.evts", "g", 100, timeout_s=0)
+    assert sorted(got) == list(range(12))

@@ -1,0 +1,203 @@
+"""Durable partitioned bus: partition semantics, disk-backed recovery,
+torn-write truncation, and the kill -9 broker-resume contract (round-4
+verdict item 4: the promised pluggable Kafka shim's durability half)."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from sitewhere_tpu.runtime.bus import EventBus, PartitionedTopic, TopicNaming
+from sitewhere_tpu.runtime.dlog import DurableEventBus, read_segments
+from sitewhere_tpu.runtime.netbus import RemoteEventBus
+
+
+async def test_partitioned_topic_key_routing_and_cursors():
+    bus = EventBus(TopicNaming("pt"), partitions={"inbound-events": 4})
+    topic = bus.naming.inbound_events("t1")
+    t = bus.topic(topic)
+    assert isinstance(t, PartitionedTopic) and t.n_partitions == 4
+    bus.subscribe(topic, "g")
+    # keyed publishes: same key → same partition, per-key order holds
+    for i in range(20):
+        await bus.publish(topic, ("dev-a", i), key="dev-a")
+        await bus.publish(topic, ("dev-b", i), key="dev-b")
+    part_a = t.partition_for("dev-a")
+    part_b = t.partition_for("dev-b")
+    got_a = await bus.consume(topic, "g", 64, timeout_s=1, partition=part_a)
+    assert [i for (d, i) in got_a if d == "dev-a"] == list(range(20))
+    if part_b != part_a:
+        got_b = await bus.consume(topic, "g", 64, 1, partition=part_b)
+        assert [i for (d, i) in got_b if d == "dev-b"] == list(range(20))
+    # unpartitioned topics stay plain
+    assert not isinstance(bus.topic("pt.global.other"), PartitionedTopic)
+
+
+async def test_partitioned_poll_any_partition_drains_all():
+    bus = EventBus(partitions={"fan": 3})
+    bus.subscribe("t.fan", "g")
+    for i in range(30):
+        await bus.publish("t.fan", i, key=i)
+    seen = []
+    while True:
+        items = await bus.consume("t.fan", "g", 8, timeout_s=0)
+        if not items:
+            break
+        seen.extend(items)
+    assert sorted(seen) == list(range(30))
+    # blocking poll wakes on a publish to ANY partition
+    async def later():
+        await asyncio.sleep(0.1)
+        await bus.publish("t.fan", "wake", key="z")
+
+    task = asyncio.create_task(later())
+    got = await bus.consume("t.fan", "g", 8, timeout_s=5)
+    assert got == ["wake"]
+    await task
+
+
+async def test_durable_bus_recovers_log_and_cursors(tmp_path):
+    bus = DurableEventBus(tmp_path, TopicNaming("d"), retention=1000,
+                          partitions={"part-topic": 2})
+    bus.subscribe("d.t", "g")
+    bus.subscribe("d.part-topic", "pg")
+    for i in range(50):
+        await bus.publish("d.t", {"i": i})
+        await bus.publish("d.part-topic", i, key=i % 7)
+    got = await bus.consume("d.t", "g", 20, timeout_s=0)
+    assert [x["i"] for x in got] == list(range(20))
+    drained = []
+    for _ in range(20):
+        items = await bus.consume("d.part-topic", "pg", 8, timeout_s=0)
+        if not items:
+            break
+        drained.extend(items)
+    bus.close()
+
+    # a brand-new bus over the same dir: log + cursors are back
+    bus2 = DurableEventBus(tmp_path, TopicNaming("d"), retention=1000,
+                           partitions={"part-topic": 2})
+    rest = await bus2.consume("d.t", "g", 1000, timeout_s=0)
+    assert [x["i"] for x in rest] == list(range(20, 50))
+    rest_p = []
+    for _ in range(20):
+        items = await bus2.consume("d.part-topic", "pg", 8, timeout_s=0)
+        if not items:
+            break
+        rest_p.extend(items)
+    assert sorted(drained + rest_p) == sorted(range(50))
+    bus2.close()
+
+
+async def test_durable_bus_truncates_torn_frame(tmp_path):
+    bus = DurableEventBus(tmp_path, retention=100)
+    bus.subscribe("x", "g")
+    for i in range(10):
+        await bus.publish("x", i)
+    bus.close()
+    # simulate a kill mid-append: garbage half-frame at the segment tail
+    seg = sorted((tmp_path / "topics").rglob("seg-*.log"))[-1]
+    with open(seg, "ab") as f:
+        f.write(b"\x00\x00\x01\x00partial")
+    bus2 = DurableEventBus(tmp_path, retention=100)
+    assert await bus2.consume("x", "g", 100, timeout_s=0) == list(range(10))
+    # and the writer continues appending cleanly after recovery
+    await bus2.publish("x", 10)
+    assert await bus2.consume("x", "g", 100, timeout_s=0) == [10]
+    bus2.close()
+
+
+async def test_durable_drop_topics_is_durable(tmp_path):
+    bus = DurableEventBus(tmp_path)
+    bus.subscribe("dead.a", "g")
+    for i in range(5):
+        await bus.publish("dead.a", i)
+    assert await bus.consume("dead.a", "g", 10, timeout_s=0) == list(range(5))
+    bus.drop_topics("dead.")
+    bus.close()
+    bus2 = DurableEventBus(tmp_path)
+    bus2.undrop("dead.")
+    assert await bus2.consume("dead.a", "g", 10, timeout_s=0) == []
+    # the journal tombstone also killed the stale cursor: a re-added
+    # topic's FIRST events must be visible, not hidden behind cursor=5
+    await bus2.publish("dead.a", "fresh")
+    assert await bus2.consume("dead.a", "g", 10, timeout_s=0) == ["fresh"]
+    bus2.close()
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_broker(port: int, data_dir, partitions: str = "{}"):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # broker imports no jax, belt+braces
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sitewhere_tpu.runtime.netbus",
+         "--port", str(port), "--data-dir", str(data_dir),
+         "--instance-id", "k9", "--partitions", partitions],
+        stdout=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = proc.stdout.readline()
+    assert '"ready": true' in line, line
+    return proc
+
+
+async def test_kill9_broker_restart_resumes_without_loss(tmp_path):
+    """Publish through a durable broker, SIGKILL it mid-run, restart it on
+    the same port+dir: the client reconnects transparently and consumption
+    resumes from the persisted cursor with every unconsumed event intact."""
+    port = _free_port()
+    proc = _spawn_broker(port, tmp_path, partitions='{"stream": 2}')
+    bus = RemoteEventBus("127.0.0.1", port, naming=TopicNaming("k9"),
+                         reconnect_window_s=15.0)
+    await bus.connect()
+    try:
+        bus.subscribe("k9.stream", "workers")
+        await asyncio.sleep(0)  # let the subscribe frame flush
+        for i in range(200):
+            await bus.publish("k9.stream", i, key=i % 11)
+        first = []
+        while len(first) < 80:
+            items = await bus.consume("k9.stream", "workers", 40, timeout_s=2)
+            if not items:
+                break
+            first.extend(items)
+        assert len(first) >= 80
+
+        proc.kill()  # SIGKILL — no flush, no goodbye
+        proc.wait()
+        proc = _spawn_broker(port, tmp_path, partitions='{"stream": 2}')
+
+        # same client object keeps working across the restart. Delivery
+        # is at-least-once: the LAST pre-kill batch's cursor commits on
+        # the next poll (Kafka auto-commit semantics), so it may be
+        # re-delivered — but nothing may be LOST
+        rest = []
+        for _ in range(50):
+            items = await bus.consume("k9.stream", "workers", 64, timeout_s=2)
+            if not items:
+                break
+            rest.extend(items)
+        assert set(first) | set(rest) == set(range(200)), (
+            len(first), len(rest))
+        dupes = len(first) + len(rest) - 200
+        assert 0 <= dupes <= 80  # at most the unacked window, never loss
+        # and the restarted broker accepts new traffic
+        await bus.publish("k9.stream", 999, key="z")
+        got = await bus.consume("k9.stream", "workers", 10, timeout_s=2)
+        assert got == [999]
+    finally:
+        await bus.close()
+        proc.kill()
+        proc.wait()
