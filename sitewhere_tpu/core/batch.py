@@ -27,6 +27,21 @@ import numpy as np
 
 from sitewhere_tpu.core.events import DeviceMeasurement
 
+# grow-on-demand pool of row-index suffix strings: `prefix + pool[:n]`
+# (object-array broadcast add) is ~5x cheaper than np.char.add + astype —
+# id generation sits on the persistence path at full ingest rate
+_ID_SUFFIXES = np.zeros((0,), object)
+
+
+def make_event_ids(prefix: str, n: int) -> np.ndarray:
+    """object[n] ids '{prefix}{row}' — the one vectorized id generator."""
+    global _ID_SUFFIXES
+    if len(_ID_SUFFIXES) < n:
+        _ID_SUFFIXES = np.arange(
+            max(n, 2 * len(_ID_SUFFIXES), 4096)
+        ).astype("U8").astype(object)
+    return prefix + _ID_SUFFIXES[:n]
+
 
 @dataclass(slots=True)
 class MeasurementBatch:
@@ -51,6 +66,11 @@ class MeasurementBatch:
     assignment_tokens: Optional[np.ndarray] = None  # object [n]
     area_tokens: Optional[np.ndarray] = None        # object [n]
     scores: Optional[np.ndarray] = None             # float32 [n], NaN=unscored
+    # lazy-id contract: ids are '{id_prefix}{row}'. The prefix pins the
+    # identity at first need so the store's lazily-persisted ids and any
+    # later edge materialization of the SAME batch agree (row subsets get
+    # fresh prefixes — their row numbering diverges from the parent's)
+    id_prefix: Optional[str] = None
     # batch-level trace marks (stage → epoch ms) — the columnar analog of
     # DeviceEvent.trace for p99 accounting
     trace: Dict[str, float] = field(default_factory=dict)
@@ -199,15 +219,13 @@ class MeasurementBatch:
         if (ets == 0).any():
             ets = np.where(ets == 0, now, ets)
         n = int(values.shape[0])
-        toks = np.concatenate(
-            [np.full((len(c[2]),), c[0], object) for c in chunks]
-        )
-        names = np.concatenate(
-            [np.full((len(c[2]),), c[1], object) for c in chunks]
-        )
+        # ONE np.repeat per object column (C-level pointer fan-out) — a
+        # per-chunk np.full here costs ~0.4 µs/event at ingest rate
+        lens = [len(c[2]) for c in chunks]
+        toks = np.repeat(np.asarray([c[0] for c in chunks], object), lens)
+        names = np.repeat(np.asarray([c[1] for c in chunks], object), lens)
         # group indices come FREE from the chunk structure (one (device,
         # name) per chunk) — O(chunks), no string sort ever
-        lens = [len(c[2]) for c in chunks]
         tok_map: dict = {}
         name_map: dict = {}
         tok_codes = [tok_map.setdefault(c[0], len(tok_map)) for c in chunks]
@@ -238,17 +256,28 @@ class MeasurementBatch:
         ids (event store seal, REST/object materialization) — the scoring
         hot path never pays for them."""
         if self.event_ids is None:
-            prefix = uuid.uuid4().hex[:16] + "-"
-            self.event_ids = np.char.add(
-                prefix, np.arange(self.n).astype("U8")
-            ).astype(object)
+            if self.id_prefix is None:
+                self.id_prefix = uuid.uuid4().hex[:16] + "-"
+            self.event_ids = make_event_ids(self.id_prefix, self.n)
         return self.event_ids
 
     def select(self, idx: np.ndarray) -> "MeasurementBatch":
-        """Row subset (fancy index or bool mask) carrying every column."""
+        """Row subset (fancy index or bool mask) carrying every column.
+
+        Id identity: if this batch's lazy id prefix is already pinned
+        (e.g. the store persisted it lazily), the subset's ids are DERIVED
+        from the parent's prefix + original row numbers — a rule alert's
+        ``origin_event`` must reference the id the store actually holds.
+        Unpinned parents pass laziness through (fresh prefix on demand)."""
         def cut(a):
             return None if a is None else a[idx]
 
+        sel_ids = cut(self.event_ids)
+        if sel_ids is None and self.id_prefix is not None:
+            rows = np.arange(self.n)[idx]
+            sel_ids = np.asarray(
+                [f"{self.id_prefix}{r}" for r in rows.tolist()], object
+            )
         return MeasurementBatch(
             tenant=self.tenant,
             stream_ids=self.stream_ids[idx],
@@ -256,7 +285,7 @@ class MeasurementBatch:
             event_ts=self.event_ts[idx],
             received_ts=self.received_ts[idx],
             valid=self.valid[idx],
-            event_ids=cut(self.event_ids),
+            event_ids=sel_ids,
             device_tokens=cut(self.device_tokens),
             names=cut(self.names),
             assignment_tokens=cut(self.assignment_tokens),

@@ -173,6 +173,7 @@ class SiteWhereInstance(LifecycleComponent):
             self.add_child(self.mqtt_broker)
         self._updates_task: Optional[asyncio.Task] = None
         self._autosave_task: Optional[asyncio.Task] = None
+        self._shared_targets: Optional[list] = None  # see _on_shared_input
         # ONE instance-level subscription for the shared input pattern; it
         # routes to opted-in tenants (cfg.shared_input) or — if none opted
         # in — to the sole tenant. With >=2 tenants and no flag it routes
@@ -210,16 +211,27 @@ class SiteWhereInstance(LifecycleComponent):
         return True
 
     async def _on_shared_input(self, topic: str, payload: bytes) -> None:
-        targets = [
-            rt for rt in self.tenants.values() if rt.config.shared_input
-        ]
-        if not targets and len(self.tenants) == 1:
-            # sole-tenant convenience fallback — but gate on the tenant
-            # REGISTRY, not the live runtime map: during an 'update' op the
-            # runtime is transiently absent while its registration remains,
-            # and shared input must not leak into the other tenant then
-            if len(self.tenant_management.list_tenants()) <= 1:
-                targets = list(self.tenants.values())
+        # routing runs at full ingest rate — recompute only when the
+        # tenant set changes (add/remove invalidate _shared_targets; a
+        # registry-size check catches the create_tenant→apply window so a
+        # second tenant's registration closes the sole-tenant fallback
+        # IMMEDIATELY, before its runtime exists — isolation)
+        targets = self._shared_targets
+        if targets is not None and len(targets) == 1 and not targets[0].config.shared_input:
+            if self.tenant_management.count() > 1:
+                targets = self._shared_targets = None
+        if targets is None:
+            targets = [
+                rt for rt in self.tenants.values() if rt.config.shared_input
+            ]
+            if not targets and len(self.tenants) == 1:
+                # sole-tenant convenience fallback — but gate on the tenant
+                # REGISTRY, not the live runtime map: during an 'update' op
+                # the runtime is transiently absent while its registration
+                # remains, and shared input must not leak then
+                if len(self.tenant_management.list_tenants()) <= 1:
+                    targets = list(self.tenants.values())
+            self._shared_targets = targets
         for rt in targets:
             await rt.source.receiver.submit(payload, topic=topic)
 
@@ -246,6 +258,55 @@ class SiteWhereInstance(LifecycleComponent):
             self.tenants[default_tenant].device_management.bootstrap_fleet(
                 dataset_devices
             )
+
+    def _command_destination(self, cfg: TenantEngineConfig):
+        """Build the tenant's command destination: in-proc sim broker by
+        default; real-wire MQTT/CoAP when the tenant config asks
+        (SURVEY.md §3.2 — the cloud→device half over actual sockets)."""
+        tenant = cfg.tenant
+        spec = cfg.command_destination
+        if not spec:
+            return BrokerCommandDestination(
+                self.broker, f"sitewhere/{tenant}/command/{{device}}"
+            )
+        kind = spec.get("type", "mqtt")
+        if kind == "mqtt":
+            from sitewhere_tpu.pipeline.commands import MqttCommandDestination
+
+            port = int(spec.get("port", 0))
+            if port == 0:
+                # the instance's embedded broker (requires tenants added
+                # after start, when the ephemeral port is bound)
+                if self.mqtt_broker is None or self.mqtt_broker.bound_port is None:
+                    raise ValueError(
+                        "command_destination port 0 needs the embedded "
+                        "MQTT broker running (InstanceConfig.mqtt_broker_port)"
+                    )
+                port = self.mqtt_broker.bound_port
+            # default creds: the tenant's own token/auth secret — the
+            # embedded broker gates CONNECT through authenticate_device
+            rec = self.tenant_management.get_tenant(tenant)
+            return MqttCommandDestination(
+                host=str(spec.get("host", "127.0.0.1")),
+                port=port,
+                topic_pattern=str(spec.get(
+                    "topic_pattern", f"sitewhere/{tenant}/command/{{device}}"
+                )),
+                username=str(spec.get("username", tenant)),
+                password=str(spec.get(
+                    "password", rec.auth_token if rec is not None else ""
+                )),
+                qos=int(spec.get("qos", 1)),
+                client_id=f"cmd-dest-{tenant}",
+            )
+        if kind == "coap":
+            from sitewhere_tpu.pipeline.commands import CoapCommandDestination
+
+            return CoapCommandDestination(
+                path=str(spec.get("path", "command")),
+                timeout_s=float(spec.get("timeout_s", 5.0)),
+            )
+        raise ValueError(f"unknown command_destination type '{kind}'")
 
     # -- tenant runtime construction -------------------------------------
     def _build_tenant(self, cfg: TenantEngineConfig) -> TenantRuntime:
@@ -289,12 +350,25 @@ class SiteWhereInstance(LifecycleComponent):
             from sitewhere_tpu.pipeline.sources import MqttReceiver
 
             mq = dict(cfg.mqtt_ingest)
+            port = int(mq.get("port", 0))
+            if port == 0:
+                # the instance's embedded broker (mirrors the
+                # command_destination convention)
+                if self.mqtt_broker is None or self.mqtt_broker.bound_port is None:
+                    raise ValueError(
+                        "mqtt_ingest port 0 needs the embedded MQTT "
+                        "broker running (InstanceConfig.mqtt_broker_port)"
+                    )
+                port = self.mqtt_broker.bound_port
+            # default creds: the tenant's own token/auth secret — its
+            # ingest subscriber passes the same CONNECT gate as devices
+            rec = self.tenant_management.get_tenant(tenant)
             mqtt_source = EventSource(
                 f"mqtt-net[{tenant}]", tenant, self.bus,
                 MqttReceiver(
                     f"mqtt-recv[{tenant}]",
                     host=mq.get("host", "127.0.0.1"),
-                    port=int(mq.get("port", 1883)),
+                    port=port,
                     # default is TENANT-SCOPED: subscribing every tenant
                     # to the shared 'sitewhere/input/#' would fan one
                     # device's telemetry into every tenant (isolation)
@@ -302,8 +376,11 @@ class SiteWhereInstance(LifecycleComponent):
                         "topics", [f"sitewhere/{tenant}/input/#"]
                     )),
                     qos=int(mq.get("qos", 0)),
-                    username=str(mq.get("username", "")),
-                    password=str(mq.get("password", "")),
+                    username=str(mq.get("username", tenant)),
+                    password=str(mq.get(
+                        "password",
+                        rec.auth_token if rec is not None else "",
+                    )),
                 ),
                 cfg.decoder, self.metrics,
             )
@@ -334,9 +411,7 @@ class SiteWhereInstance(LifecycleComponent):
             registration=RegistrationService(tenant, self.bus, dm, self.metrics),
             commands=CommandDelivery(
                 tenant, self.bus, dm,
-                BrokerCommandDestination(
-                    self.broker, f"sitewhere/{tenant}/command/{{device}}"
-                ),
+                self._command_destination(cfg),
                 metrics=self.metrics,
             ),
             batch=BatchOperationManager(tenant, self.bus, dm, self.metrics),
@@ -351,6 +426,7 @@ class SiteWhereInstance(LifecycleComponent):
         self.bus.undrop(self.bus.naming.tenant_topic(cfg.tenant, ""))
         rt = self._build_tenant(cfg)
         self.tenants[cfg.tenant] = rt
+        self._shared_targets = None
         for comp in rt.components():
             self.add_child(comp)
             if self.state is LifecycleState.STARTED:
@@ -360,6 +436,7 @@ class SiteWhereInstance(LifecycleComponent):
 
     async def remove_tenant(self, tenant: str) -> None:
         rt = self.tenants.pop(tenant, None)
+        self._shared_targets = None
         if rt is None:
             return
         # stop broker ingress FIRST: the closure would otherwise keep

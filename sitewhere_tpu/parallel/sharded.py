@@ -86,6 +86,7 @@ class ShardedScorer:
         max_streams: int = 4096,
         window: int = 32,
         seed: int = 0,
+        wire_dtype: str = "f32",
     ) -> None:
         if spec.score is None:
             raise ValueError(f"model '{spec.name}' has no scorer contract")
@@ -101,6 +102,27 @@ class ShardedScorer:
             )
         self.max_streams = max_streams
         self.window = window
+        # -- wire format for step_counts (the host↔device byte diet) ------
+        # Host↔device bandwidth is a real budget (PCIe on-prem; ~10 MB/s on
+        # the tunneled bench rig, where it IS the e2e ceiling): stream ids
+        # ship as u16 when the per-shard capacity fits, values/scores ship
+        # as bf16/f16 when the tenant opts in, and the bool valid-mask is
+        # replaced by one i32 count per (slot, data-shard) lane — 6 bytes
+        # per event instead of 36 at slots_per_shard=4.
+        import numpy as _np
+        try:
+            import ml_dtypes as _mld
+            _bf16 = _mld.bfloat16
+        except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+            _bf16 = _np.float32
+        if wire_dtype not in ("f32", "bf16", "f16"):
+            raise ValueError(f"wire_dtype must be f32|bf16|f16, got {wire_dtype}")
+        self.wire_dtype = wire_dtype
+        local_cap = max_streams // mm.n_data_shards
+        self.ids_np_dtype = _np.uint16 if local_cap <= 65536 else _np.int32
+        self.vals_np_dtype = {
+            "f32": _np.float32, "bf16": _bf16, "f16": _np.float16,
+        }[wire_dtype]
 
         # identical init per slot; per-tenant training diverges them later
         key = jax.random.PRNGKey(seed)
@@ -135,21 +157,45 @@ class ShardedScorer:
             jnp.ones((self.n_slots,), jnp.float32), t_shard
         )
         self._step = self._build_step()
+        self._step_counts = self._build_step(counts_mode=True)
 
     # -- compiled step ---------------------------------------------------
-    def _build_step(self) -> Callable:
+    def _build_step(self, counts_mode: bool = False) -> Callable:
+        """The scoring jit. Two variants share this builder:
+
+        - mask mode (``step``): per-row bool valid mask, f32 wire — the
+          fully general path (tests, arbitrary row patterns).
+        - counts mode (``step_counts``): rows are front-contiguous per
+          (slot, data-shard) lane, so validity is ONE i32 count per lane,
+          derived on device; ids/values arrive in the thin wire dtypes and
+          scores return in the wire dtype. The service hot path uses this.
+        """
         mesh = self.mm.mesh
         spec, cfg = self.spec, self.cfg
+        score_dtype = (
+            {"f32": jnp.float32, "bf16": jnp.bfloat16, "f16": jnp.float16}[
+                self.wire_dtype
+            ]
+            if counts_mode
+            else jnp.float32
+        )
 
-        def local_step(params, state, active, ids, vals, valid):
+        def local_step(params, state, active, ids, vals, validity):
             # local shapes: params [T_loc, ...], state [T_loc, S_loc, W],
-            # ids/vals/valid [T_loc, B_loc]
-            def one(p, st, act, i, v, m):
+            # ids/vals [T_loc, B_loc]; validity is bool[T_loc, B_loc]
+            # (mask mode) or i32[T_loc, 1] lane counts (counts mode)
+            def one(p, st, act, i, v, m_or_c):
+                if counts_mode:
+                    m = jnp.arange(i.shape[0], dtype=jnp.int32) < m_or_c[0]
+                else:
+                    m = m_or_c
+                i = i.astype(jnp.int32)
+                v = v.astype(jnp.float32)
                 st2, w, n = update_and_gather(st, i, v, m)
                 s = spec.score(p, cfg, w, n)
-                return st2, jnp.where(act & m, s, 0.0)
+                return st2, jnp.where(act & m, s, 0.0).astype(score_dtype)
 
-            return jax.vmap(one)(params, state, active, ids, vals, valid)
+            return jax.vmap(one)(params, state, active, ids, vals, validity)
 
         smapped = jax.shard_map(
             local_step,
@@ -160,7 +206,7 @@ class ShardedScorer:
                 P(AXIS_TENANT),              # active mask
                 P(AXIS_TENANT, AXIS_DATA),   # stream ids (B over data)
                 P(AXIS_TENANT, AXIS_DATA),   # values
-                P(AXIS_TENANT, AXIS_DATA),   # valid
+                P(AXIS_TENANT, AXIS_DATA),   # valid mask / lane counts
             ),
             out_specs=(
                 P(AXIS_TENANT, AXIS_DATA),   # new state
@@ -170,18 +216,27 @@ class ShardedScorer:
         return jax.jit(smapped, donate_argnums=(1,))
 
     def prewarm(self, lane_sizes) -> None:
-        """Compile every bucketed batch shape up front. A first-use compile
-        inside the scoring loop blocks the event loop for seconds (tens of
-        seconds on TPU) and torpedoes p99 — pay it at startup instead.
-        All-invalid rows leave window state untouched (scatter mode=drop)."""
+        """Compile every bucketed batch shape up front (counts wire — the
+        service hot path). A first-use compile inside the scoring loop
+        blocks the event loop for seconds (tens of seconds on TPU) and
+        torpedoes p99 — pay it at startup instead. Zero-count lanes leave
+        window state untouched (scatter mode=drop)."""
         import numpy as _np
 
         t, d = self.n_slots, self.mm.n_data_shards
         for b in sorted(set(int(x) for x in lane_sizes)):
-            ids = _np.zeros((t, d * b), _np.int32)
-            vals = _np.zeros((t, d * b), _np.float32)
-            valid = _np.zeros((t, d * b), bool)
-            _np.asarray(self.step(ids, vals, valid))
+            ids = _np.zeros((t, d * b), self.ids_np_dtype)
+            vals = _np.zeros((t, d * b), self.vals_np_dtype)
+            counts = _np.zeros((t, d), _np.int32)
+            s = self.step_counts(ids, vals, counts)
+            _np.asarray(s)
+            if t > 1:
+                # the single-used-slot d2h slice the flush path takes
+                # (see TpuInferenceService._flush_family) — same rule:
+                # never compile inside the scoring loop
+                # int32 index: the flush path slices with np.unique of
+                # int32 slot ids — dtype must match or it recompiles
+                _np.asarray(s[_np.zeros((1,), _np.int32)])
 
     # chaos knob: >0 makes the next N step() calls raise (fault-injection
     # hook for the auto-failover path, like the bus FaultPlan)
@@ -199,6 +254,24 @@ class ShardedScorer:
             raise RuntimeError("injected scorer fault (chaos)")
         self.state, scores = self._step(
             self.params, self.state, self.active, stream_ids, values, valid
+        )
+        return scores
+
+    def step_counts(
+        self,
+        stream_ids,  # ids_np_dtype[T, D*B] LOCAL ids, front-contiguous/lane
+        values,      # vals_np_dtype[T, D*B]
+        counts,      # i32[T, D] valid rows per (slot, data-shard) lane
+    ) -> jnp.ndarray:
+        """Wire-thin scoring step: validity is one count per lane (rows
+        fill each lane from the front), so no bool mask crosses
+        host→device and ids/values ride the compact wire dtypes. Returns
+        scores in the wire dtype, f32[T, D*B]-shaped."""
+        if self.fault_steps > 0:
+            self.fault_steps -= 1
+            raise RuntimeError("injected scorer fault (chaos)")
+        self.state, scores = self._step_counts(
+            self.params, self.state, self.active, stream_ids, values, counts
         )
         return scores
 
@@ -297,6 +370,7 @@ class ShardedScorer:
             count=jax.device_put(state.count, st_sharding),
         )
         self._step = self._build_step()
+        self._step_counts = self._build_step(counts_mode=True)
         if getattr(self, "_optimizer", None) is not None:
             opt_state = jax.vmap(self._optimizer.init)(self.params)
             self._opt_state = jax.device_put(opt_state, t_shard)

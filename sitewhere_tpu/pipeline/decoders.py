@@ -199,31 +199,52 @@ class BinaryDecoder:
         messages decodes to ``("columns_np", [(device, name, values f32[k],
         event_ts f64[k]), ...])`` — numeric columns come straight off the
         wire via ``np.frombuffer``, zero per-row Python. Anything else
-        falls back to the per-message request path."""
+        falls back to the per-message request path. Parsing is inlined
+        (no _Reader method dispatch): this runs once per wire payload at
+        full ingest rate."""
         import numpy as np
 
-        r = _Reader(payload)
+        data = payload
+        ln = len(data)
+        off = 0
+        unpack = struct.unpack_from
         chunks: List[tuple] = []
-        while r.more:
-            start = r.off
-            if r.u("<H") != MAGIC:
+        while off < ln:
+            if off + 4 > ln:
+                raise DecodeError("truncated binary payload")
+            magic, version, msg = unpack("<HBB", data, off)
+            if magic != MAGIC:
                 raise DecodeError("bad magic")
-            if r.u("<B") != 1:
+            if version != 1:
                 raise DecodeError("unsupported binary version")
-            msg = r.u("<B")
             if msg != _MSG_MEASUREMENTS_BULK:
-                r.off = start
                 return "requests", self.decode(payload, context)
-            device = r.s()
-            name = r.s()
-            count = r.u("<I")
-            base_ts = r.u("<Q")
-            stride = r.u("<I")
+            off += 4
+            dlen = data[off] if off < ln else 0
+            off += 1
+            nend = off + dlen
+            if nend > ln:
+                raise DecodeError("truncated string in binary payload")
+            device = data[off:nend].decode()
+            off = nend
+            if off >= ln:
+                raise DecodeError("truncated binary payload")
+            nlen = data[off]
+            off += 1
+            nend = off + nlen
+            if nend > ln:
+                raise DecodeError("truncated string in binary payload")
+            name = data[off:nend].decode()
+            off = nend
+            if off + 16 > ln:
+                raise DecodeError("truncated binary payload")
+            count, base_ts, stride = unpack("<IQI", data, off)
+            off += 16
             nbytes = count * 4
-            if r.off + nbytes > len(r.data):
+            if off + nbytes > ln:
                 raise DecodeError("truncated bulk values")
-            vals = np.frombuffer(r.data, "<f4", count, r.off)
-            r.off += nbytes
+            vals = np.frombuffer(data, "<f4", count, off)
+            off += nbytes
             ets = base_ts + stride * np.arange(count, dtype=np.float64)
             chunks.append((device, name, vals, ets))
         return "columns_np", chunks

@@ -300,6 +300,19 @@ class TpuInferenceService(MultitenantService):
 
     def scorer_for_family(self, family: str, cfg: TenantEngineConfig) -> ShardedScorer:
         scorer = self.scorers.get(family)
+        if scorer is not None and scorer.wire_dtype != cfg.wire_dtype:
+            # the wire dtype is a property of the FAMILY stack (first
+            # tenant wins); a later tenant asking for a different wire
+            # would silently score at the stack's precision — surface it
+            self._record_error(
+                "wire-dtype",
+                ValueError(
+                    f"tenant '{cfg.tenant}' asked wire_dtype="
+                    f"'{cfg.wire_dtype}' but family '{family}' runs "
+                    f"'{scorer.wire_dtype}' (first tenant pinned it)"
+                ),
+            )
+            self.metrics.counter("tpu_inference.wire_dtype_conflicts").inc()
         if scorer is None:
             spec = get_model(family)
             mcfg = make_config(family, {
@@ -312,6 +325,7 @@ class TpuInferenceService(MultitenantService):
                 slots_per_shard=self.slots_per_shard,
                 max_streams=cfg.max_streams,
                 window=cfg.microbatch.window,
+                wire_dtype=cfg.wire_dtype,
             )
             self.scorers[family] = scorer
             self._lanes[family] = {}
@@ -485,16 +499,24 @@ class TpuInferenceService(MultitenantService):
         # acquire the in-flight slot BEFORE popping rows off the lanes:
         # a cancellation while waiting here must not strand popped rows
         # (everything from the pop to create_task below is await-free).
+        t_acq = time.perf_counter()
         await self._inflight.acquire()
+        self.metrics.histogram("tpu_inference.acquire_wait", unit="s").record(
+            time.perf_counter() - t_acq
+        )
         # pick the bucket AFTER the (possibly long) acquire wait: rows that
         # accumulated while every slot was busy should ride out in ONE
         # bigger flush, not drain at the stale pre-wait size
         pending_max = max((l.count for l in lanes.values()), default=0)
         b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
         t, d = scorer.n_slots, self.mm.n_data_shards
-        ids = np.zeros((t, d * b_lane), np.int32)
-        vals = np.zeros((t, d * b_lane), np.float32)
-        valid = np.zeros((t, d * b_lane), bool)
+        # wire-thin stacked batch: compact id/value dtypes + one count per
+        # (slot, data-shard) lane instead of a bool mask — rows fill each
+        # lane from the front, so validity is derivable on device (see
+        # ShardedScorer.step_counts; h2d bytes are a first-class budget)
+        ids = np.zeros((t, d * b_lane), scorer.ids_np_dtype)
+        vals = np.zeros((t, d * b_lane), scorer.vals_np_dtype)
+        counts = np.zeros((t, d), np.int32)
         tk_slots, tk_cols, tk_seqs, tk_rows = [], [], [], []
         moved = 0
         for (slot, dshard), lane in list(lanes.items()):
@@ -505,7 +527,7 @@ class TpuInferenceService(MultitenantService):
             base = dshard * b_lane
             ids[slot, base : base + k] = li
             vals[slot, base : base + k] = lv
-            valid[slot, base : base + k] = True
+            counts[slot, dshard] = k
             tk_slots.append(np.full((k,), slot, np.int32))
             tk_cols.append(np.arange(base, base + k, dtype=np.int32))
             tk_seqs.append(ls)
@@ -519,14 +541,33 @@ class TpuInferenceService(MultitenantService):
             self._inflight.release()
             return 0
 
+        slots_cat = np.concatenate(tk_slots)
         taken = (
-            np.concatenate(tk_slots),
+            slots_cat,
             np.concatenate(tk_cols),
             np.concatenate(tk_seqs),
             np.concatenate(tk_rows),
         )
         try:
-            scores_dev = scorer.step(ids, vals, valid)  # async dispatch
+            t_disp = time.perf_counter()
+            scores_dev = scorer.step_counts(ids, vals, counts)  # async dispatch
+            self.metrics.histogram("tpu_inference.dispatch", unit="s").record(
+                time.perf_counter() - t_disp
+            )
+            self.metrics.counter("tpu_inference.flushes").inc()
+            self.metrics.counter("tpu_inference.flush_rows").inc(moved)
+            # d2h diet: when ONE slot carries this flush's rows (the common
+            # single-tenant-per-family case), slice that row on device and
+            # materialize 1×lane instead of the full T×lane score plane.
+            # Restricted to len(used)==1 so the gather has ONE shape per
+            # bucket — prewarm compiles it; arbitrary used-counts would
+            # compile mid-loop and stall the pipeline
+            used = np.unique(slots_cat)
+            if len(used) == 1 and t > 1:
+                scores_dev = scores_dev[used]
+                taken = (
+                    np.zeros_like(slots_cat),
+                ) + taken[1:]
         except Exception as exc:  # noqa: BLE001 - a failing scorer must
             # not strand popped rows or kill the loop; repeated failures
             # trigger shard failover
@@ -702,11 +743,17 @@ class TpuInferenceService(MultitenantService):
         buffers later loop-thread calls donate (see
         ``checkpoint.host_copy_params`` for the full invariant)."""
         try:
+            t0 = time.perf_counter()
             scores_np = await asyncio.get_running_loop().run_in_executor(
                 self._deliver_pool, np.asarray, scores_dev
             )
+            self.metrics.histogram(
+                "tpu_inference.materialize", unit="s"
+            ).record(time.perf_counter() - t0)
             slots, cols, seqs, rows = taken
-            await self._resolve_rows(seqs, rows, scores_np[slots, cols])
+            # wire dtype (bf16/f16) widens back to f32 at the batch edge
+            picks = scores_np[slots, cols].astype(np.float32, copy=False)
+            await self._resolve_rows(seqs, rows, picks)
             self._consec_errors.pop(family, None)  # healthy again
             self._failover_rounds.pop(family, None)
         except asyncio.CancelledError:
@@ -746,7 +793,9 @@ class TpuInferenceService(MultitenantService):
 
     # -- main loop -------------------------------------------------------
     async def _scoring_loop(self) -> None:
+        iters = self.metrics.counter("tpu_inference.loop_iters")
         while True:
+            iters.inc()
             moved = 0
             fam_cfgs: Dict[str, Dict[int, TenantEngineConfig]] = {}
             for tenant, engine in list(self.engines.items()):

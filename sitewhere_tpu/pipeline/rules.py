@@ -516,6 +516,38 @@ class RuleEngine(LifecycleComponent):
                 continue
             if hits.size == 0:
                 continue
+            # stateless + cooldown rules: within ONE batch only the first
+            # hit per (device:name) group can pass the cooldown gate, and
+            # groups still cooling down can be skipped outright — compact
+            # BEFORE materializing (an alert-storm batch would otherwise
+            # objectify thousands of rows just to drop them)
+            if (
+                rule.cooldown_ms
+                and not rule.window
+                and not rule.window_time_ms
+                and rule.group_by is None
+            ):
+                codes = batch.pair_codes()[hits]
+                _, first = np.unique(codes, return_index=True)
+                hits = hits[np.sort(first)]
+                lf = rule._last_fired
+                if lf:
+                    now = time.time() * 1000.0
+                    toks, nms = batch.device_tokens, batch.names
+                    keep = [
+                        j
+                        for j, i in enumerate(hits.tolist())
+                        if now - lf.get(f"{toks[i]}:{nms[i]}", 0.0)
+                        >= rule.cooldown_ms
+                    ]
+                    if len(keep) != len(hits):
+                        hits = (
+                            hits[np.asarray(keep, np.intp)]
+                            if keep
+                            else hits[:0]
+                        )
+                if hits.size == 0:
+                    continue
             # hit rows materialize to objects; evaluate() re-applies the
             # scalar filter plus cooldown/window state and runs the action
             for e in batch.select(hits).to_events():

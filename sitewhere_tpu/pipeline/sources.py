@@ -211,7 +211,7 @@ class EventSource(LifecycleComponent):
     # (100s of samples each) can't snowball into monster batches that
     # destabilize downstream flush sizing
     DRAIN = 8192
-    EVENT_CAP = 16384
+    EVENT_CAP = 32768
 
     async def _run(self) -> None:
         decoded_topic = self.bus.naming.decoded_events(self.tenant)

@@ -58,6 +58,10 @@ class TenantEngineConfig:
     training: TrainingConfig = field(default_factory=TrainingConfig)
     max_streams: int = 4096         # window-state capacity (series slots)
     decoder: str = "json"
+    # host↔device wire dtype for scoring values/scores ("f32" | "bf16" |
+    # "f16"): bf16 halves transfer bytes at ~3 significant digits — the
+    # right trade for anomaly scoring over a bandwidth-bound link
+    wire_dtype: str = "f32"
     # streaming-media classification leg (chunks → ViT → events); tiny
     # uses the test-sized ViT so CI exercises the full flow cheaply
     media_pipeline: bool = False
@@ -65,6 +69,12 @@ class TenantEngineConfig:
     # real-socket MQTT ingest: {"host": ..., "port": ..., "topics": [...]}
     # adds an MqttReceiver-backed event source beside the in-proc one
     mqtt_ingest: Optional[Dict[str, Any]] = None
+    # real-wire command delivery destination (default: in-proc sim broker):
+    #   {"type": "mqtt", "host": ..., "port": ..., "topic_pattern": ...,
+    #    "qos": 1}   — port 0 = the instance's embedded MQTT broker
+    #   {"type": "coap", "path": "command"}  — per-device coap_host/
+    #    coap_port metadata addresses the device's CoAP server
+    command_destination: Optional[Dict[str, Any]] = None
     # opt-in to the instance-shared 'sitewhere/input/+' broker pattern; the
     # tenant-scoped 'sitewhere/{tenant}/input/+' pattern is always active.
     # With >1 tenant and no flag, shared-input routes to NO tenant (isolation)

@@ -141,9 +141,18 @@ class DeviceStateService(LifecycleComponent):
                 st.present = True
                 st.presence_missing_ts = None
                 returned.inc()
-        # last occurrence per (device, name): first hit in the reversed view
-        _, first_rev = np.unique(b.pair_codes()[::-1], return_index=True)
-        last_idx = b.n - 1 - first_rev
+        # last occurrence per (device, name): dense scatter-max of the row
+        # index over pair codes (C-level, no sort) when the code space is
+        # small — the reversed-unique sort costs ~1 ms/batch at full rate
+        codes = b.pair_codes()
+        n_codes = len(ut) * len(b.names_index()[0])
+        if n_codes <= 4 * b.n:
+            last_row = np.full((n_codes,), -1, np.int64)
+            np.maximum.at(last_row, codes, np.arange(b.n, dtype=np.int64))
+            last_idx = last_row[last_row >= 0]
+        else:  # pathologically diverse batch: fall back to the sort
+            _, first_rev = np.unique(codes[::-1], return_index=True)
+            last_idx = b.n - 1 - first_rev
         asg = b.assignment_tokens
         scs = b.scores
         vals = b.values

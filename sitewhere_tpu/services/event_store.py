@@ -46,6 +46,16 @@ class EventQuery:
     page_size: int = 100
 
 
+def _pin_prefix(b) -> str:
+    """Pin (or reuse) a batch's lazy event-id prefix (see
+    MeasurementBatch.id_prefix for the identity contract)."""
+    if b.id_prefix is None:
+        import uuid
+
+        b.id_prefix = uuid.uuid4().hex[:16] + "-"
+    return b.id_prefix
+
+
 class _MeasurementColumns:
     """Append-only struct-of-arrays chunk store for measurements."""
 
@@ -99,7 +109,14 @@ class _MeasurementColumns:
 
         self._pending.append(
             {
-                "event_id": b.ensure_event_ids(),
+                # ids stay LAZY (None + the BATCH's pinned prefix) until a
+                # seal or read forces them — id generation is pure overhead
+                # on the steady-state ingest path (~90 ns/row even
+                # vectorized), and sharing the batch's prefix keeps the
+                # persisted ids identical to any later edge
+                # materialization of the same batch (to_events, WS feed)
+                "event_id": b.event_ids,
+                "_idp": None if b.event_ids is not None else _pin_prefix(b),
                 "device_token": col(b.device_tokens),
                 "assignment_token": col(b.assignment_tokens),
                 "area_token": col(b.area_tokens),
@@ -119,6 +136,28 @@ class _MeasurementColumns:
         if self._pending_rows + len(self._cur["value"]) >= self.CHUNK:
             self._seal()
 
+    @staticmethod
+    def _ensure_ids(chunk: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Materialize a chunk's lazy event ids in place (idempotent).
+        Lazy chunks carry ``event_id: None`` plus either ``_idp`` (one
+        prefix) or ``_idsegs`` ([(prefix, n), ...] after a lazy seal)."""
+        from sitewhere_tpu.core.batch import make_event_ids
+
+        if chunk.get("event_id") is not None:
+            chunk.pop("_idp", None)
+            chunk.pop("_idsegs", None)
+            return chunk
+        segs = chunk.pop("_idsegs", None)
+        if segs is None:
+            segs = [(chunk.pop("_idp"), len(chunk["value"]))]
+        else:
+            chunk.pop("_idp", None)
+        parts = [make_event_ids(p, n) for p, n in segs]
+        chunk["event_id"] = (
+            parts[0] if len(parts) == 1 else np.concatenate(parts)
+        )
+        return chunk
+
     def _seal(self) -> None:
         if not self._cur["value"] and not self._pending:
             return
@@ -126,11 +165,29 @@ class _MeasurementColumns:
         parts: List[Dict[str, np.ndarray]] = list(self._pending)
         if self._cur["value"]:
             parts.append(self._cur_arrays())
-        self._chunks.append(
-            parts[0]
-            if len(parts) == 1
-            else {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
-        )
+        if len(parts) == 1:
+            chunk = parts[0]
+        else:
+            # all-lazy parts seal LAZY: carry the (prefix, n) segments
+            # forward instead of paying id generation on the ingest path
+            lazy = all(p.get("event_id") is None for p in parts)
+            if lazy:
+                idsegs: List[tuple] = []
+                for p in parts:
+                    idsegs.extend(
+                        p.get("_idsegs") or [(p["_idp"], len(p["value"]))]
+                    )
+            else:
+                parts = [self._ensure_ids(p) for p in parts]
+            keys = [
+                k for k in parts[0]
+                if not k.startswith("_") and not (lazy and k == "event_id")
+            ]
+            chunk = {k: np.concatenate([p[k] for p in parts]) for k in keys}
+            if lazy:
+                chunk["event_id"] = None
+                chunk["_idsegs"] = idsegs
+        self._chunks.append(chunk)
         self._pending = []
         self._pending_rows = 0
         self._cur = self._fresh()
@@ -151,7 +208,9 @@ class _MeasurementColumns:
         cur = self._cur_arrays()
         if not self._pending:
             return cur
-        parts = list(self._pending) + ([cur] if len(cur["value"]) else [])
+        parts = [self._ensure_ids(p) for p in self._pending] + (
+            [cur] if len(cur["value"]) else []
+        )
         if len(parts) == 1:
             return parts[0]
         return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
@@ -164,9 +223,10 @@ class _MeasurementColumns:
         if self._materialized is not None:
             return self._materialized
         if self._sealed_cache is None and self._chunks:
+            chunks = [self._ensure_ids(ch) for ch in self._chunks]
             self._sealed_cache = {
-                k: np.concatenate([ch[k] for ch in self._chunks])
-                for k in self._chunks[0]
+                k: np.concatenate([ch[k] for ch in chunks])
+                for k in chunks[0]
             }
         tail = self._tail_arrays()
         if self._sealed_cache is None:
@@ -190,8 +250,9 @@ class _MeasurementColumns:
         self._chunks.append(chunk)
 
     def sealed_chunks(self) -> List[Dict[str, np.ndarray]]:
-        """The immutable sealed chunks (checkpoint segment contract)."""
-        return self._chunks
+        """The immutable sealed chunks (checkpoint segment contract).
+        Lazy ids materialize here: checkpoint segments are self-contained."""
+        return [self._ensure_ids(ch) for ch in self._chunks]
 
     def __len__(self) -> int:
         return (

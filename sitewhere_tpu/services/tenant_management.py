@@ -30,6 +30,9 @@ class TenantManagement:
     def list_tenants(self) -> List[Tenant]:
         return sorted(self._tenants.values(), key=lambda t: t.token)
 
+    def count(self) -> int:
+        return len(self._tenants)
+
     def list_templates(self) -> List[str]:
         return sorted(TENANT_TEMPLATES)
 

@@ -36,19 +36,31 @@ class SimBroker:
         self._subs: List[Tuple[str, Handler]] = []
         self.published = 0
         self.delivered = 0
+        # topic → matched handler tuple. Concrete topic names are a small
+        # set (per-device), so wildcard matching runs once per topic, not
+        # once per publish; any (un)subscribe invalidates the whole cache
+        self._route_cache: Dict[str, tuple] = {}
 
     def subscribe(self, pattern: str, handler: Handler) -> None:
         self._subs.append((pattern, handler))
+        self._route_cache.clear()
 
     def unsubscribe(self, handler: Handler) -> None:
         self._subs = [(p, h) for p, h in self._subs if h is not handler]
+        self._route_cache.clear()
 
     async def publish(self, topic: str, payload: bytes) -> int:
         self.published += 1
+        handlers = self._route_cache.get(topic)
+        if handlers is None:
+            if len(self._route_cache) > 65536:  # adversarial topic churn
+                self._route_cache.clear()
+            handlers = self._route_cache[topic] = tuple(
+                h for p, h in self._subs if _topic_matches(p, topic)
+            )
         n = 0
-        for pattern, handler in list(self._subs):
-            if _topic_matches(pattern, topic):
-                await handler(topic, payload)
-                n += 1
+        for handler in handlers:
+            await handler(topic, payload)
+            n += 1
         self.delivered += n
         return n

@@ -106,6 +106,69 @@ class TestShardedScorer:
         assert len(st.sharding.device_set) == 8
 
 
+class TestStepCountsWire:
+    """step_counts (wire-thin hot path) must agree with the masked step."""
+
+    def _twin(self, wire_dtype):
+        mm = MeshManager(tenant=4, data=2)
+        spec = get_model("lstm_ad")
+        cfg = make_config("lstm_ad", {"window": 8, "hidden": 8})
+        return ShardedScorer(
+            mm, spec, cfg, slots_per_shard=2, max_streams=16, window=8,
+            wire_dtype=wire_dtype,
+        )
+
+    def test_counts_matches_mask_f32(self):
+        a, b = self._twin("f32"), self._twin("f32")
+        a.activate(1)
+        b.activate(1)
+        T, D, B = a.n_slots, a.mm.n_data_shards, 4
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            # front-contiguous lanes: k valid rows per (slot, dshard)
+            ids = np.zeros((T, D * B), np.int32)
+            vals = np.zeros((T, D * B), np.float32)
+            counts = np.zeros((T, D), np.int32)
+            mask = np.zeros((T, D * B), bool)
+            for t in range(T):
+                for d in range(D):
+                    k = int(rng.integers(0, B + 1))
+                    base = d * B
+                    ids[t, base:base + k] = rng.integers(0, 8, k)
+                    vals[t, base:base + k] = rng.normal(size=k)
+                    counts[t, d] = k
+                    mask[t, base:base + k] = True
+            sm = np.asarray(a.step(ids, vals, mask))
+            sc = np.asarray(b.step_counts(
+                ids.astype(b.ids_np_dtype), vals.astype(b.vals_np_dtype),
+                counts,
+            ))
+            # every step must agree (state evolves across iterations)
+            np.testing.assert_allclose(sm, sc, rtol=1e-6, atol=1e-6)
+
+    def test_bf16_wire_close_to_f32(self):
+        a, b = self._twin("f32"), self._twin("bf16")
+        a.activate(0)
+        b.activate(0)
+        T, D, B = a.n_slots, a.mm.n_data_shards, 8
+        assert b.ids_np_dtype == np.uint16
+        rng = np.random.default_rng(2)
+        ids = np.broadcast_to(
+            np.arange(D * B, dtype=np.int32) % 8, (T, D * B)
+        ).copy()
+        counts = np.full((T, D), B, np.int32)
+        mask = np.ones((T, D * B), bool)
+        for _ in range(6):
+            vals = rng.normal(size=(T, D * B)).astype(np.float32)
+            sm = np.asarray(a.step(ids, vals, mask))
+            sc = np.asarray(b.step_counts(
+                ids.astype(np.uint16), vals.astype(b.vals_np_dtype), counts
+            )).astype(np.float32)
+            # bf16 wire: ~3 significant digits end to end, every step
+            np.testing.assert_allclose(sm, sc, rtol=0.1, atol=0.05)
+        assert np.any(sc != 0.0)
+
+
 def test_stack_unstack_roundtrip():
     spec = get_model("lstm_ad")
     cfg = make_config("lstm_ad", {"hidden": 4})
