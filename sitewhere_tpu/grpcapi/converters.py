@@ -200,3 +200,115 @@ def alert_from_proto(p: pb.DeviceAlert) -> DeviceAlert:
         message=p.message,
         event_ts=p.event_ts,
     )
+
+
+# -- asset / schedule / batch / user / command planes (round-5 parity) ----
+
+def asset_type_to_proto(at) -> pb.AssetType:
+    return pb.AssetType(
+        token=at.token, name=at.name, description=at.description,
+        asset_category=at.asset_category,
+    )
+
+
+def asset_type_from_proto(p: pb.AssetType):
+    from sitewhere_tpu.core.model import AssetType
+
+    kw = {"token": p.token} if p.token else {}
+    return AssetType(
+        name=p.name, description=p.description,
+        asset_category=p.asset_category or "device", **kw,
+    )
+
+
+def asset_to_proto(a) -> pb.Asset:
+    return pb.Asset(
+        token=a.token, name=a.name, description=a.description,
+        asset_type_token=a.asset_type_token, image_url=a.image_url,
+    )
+
+
+def asset_from_proto(p: pb.Asset):
+    from sitewhere_tpu.core.model import Asset
+
+    kw = {"token": p.token} if p.token else {}
+    return Asset(
+        name=p.name, description=p.description,
+        asset_type_token=p.asset_type_token, image_url=p.image_url, **kw,
+    )
+
+
+def schedule_to_proto(s) -> pb.Schedule:
+    return pb.Schedule(
+        token=s.token, name=s.name, at_ts=s.at_ts, every_s=s.every_s,
+        cron=s.cron, end_ts=s.end_ts, command_token=s.command_token,
+        device_tokens=list(s.device_tokens),
+        parameters=dict(s.parameters), enabled=s.enabled,
+        fire_count=s.fire_count,
+    )
+
+
+def schedule_from_proto(p: pb.Schedule):
+    from sitewhere_tpu.services.schedule_management import Schedule
+
+    kw = {"token": p.token} if p.token else {}
+    return Schedule(
+        name=p.name, at_ts=p.at_ts, every_s=p.every_s, cron=p.cron,
+        end_ts=p.end_ts, command_token=p.command_token,
+        device_tokens=list(p.device_tokens),
+        parameters=dict(p.parameters), enabled=p.enabled, **kw,
+    )
+
+
+def batch_op_to_proto(op) -> pb.BatchOperation:
+    return pb.BatchOperation(
+        token=op.token, command_token=op.command_token,
+        parameters=dict(op.parameters), status=op.status.value,
+        elements=[
+            pb.BatchElement(
+                device_token=el.device_token, status=el.status.value,
+                error=el.error, processed_ts=el.processed_ts,
+            )
+            for el in op.elements
+        ],
+        created_ts=op.created_ts, finished_ts=op.finished_ts,
+    )
+
+
+def user_to_proto(u) -> pb.User:
+    # never carries password material (hash/salt stay server-side)
+    return pb.User(
+        username=u.username, first_name=u.first_name, last_name=u.last_name,
+        authorities=list(u.authorities), enabled=u.enabled,
+        created_ts=u.created_ts,
+    )
+
+
+def command_to_proto(c) -> pb.DeviceCommand:
+    return pb.DeviceCommand(
+        token=c.token, name=c.name, namespace=c.namespace,
+        description=c.description,
+        parameters=[
+            pb.CommandParameter(
+                name=p.get("name", ""), type=p.get("type", "string"),
+                required=str(p.get("required", "false")).lower() == "true",
+            )
+            for p in c.parameters
+        ],
+    )
+
+
+def command_from_proto(p: pb.DeviceCommand):
+    from sitewhere_tpu.core.model import DeviceCommand
+
+    kw = {"token": p.token} if p.token else {}
+    return DeviceCommand(
+        name=p.name, namespace=p.namespace or "default",
+        description=p.description,
+        parameters=[
+            {"name": cp.name, "type": cp.type or "string",
+             "required": "true" if cp.required else "false"}
+            for cp in p.parameters
+        ],
+        **kw,
+    )

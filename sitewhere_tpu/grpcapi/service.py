@@ -26,6 +26,7 @@ from sitewhere_tpu.grpcapi import converters as cv
 from sitewhere_tpu.grpcapi import sitewhere_pb2 as pb
 from sitewhere_tpu.services.event_store import EventQuery
 from sitewhere_tpu.services.user_management import (
+    AUTH_ADMIN,
     AUTH_DEVICE_MANAGE,
     AUTH_EVENT_VIEW,
     AUTH_TENANT_ADMIN,
@@ -42,6 +43,14 @@ class MethodSpec:
     response_cls: type
     authority: Optional[str] = None   # None = any valid token
     tenant_scoped: bool = True
+
+
+def _paginate(items, paging) -> Tuple[list, int]:
+    """Shared in-servicer pagination (1-based page, default size 100)."""
+    page = paging.page or 1
+    size = paging.page_size or 100
+    lo = (page - 1) * size
+    return items[lo:lo + size], len(items)
 
 
 class _Ctx:
@@ -235,6 +244,194 @@ class TenantManagementServicer:
         return pb.Empty()
 
 
+class AssetManagementServicer:
+    SERVICE = "sitewhere.grpc.AssetManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def CreateAssetType(self, req: pb.AssetType, ctx: _Ctx) -> pb.AssetType:
+        at = ctx.runtime.asset_management.create_asset_type(
+            cv.asset_type_from_proto(req)
+        )
+        return cv.asset_type_to_proto(at)
+
+    async def ListAssetTypes(self, req: pb.Paging, ctx: _Ctx) -> pb.AssetTypeList:
+        items, total = ctx.runtime.asset_management.list_asset_types(
+            page=req.page or 1, page_size=req.page_size or 100
+        )
+        return pb.AssetTypeList(
+            asset_types=[cv.asset_type_to_proto(t) for t in items], total=total
+        )
+
+    async def CreateAsset(self, req: pb.Asset, ctx: _Ctx) -> pb.Asset:
+        a = ctx.runtime.asset_management.create_asset(cv.asset_from_proto(req))
+        return cv.asset_to_proto(a)
+
+    async def GetAsset(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Asset:
+        a = ctx.runtime.asset_management.get_asset(req.token)
+        if a is None:
+            raise KeyError(req.token)
+        return cv.asset_to_proto(a)
+
+    async def ListAssets(self, req: pb.AssetListRequest, ctx: _Ctx) -> pb.AssetList:
+        items, total = ctx.runtime.asset_management.list_assets(
+            page=req.paging.page or 1, page_size=req.paging.page_size or 100,
+            asset_type=req.asset_type_token,
+        )
+        return pb.AssetList(
+            assets=[cv.asset_to_proto(a) for a in items], total=total
+        )
+
+    async def DeleteAsset(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Empty:
+        ctx.runtime.asset_management.delete_asset(req.token)
+        return pb.Empty()
+
+
+class ScheduleManagementServicer:
+    SERVICE = "sitewhere.grpc.ScheduleManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def CreateSchedule(self, req: pb.Schedule, ctx: _Ctx) -> pb.Schedule:
+        s = ctx.runtime.schedules.create_schedule(cv.schedule_from_proto(req))
+        return cv.schedule_to_proto(s)
+
+    async def GetSchedule(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Schedule:
+        s = ctx.runtime.schedules.get_schedule(req.token)
+        if s is None:
+            raise KeyError(req.token)
+        return cv.schedule_to_proto(s)
+
+    async def ListSchedules(self, req: pb.Paging, ctx: _Ctx) -> pb.ScheduleList:
+        page, total = _paginate(ctx.runtime.schedules.list_schedules(), req)
+        return pb.ScheduleList(
+            schedules=[cv.schedule_to_proto(s) for s in page], total=total,
+        )
+
+    async def DeleteSchedule(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Empty:
+        ctx.runtime.schedules.delete_schedule(req.token)
+        return pb.Empty()
+
+
+class BatchManagementServicer:
+    SERVICE = "sitewhere.grpc.BatchManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def CreateBatchOperation(
+        self, req: pb.BatchCreateRequest, ctx: _Ctx
+    ) -> pb.BatchOperation:
+        op = ctx.runtime.batch.create_operation(
+            req.command_token,
+            device_tokens=list(req.device_tokens) or None,
+            group_token=req.group_token,
+            role=req.role,
+            parameters=dict(req.parameters),
+        )
+        if req.submit:
+            await ctx.runtime.batch.submit(op.token)
+        return cv.batch_op_to_proto(op)
+
+    async def GetBatchOperation(
+        self, req: pb.TokenRequest, ctx: _Ctx
+    ) -> pb.BatchOperation:
+        op = ctx.runtime.batch.get_operation(req.token)
+        if op is None:
+            raise KeyError(req.token)
+        return cv.batch_op_to_proto(op)
+
+    async def ListBatchOperations(
+        self, req: pb.Paging, ctx: _Ctx
+    ) -> pb.BatchOperationList:
+        ops = sorted(
+            ctx.runtime.batch.operations.values(),
+            key=lambda o: o.created_ts,
+        )
+        page, total = _paginate(ops, req)
+        return pb.BatchOperationList(
+            operations=[cv.batch_op_to_proto(o) for o in page], total=total,
+        )
+
+    async def CancelBatchOperation(
+        self, req: pb.TokenRequest, ctx: _Ctx
+    ) -> pb.BatchOperation:
+        ctx.runtime.batch.cancel(req.token)
+        op = ctx.runtime.batch.get_operation(req.token)
+        if op is None:
+            raise KeyError(req.token)
+        return cv.batch_op_to_proto(op)
+
+
+class UserManagementServicer:
+    SERVICE = "sitewhere.grpc.UserManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def CreateUser(self, req: pb.UserCreateRequest, ctx: _Ctx) -> pb.User:
+        u = self.instance.users.create_user(
+            req.username, req.password, list(req.authorities),
+            first_name=req.first_name, last_name=req.last_name,
+        )
+        return cv.user_to_proto(u)
+
+    async def GetUser(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.User:
+        u = self.instance.users.get_user(req.token)
+        if u is None:
+            raise KeyError(req.token)
+        return cv.user_to_proto(u)
+
+    async def ListUsers(self, req: pb.Paging, ctx: _Ctx) -> pb.UserList:
+        page, total = _paginate(self.instance.users.list_users(), req)
+        return pb.UserList(
+            users=[cv.user_to_proto(u) for u in page], total=total,
+        )
+
+    async def DeleteUser(self, req: pb.TokenRequest, ctx: _Ctx) -> pb.Empty:
+        self.instance.users.delete_user(req.token)
+        return pb.Empty()
+
+
+class CommandManagementServicer:
+    SERVICE = "sitewhere.grpc.CommandManagement"
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    async def AddCommand(self, req: pb.AddCommandRequest, ctx: _Ctx) -> pb.DeviceCommand:
+        cmd = ctx.runtime.device_management.add_command(
+            req.device_type_token, cv.command_from_proto(req.command)
+        )
+        return cv.command_to_proto(cmd)
+
+    async def InvokeCommand(
+        self, req: pb.InvokeCommandRequest, ctx: _Ctx
+    ) -> pb.CommandInvocationAck:
+        """The §3.2 write path over gRPC: create + dispatch an invocation
+        through the command-invocations topic (same as the REST plane)."""
+        from sitewhere_tpu.core.events import DeviceCommandInvocation
+
+        rt = ctx.runtime
+        asg = rt.device_management.get_assignment(req.assignment_token)
+        if asg is None:
+            raise KeyError(req.assignment_token)
+        inv = DeviceCommandInvocation(
+            device_token=asg.device_token,
+            assignment_token=asg.token,
+            tenant=rt.tenant,
+            command_token=req.command_token,
+            initiator=req.initiator or "grpc",
+            parameters=dict(req.parameters),
+        )
+        await self.instance.bus.publish(
+            self.instance.bus.naming.command_invocations(rt.tenant), inv
+        )
+        return pb.CommandInvocationAck(invocation_id=inv.id)
+
+
 # ---------------------------------------------------------------- registry
 # (service class, method name, request, response, authority-for-mutations,
 # tenant-scoped). Keep in sync with protos/sitewhere.proto.
@@ -282,12 +479,66 @@ METHODS: Tuple[MethodSpec, ...] = (
                pb.TenantUpdateRequest, pb.Tenant, AUTH_TENANT_ADMIN, False),
     MethodSpec("sitewhere.grpc.TenantManagement", "DeleteTenant",
                pb.TokenRequest, pb.Empty, AUTH_TENANT_ADMIN, False),
+    # AssetManagement
+    MethodSpec("sitewhere.grpc.AssetManagement", "CreateAssetType",
+               pb.AssetType, pb.AssetType, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.AssetManagement", "ListAssetTypes",
+               pb.Paging, pb.AssetTypeList),
+    MethodSpec("sitewhere.grpc.AssetManagement", "CreateAsset",
+               pb.Asset, pb.Asset, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.AssetManagement", "GetAsset",
+               pb.TokenRequest, pb.Asset),
+    MethodSpec("sitewhere.grpc.AssetManagement", "ListAssets",
+               pb.AssetListRequest, pb.AssetList),
+    MethodSpec("sitewhere.grpc.AssetManagement", "DeleteAsset",
+               pb.TokenRequest, pb.Empty, AUTH_DEVICE_MANAGE),
+    # ScheduleManagement
+    MethodSpec("sitewhere.grpc.ScheduleManagement", "CreateSchedule",
+               pb.Schedule, pb.Schedule, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.ScheduleManagement", "GetSchedule",
+               pb.TokenRequest, pb.Schedule),
+    MethodSpec("sitewhere.grpc.ScheduleManagement", "ListSchedules",
+               pb.Paging, pb.ScheduleList),
+    MethodSpec("sitewhere.grpc.ScheduleManagement", "DeleteSchedule",
+               pb.TokenRequest, pb.Empty, AUTH_DEVICE_MANAGE),
+    # BatchManagement
+    MethodSpec("sitewhere.grpc.BatchManagement", "CreateBatchOperation",
+               pb.BatchCreateRequest, pb.BatchOperation, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.BatchManagement", "GetBatchOperation",
+               pb.TokenRequest, pb.BatchOperation),
+    MethodSpec("sitewhere.grpc.BatchManagement", "ListBatchOperations",
+               pb.Paging, pb.BatchOperationList),
+    MethodSpec("sitewhere.grpc.BatchManagement", "CancelBatchOperation",
+               pb.TokenRequest, pb.BatchOperation, AUTH_DEVICE_MANAGE),
+    # UserManagement (instance-scoped). ADMIN on every method, matching
+    # the REST plane: CreateUser accepts arbitrary authorities, so any
+    # weaker gate is a privilege-escalation path, and user enumeration is
+    # admin-only on REST too
+    MethodSpec("sitewhere.grpc.UserManagement", "CreateUser",
+               pb.UserCreateRequest, pb.User, AUTH_ADMIN, False),
+    MethodSpec("sitewhere.grpc.UserManagement", "GetUser",
+               pb.TokenRequest, pb.User, AUTH_ADMIN, False),
+    MethodSpec("sitewhere.grpc.UserManagement", "ListUsers",
+               pb.Paging, pb.UserList, AUTH_ADMIN, False),
+    MethodSpec("sitewhere.grpc.UserManagement", "DeleteUser",
+               pb.TokenRequest, pb.Empty, AUTH_ADMIN, False),
+    # CommandManagement
+    MethodSpec("sitewhere.grpc.CommandManagement", "AddCommand",
+               pb.AddCommandRequest, pb.DeviceCommand, AUTH_DEVICE_MANAGE),
+    MethodSpec("sitewhere.grpc.CommandManagement", "InvokeCommand",
+               pb.InvokeCommandRequest, pb.CommandInvocationAck,
+               AUTH_DEVICE_MANAGE),
 )
 
 SERVICERS = {
     "sitewhere.grpc.DeviceManagement": DeviceManagementServicer,
     "sitewhere.grpc.EventManagement": EventManagementServicer,
     "sitewhere.grpc.TenantManagement": TenantManagementServicer,
+    "sitewhere.grpc.AssetManagement": AssetManagementServicer,
+    "sitewhere.grpc.ScheduleManagement": ScheduleManagementServicer,
+    "sitewhere.grpc.BatchManagement": BatchManagementServicer,
+    "sitewhere.grpc.UserManagement": UserManagementServicer,
+    "sitewhere.grpc.CommandManagement": CommandManagementServicer,
 }
 
 

@@ -350,19 +350,22 @@ class SiteWhereInstance(LifecycleComponent):
             from sitewhere_tpu.pipeline.sources import MqttReceiver
 
             mq = dict(cfg.mqtt_ingest)
-            port = int(mq.get("port", 0))
-            if port == 0:
-                # the instance's embedded broker (mirrors the
-                # command_destination convention)
+            # port 0 = the instance's embedded broker (mirrors the
+            # command_destination convention); omitted = standard 1883
+            # against an external broker, exactly as before round 5
+            port = int(mq.get("port", 1883))
+            embedded = port == 0
+            if embedded:
                 if self.mqtt_broker is None or self.mqtt_broker.bound_port is None:
                     raise ValueError(
                         "mqtt_ingest port 0 needs the embedded MQTT "
                         "broker running (InstanceConfig.mqtt_broker_port)"
                     )
                 port = self.mqtt_broker.bound_port
-            # default creds: the tenant's own token/auth secret — its
-            # ingest subscriber passes the same CONNECT gate as devices
-            rec = self.tenant_management.get_tenant(tenant)
+            # embedded-broker creds default to the tenant's own token/auth
+            # secret (its subscriber passes the same CONNECT gate as
+            # devices); external brokers keep the anonymous default
+            rec = self.tenant_management.get_tenant(tenant) if embedded else None
             mqtt_source = EventSource(
                 f"mqtt-net[{tenant}]", tenant, self.bus,
                 MqttReceiver(
@@ -376,7 +379,9 @@ class SiteWhereInstance(LifecycleComponent):
                         "topics", [f"sitewhere/{tenant}/input/#"]
                     )),
                     qos=int(mq.get("qos", 0)),
-                    username=str(mq.get("username", tenant)),
+                    username=str(mq.get(
+                        "username", tenant if embedded else ""
+                    )),
                     password=str(mq.get(
                         "password",
                         rec.auth_token if rec is not None else "",

@@ -120,6 +120,110 @@ class CollectingDestination:
         self.deliveries.append((device.token, payload, inv.id))
 
 
+class MqttCommandDestination:
+    """Per-device command delivery over a REAL MQTT socket — the cloud→
+    device half of the wire loop (reference: the MQTT command destination
+    + parameter extractor in service-command-delivery, SURVEY.md §3.2 [U];
+    reference mount empty, see provenance banner).
+
+    Built on the in-repo MQTT 3.1.1 client (``comm.mqtt.MqttClient``):
+    connects lazily on first delivery, publishes the encoded frame to the
+    per-device topic at QoS 1 (broker PUBACK confirms the handoff), and on
+    any socket error drops the connection so the next invocation
+    reconnects — the failed invocation itself rides the undelivered topic
+    via CommandDelivery's normal fail path."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        topic_pattern: str = "sitewhere/{tenant}/command/{device}",
+        username: str = "",
+        password: str = "",
+        qos: int = 1,
+        client_id: str = "",
+    ) -> None:
+        self.host, self.port = host, port
+        self.topic_pattern = topic_pattern
+        self.username, self.password = username, password
+        self.qos = qos
+        self.client_id = client_id or f"cmd-dest-{id(self):x}"
+        self._client = None
+        self._lock = asyncio.Lock()
+
+    async def _ensure(self):
+        async with self._lock:
+            if self._client is None:
+                from sitewhere_tpu.comm.mqtt import MqttClient
+
+                self._client = await MqttClient(
+                    self.host, self.port, client_id=self.client_id,
+                    username=self.username, password=self.password,
+                ).connect()
+            return self._client
+
+    async def deliver(self, device: Device, payload: bytes, inv) -> None:
+        client = await self._ensure()
+        topic = self.topic_pattern.format(
+            device=device.token, tenant=getattr(inv, "tenant", ""),
+        )
+        try:
+            await client.publish(topic, payload, qos=self.qos)
+        except Exception:
+            # poisoned connection: tear down so the next deliver dials fresh
+            self._client = None
+            try:
+                await client.disconnect()
+            except Exception:  # noqa: BLE001 - already broken
+                pass
+            raise
+
+    async def close(self) -> None:
+        async with self._lock:
+            if self._client is not None:
+                await self._client.disconnect()
+                self._client = None
+
+
+class CoapCommandDestination:
+    """Command delivery over CoAP/UDP (reference: the CoAP command
+    destination [U]): POSTs the encoded frame to the device's own CoAP
+    server at ``/command``. Device addressing comes from a resolver
+    callable (default: the device's ``coap_host``/``coap_port`` metadata —
+    registration can record the observed source address there)."""
+
+    def __init__(self, resolver=None, path: str = "command",
+                 timeout_s: float = 5.0) -> None:
+        self.resolver = resolver or self._metadata_resolver
+        self.path = path
+        self.timeout_s = timeout_s
+
+    @staticmethod
+    def _metadata_resolver(device: Device):
+        host = device.metadata.get("coap_host", "")
+        port = device.metadata.get("coap_port", "")
+        if not host or not port:
+            raise CommandEncodeError(
+                f"device '{device.token}' has no coap_host/coap_port metadata"
+            )
+        return host, int(port)
+
+    async def deliver(self, device: Device, payload: bytes, inv) -> None:
+        from sitewhere_tpu.comm.coap import CoapClient
+
+        host, port = self.resolver(device)
+        code = await CoapClient(host, port).post(
+            self.path, payload,
+            queries={"invocation": inv.id},
+            timeout_s=self.timeout_s,
+        )
+        if (code >> 5) != 2:  # not a 2.xx success class
+            raise ConnectionError(
+                f"CoAP command POST to {host}:{port} returned "
+                f"{code >> 5}.{code & 0x1F:02d}"
+            )
+
+
 class CommandDelivery(LifecycleComponent):
     """Per-tenant command-delivery stage."""
 
@@ -158,6 +262,9 @@ class CommandDelivery(LifecycleComponent):
     async def on_stop(self) -> None:
         await cancel_and_wait(self._task)
         self._task = None
+        close = getattr(self.destination, "close", None)
+        if close is not None:  # real-wire destinations own a socket
+            await close()
 
     async def _run(self) -> None:
         src = self.bus.naming.command_invocations(self.tenant)

@@ -124,6 +124,11 @@ class Rule:
     group_by: Optional[Callable[[DeviceEvent], str]] = None
     action: Optional[Action] = None
     cooldown_ms: int = 0                     # suppress re-fire per group
+    # declares vector_where EXACTLY row-equivalent to the scalar where —
+    # enables the engine's cooldown pre-compaction (first hit per group).
+    # A rule whose vector_where over-approximates where must leave this
+    # False, or non-first rows that where would have accepted get dropped
+    vector_exact: bool = False
 
     _windows: Dict[str, SlidingWindow] = field(default_factory=dict)
     _last_fired: Dict[str, float] = field(default_factory=dict)
@@ -246,6 +251,7 @@ def threshold_rule(
         vector_where=vec,
         action=alert_action(alert_type, level, f"{measurement} {op} {threshold}"),
         cooldown_ms=cooldown_ms,
+        vector_exact=True,
     )
 
 
@@ -270,6 +276,7 @@ def anomaly_score_rule(
         vector_where=vec,
         action=alert_action("anomaly", level, "tpu anomaly score"),
         cooldown_ms=cooldown_ms,
+        vector_exact=True,
     )
 
 
@@ -523,6 +530,7 @@ class RuleEngine(LifecycleComponent):
             # objectify thousands of rows just to drop them)
             if (
                 rule.cooldown_ms
+                and rule.vector_exact
                 and not rule.window
                 and not rule.window_time_ms
                 and rule.group_by is None

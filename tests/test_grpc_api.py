@@ -202,3 +202,124 @@ async def test_grpc_and_rest_see_the_same_platform():
             assert (await r.json())["name"] == "xplane"
         finally:
             await rest.close()
+
+
+# ---------------------------------------------------------------- round-5
+# parity: asset / schedule / batch / user / command planes over gRPC
+
+
+async def test_asset_plane_roundtrip():
+    async with grpc_ctx() as (client, inst):
+        AM = "AssetManagement"
+        at = await client.call(AM, "CreateAssetType", pb.AssetType(
+            name="pump", asset_category="hardware",
+        ))
+        assert at.token
+        a = await client.call(AM, "CreateAsset", pb.Asset(
+            name="pump-1", asset_type_token=at.token,
+        ))
+        got = await client.call(AM, "GetAsset", pb.TokenRequest(token=a.token))
+        assert got.name == "pump-1" and got.asset_type_token == at.token
+        lst = await client.call(AM, "ListAssets", pb.AssetListRequest(
+            paging=pb.Paging(page=1, page_size=10),
+        ))
+        assert lst.total == 1 and lst.assets[0].token == a.token
+        types = await client.call(AM, "ListAssetTypes", pb.Paging(page=1, page_size=10))
+        assert types.total == 1
+        await client.call(AM, "DeleteAsset", pb.TokenRequest(token=a.token))
+        lst = await client.call(AM, "ListAssets", pb.AssetListRequest())
+        assert lst.total == 0
+
+
+async def test_schedule_plane_roundtrip():
+    async with grpc_ctx() as (client, inst):
+        SM = "ScheduleManagement"
+        s = await client.call(SM, "CreateSchedule", pb.Schedule(
+            name="hourly-ping", cron="0 * * * *",
+            command_token="cmd-ping", device_tokens=["dev-00000"],
+            parameters={"x": "1"}, enabled=True,
+        ))
+        assert s.token and s.cron == "0 * * * *"
+        got = await client.call(SM, "GetSchedule", pb.TokenRequest(token=s.token))
+        assert got.name == "hourly-ping" and got.parameters["x"] == "1"
+        lst = await client.call(SM, "ListSchedules", pb.Paging())
+        assert lst.total == 1
+        await client.call(SM, "DeleteSchedule", pb.TokenRequest(token=s.token))
+        lst = await client.call(SM, "ListSchedules", pb.Paging())
+        assert lst.total == 0
+
+
+async def test_user_plane_roundtrip():
+    async with grpc_ctx() as (client, inst):
+        UM = "UserManagement"
+        u = await client.call(UM, "CreateUser", pb.UserCreateRequest(
+            username="ops", password="secret",
+            authorities=["ROLE_EVENT_VIEW"], first_name="Op",
+        ))
+        assert u.username == "ops" and "ROLE_EVENT_VIEW" in u.authorities
+        got = await client.call(UM, "GetUser", pb.TokenRequest(token="ops"))
+        assert got.first_name == "Op" and got.enabled
+        lst = await client.call(UM, "ListUsers", pb.Paging())
+        assert lst.total >= 2  # admin + ops
+        # the proto never carries password material
+        assert not any(
+            f.name in ("password", "password_hash", "salt")
+            for f in pb.User.DESCRIPTOR.fields
+        )
+        await client.call(UM, "DeleteUser", pb.TokenRequest(token="ops"))
+        assert inst.users.get_user("ops") is None
+
+
+async def test_command_and_batch_planes_roundtrip():
+    async with grpc_ctx() as (client, inst):
+        CM = "CommandManagement"
+        BM = "BatchManagement"
+        rt = inst.tenants["default"]
+        types = await client.call(
+            "DeviceManagement", "ListDeviceTypes",
+            pb.Paging(page=1, page_size=10),
+        )
+        dt_token = types.device_types[0].token
+        cmd = await client.call(CM, "AddCommand", pb.AddCommandRequest(
+            device_type_token=dt_token,
+            command=pb.DeviceCommand(
+                name="reboot",
+                parameters=[pb.CommandParameter(
+                    name="delay", type="int64", required=True,
+                )],
+            ),
+        ))
+        assert cmd.token and cmd.parameters[0].name == "delay"
+
+        # single invocation through the command plane
+        asg = rt.device_management.active_assignment_for("dev-00000")
+        ack = await client.call(CM, "InvokeCommand", pb.InvokeCommandRequest(
+            assignment_token=asg.token, command_token=cmd.token,
+            parameters={"delay": "3"},
+        ))
+        assert ack.invocation_id
+        delivered = inst.metrics.counter("command_delivery.delivered")
+        for _ in range(200):
+            if delivered.value >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert delivered.value == 1
+
+        # batch operation over an explicit device list, submitted
+        op = await client.call(BM, "CreateBatchOperation", pb.BatchCreateRequest(
+            command_token=cmd.token,
+            device_tokens=[f"dev-{i:05d}" for i in range(3)],
+            parameters={"delay": "1"},
+            submit=True,
+        ))
+        assert op.token and len(op.elements) == 3
+        for _ in range(300):
+            got = await client.call(BM, "GetBatchOperation",
+                                    pb.TokenRequest(token=op.token))
+            if got.status == "done":
+                break
+            await asyncio.sleep(0.02)
+        assert got.status == "done"
+        assert all(el.status == "succeeded" for el in got.elements)
+        lst = await client.call(BM, "ListBatchOperations", pb.Paging())
+        assert lst.total == 1
