@@ -252,11 +252,13 @@ def schedule_from_proto(p: pb.Schedule):
     from sitewhere_tpu.services.schedule_management import Schedule
 
     kw = {"token": p.token} if p.token else {}
+    # proto3-optional: unset → dataclass default True (see the .proto note)
+    enabled = p.enabled if p.HasField("enabled") else True
     return Schedule(
         name=p.name, at_ts=p.at_ts, every_s=p.every_s, cron=p.cron,
         end_ts=p.end_ts, command_token=p.command_token,
         device_tokens=list(p.device_tokens),
-        parameters=dict(p.parameters), enabled=p.enabled, **kw,
+        parameters=dict(p.parameters), enabled=enabled, **kw,
     )
 
 

@@ -424,8 +424,13 @@ class CommandManagementServicer:
             tenant=rt.tenant,
             command_token=req.command_token,
             initiator=req.initiator or "grpc",
+            initiator_id=ctx.claims.get("sub", ""),
             parameters=dict(req.parameters),
         )
+        # persist BEFORE dispatch, like the REST plane: the device's later
+        # command_response references this id, and the invocation must be
+        # visible to event queries (the cloud→device audit trail)
+        rt.event_store.add_event(inv)
         await self.instance.bus.publish(
             self.instance.bus.naming.command_invocations(rt.tenant), inv
         )

@@ -151,15 +151,24 @@ class MqttCommandDestination:
         self._client = None
         self._lock = asyncio.Lock()
 
+    CONNECT_TIMEOUT_S = 10.0
+
     async def _ensure(self):
         async with self._lock:
             if self._client is None:
                 from sitewhere_tpu.comm.mqtt import MqttClient
 
-                self._client = await MqttClient(
-                    self.host, self.port, client_id=self.client_id,
-                    username=self.username, password=self.password,
-                ).connect()
+                # bounded dial: a blackholed broker must not wedge the
+                # serial delivery loop for the kernel TCP timeout while
+                # holding the lock (failed invocations ride the
+                # undelivered topic instead)
+                self._client = await asyncio.wait_for(
+                    MqttClient(
+                        self.host, self.port, client_id=self.client_id,
+                        username=self.username, password=self.password,
+                    ).connect(),
+                    self.CONNECT_TIMEOUT_S,
+                )
             return self._client
 
     async def deliver(self, device: Device, payload: bytes, inv) -> None:
