@@ -1,0 +1,175 @@
+"""Minimal admin console — the L7 layer (reference: sitewhere-admin-ui,
+a SEPARATE Vue repo upstream — SURVEY.md:71 [U]; reference mount empty,
+see provenance banner).
+
+One static, dependency-free HTML page served at ``/admin`` over the
+existing REST + WebSocket surface: JWT login, tenant switcher, instance
+topology, device/assignment tables, the live persisted-event feed, and a
+north-star metrics strip scraped from /metrics. Everything is plain
+fetch()/WebSocket against the documented API — the console holds no
+privileged path into the instance.
+"""
+
+CONSOLE_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>SiteWhere-TPU Console</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+:root{--bg:#111418;--panel:#1a1f26;--line:#2a313b;--fg:#e6e8eb;
+--dim:#8b949e;--acc:#4f8cc9;--ok:#4fa56b;--warn:#c9804f}
+*{box-sizing:border-box}
+body{margin:0;background:var(--bg);color:var(--fg);
+font:14px/1.45 system-ui,sans-serif}
+header{display:flex;align-items:center;gap:12px;padding:10px 16px;
+border-bottom:1px solid var(--line)}
+header h1{font-size:15px;margin:0;font-weight:600}
+header .dim{color:var(--dim)}
+main{display:grid;grid-template-columns:1fr 1fr;gap:12px;padding:12px}
+section{background:var(--panel);border:1px solid var(--line);
+border-radius:8px;padding:12px;min-height:120px}
+section h2{margin:0 0 8px;font-size:13px;color:var(--dim);
+text-transform:uppercase;letter-spacing:.06em}
+table{width:100%;border-collapse:collapse;font-size:13px}
+th{color:var(--dim);text-align:left;font-weight:500}
+th,td{padding:3px 8px 3px 0;border-bottom:1px solid var(--line)}
+#feed{font-family:ui-monospace,monospace;font-size:12px;max-height:320px;
+overflow-y:auto;white-space:pre}
+#feed .alert{color:var(--warn)}
+#login{max-width:320px;margin:80px auto;display:flex;flex-direction:column;
+gap:8px}
+input,select,button{background:var(--bg);color:var(--fg);
+border:1px solid var(--line);border-radius:5px;padding:6px 9px;font:inherit}
+button{cursor:pointer;border-color:var(--acc)}
+.stat{display:inline-block;margin-right:18px}
+.stat b{display:block;font-size:18px}
+.stat span{color:var(--dim);font-size:12px}
+#err{color:var(--warn)}
+.full{grid-column:1/-1}
+</style>
+</head>
+<body>
+<div id="login">
+  <h1>SiteWhere-TPU</h1>
+  <input id="user" placeholder="username" value="admin">
+  <input id="pass" type="password" placeholder="password">
+  <button onclick="login()">Sign in</button>
+  <div id="err"></div>
+</div>
+<div id="app" style="display:none">
+<header>
+  <h1>SiteWhere-TPU</h1>
+  <span class="dim">tenant</span>
+  <select id="tenant" onchange="switchTenant()"></select>
+  <span class="dim" id="whoami"></span>
+</header>
+<main>
+  <section class="full"><h2>North star</h2><div id="stats"></div></section>
+  <section><h2>Topology</h2><div id="topo"></div></section>
+  <section><h2>Devices</h2><div id="devices"></div></section>
+  <section class="full"><h2>Live events</h2><div id="feed"></div></section>
+</main>
+</div>
+<script>
+let jwt = "", tenant = "default", ws = null;
+const $ = id => document.getElementById(id);
+const esc = v => String(v ?? "").replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+const api = (path, opts={}) => fetch(path, {...opts, headers: {
+  "Authorization": "Bearer " + jwt, "X-SiteWhere-Tenant": tenant,
+  "Content-Type": "application/json", ...(opts.headers||{})}})
+  .then(r => { if (!r.ok) throw new Error(path+": "+r.status); return r; });
+
+async function login() {
+  try {
+    const r = await fetch("/api/authapi/jwt", {method: "POST",
+      body: JSON.stringify({username: $("user").value,
+                            password: $("pass").value})});
+    if (!r.ok) throw new Error("bad credentials");
+    jwt = (await r.json()).token;
+    $("login").style.display = "none";
+    $("app").style.display = "";
+    $("whoami").textContent = $("user").value;
+    await loadTenants();
+    refresh();
+    setInterval(refresh, 5000);
+  } catch (e) { $("err").textContent = e.message; }
+}
+
+async function loadTenants() {
+  const body = await (await api("/api/tenants")).json();
+  const ts = body.results || body;
+  $("tenant").innerHTML = ts.map(t =>
+    `<option value="${esc(t.token)}">${esc(t.token)}</option>`).join("");
+  if (ts.length) tenant = ts[0].token;
+  $("tenant").value = tenant;
+}
+
+function switchTenant() {
+  tenant = $("tenant").value;
+  if (ws) ws.close();
+  openFeed();
+  refresh();
+}
+
+async function refresh() {
+  try {
+    const topo = await (await api("/api/instance/topology")).json();
+    const t = topo.tenants[tenant] || {};
+    $("topo").innerHTML =
+      "<table><tr><th>component</th><th>state</th></tr>" +
+      Object.entries(t.components || {}).map(([k, v]) =>
+        `<tr><td>${esc(k)}</td><td style="color:${
+          v === "started" ? "var(--ok)" : "var(--warn)"}">${esc(v)}</td></tr>`
+      ).join("") + "</table>";
+    const devs = await (await api("/api/devices?page_size=12")).json();
+    $("devices").innerHTML =
+      `<div class="dim">${devs.total} devices</div>` +
+      "<table><tr><th>token</th><th>type</th><th>status</th></tr>" +
+      devs.results.map(d =>
+        `<tr><td>${esc(d.token)}</td><td>${esc(d.device_type_token)}</td>` +
+        `<td>${esc(d.status)}</td></tr>`).join("") + "</table>";
+    const m = await (await fetch("/metrics")).text();
+    const pick = name => {
+      const row = m.split("\\n").find(l => l.startsWith(name + " "));
+      return row ? Number(row.split(" ")[1]) : 0;
+    };
+    const stats = [
+      ["scored", pick("tpu_inference_scored_total")],
+      ["persisted", pick("event_management_persisted")],
+      ["rules fired", pick("rules_fired")],
+      ["commands", pick("command_delivery_delivered")],
+      ["failovers", pick("tpu_inference_failovers")],
+    ];
+    $("stats").innerHTML = stats.map(([k, v]) =>
+      `<span class="stat"><b>${v.toLocaleString()}</b>` +
+      `<span>${esc(k)}</span></span>`).join("");
+  } catch (e) { console.error(e); }
+}
+
+function openFeed() {
+  const proto = location.protocol === "https:" ? "wss" : "ws";
+  ws = new WebSocket(`${proto}://${location.host}/api/ws/events` +
+    `?access_token=${encodeURIComponent(jwt)}` +
+    `&tenant=${encodeURIComponent(tenant)}`);
+  ws.onmessage = ev => {
+    const e = JSON.parse(ev.data);
+    const line = document.createElement("div");
+    if (e.type === "alert") line.className = "alert";
+    line.textContent = `${new Date(e.event_ts).toISOString()}  ` +
+      `${(e.type || "?").padEnd(12)} ${(e.device_token || "").padEnd(12)}` +
+      ` ${e.name || e.alert_type || ""} ${e.value ?? e.message ?? ""}` +
+      (e.score != null ? `  score=${Number(e.score).toFixed(3)}` : "");
+    const feed = $("feed");
+    feed.prepend(line);
+    while (feed.childNodes.length > 200) feed.removeChild(feed.lastChild);
+  };
+}
+// feed opens after first refresh so the tenant selector is settled
+const _origLoad = loadTenants;
+loadTenants = async () => { await _origLoad(); openFeed(); };
+</script>
+</body>
+</html>
+"""

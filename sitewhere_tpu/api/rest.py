@@ -74,6 +74,9 @@ class RestApi:
     # -- auth ------------------------------------------------------------
     PUBLIC = {("POST", "/api/authapi/jwt"), ("GET", "/api/health"),
               ("GET", "/metrics"), ("GET", "/api/openapi.json"),
+              # static console shell: holds no data — every data call it
+              # makes authenticates through the normal JWT middleware
+              ("GET", "/admin"),
               # device-facing ingest authenticates with the TENANT auth
               # token (devices don't hold user JWTs) — see http_ingest
               ("POST", "/api/input"), ("GET", "/api/ws/input")}
@@ -84,6 +87,15 @@ class RestApi:
         if key in self.PUBLIC:
             return await handler(request)
         auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer ") and request.path.startswith("/api/ws/"):
+            # browsers cannot set headers on WebSocket upgrades — the
+            # admin console's live feed passes the SAME jwt as a query
+            # param instead (validated identically below). Scoped to the
+            # WS routes ONLY: tokens in ordinary request URLs would leak
+            # into access logs / history / Referer headers
+            qt = request.query.get("access_token", "")
+            if qt:
+                auth = f"Bearer {qt}"
         if not auth.startswith("Bearer "):
             return web.json_response({"error": "missing bearer token"}, status=401)
         try:
@@ -99,7 +111,9 @@ class RestApi:
             return web.json_response({"error": str(exc)}, status=400)
 
     def _tenant(self, request: web.Request) -> TenantRuntime:
-        token = request.headers.get("X-SiteWhere-Tenant", "default")
+        token = request.headers.get(
+            "X-SiteWhere-Tenant", request.query.get("tenant", "default")
+        )
         rt = self.instance.tenants.get(token)
         if rt is None:
             raise web.HTTPNotFound(
@@ -123,6 +137,7 @@ class RestApi:
         r.add_get("/api/ws/input", self.ws_ingest)
         r.add_get("/api/ws/events", self.ws_events)
         r.add_get("/api/health", self.health)
+        r.add_get("/admin", self.admin_console)
         r.add_get("/metrics", self.metrics)
         r.add_get("/api/openapi.json", self.openapi)
         r.add_get("/api/instance/topology", self.topology)
@@ -146,6 +161,12 @@ class RestApi:
         r.add_delete("/api/assignments/{token}", self.release_assignment)
 
         r.add_get("/api/events", self.list_events)
+        r.add_get("/api/devicegroups", self.list_device_groups)
+        r.add_post("/api/devicegroups", self.create_device_group)
+        r.add_get("/api/devicegroups/{token}", self.get_device_group)
+        r.add_delete("/api/devicegroups/{token}", self.delete_device_group)
+        r.add_get("/api/devicegroups/{token}/devices",
+                  self.device_group_devices)
         r.add_get("/api/areas", self.list_areas)
         r.add_post("/api/areas", self.create_area)
         r.add_get("/api/zones", self.list_zones)
@@ -296,6 +317,13 @@ class RestApi:
         return web.json_response(
             {"status": "ok", "state": self.instance.state.value}
         )
+
+    async def admin_console(self, request) -> web.Response:
+        """The L7 admin console: one static page over REST + WS (see
+        api/console.py)."""
+        from sitewhere_tpu.api.console import CONSOLE_HTML
+
+        return web.Response(text=CONSOLE_HTML, content_type="text/html")
 
     async def metrics(self, request) -> web.Response:
         return web.Response(
@@ -544,6 +572,76 @@ class RestApi:
         )
         rt.device_management.create_zone(z)
         return web.json_response(_entity(z), status=201)
+
+    # -- device groups ---------------------------------------------------
+    @staticmethod
+    def _group_dict(g) -> dict:
+        return {
+            "token": g.token, "name": g.name, "description": g.description,
+            "roles": list(g.roles),
+            "elements": [
+                {"device_token": el.device_token,
+                 "nested_group_token": el.nested_group_token,
+                 "roles": list(el.roles)}
+                for el in g.elements
+            ],
+        }
+
+    async def list_device_groups(self, request) -> web.Response:
+        rt = self._tenant(request)
+        page, size = self._page(request)
+        items, total = rt.device_management.list_groups(page, size)
+        return web.json_response({
+            "results": [self._group_dict(g) for g in items],
+            "total": total, "page": page, "page_size": size,
+        })
+
+    async def create_device_group(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        b = await request.json()
+        from sitewhere_tpu.core.model import DeviceGroup, DeviceGroupElement
+
+        g = DeviceGroup(
+            name=b.get("name", ""),
+            description=b.get("description", ""),
+            roles=list(b.get("roles", [])),
+            elements=[
+                DeviceGroupElement(
+                    device_token=el.get("device_token", ""),
+                    nested_group_token=el.get("nested_group_token", ""),
+                    roles=list(el.get("roles", [])),
+                )
+                for el in b.get("elements", [])
+            ],
+            **({"token": b["token"]} if b.get("token") else {}),
+        )
+        rt.device_management.create_group(g)
+        return web.json_response(self._group_dict(g), status=201)
+
+    async def get_device_group(self, request) -> web.Response:
+        rt = self._tenant(request)
+        g = rt.device_management.get_group(request.match_info["token"])
+        if g is None:
+            return web.json_response({"error": "unknown group"}, status=404)
+        return web.json_response(self._group_dict(g))
+
+    async def delete_device_group(self, request) -> web.Response:
+        self.instance.users.require_authority(request["claims"], AUTH_DEVICE_MANAGE)
+        rt = self._tenant(request)
+        rt.device_management.delete_group(request.match_info["token"])
+        return web.json_response({"deleted": True})
+
+    async def device_group_devices(self, request) -> web.Response:
+        """Flattened device tokens (nested groups walked, ?role= filter)."""
+        rt = self._tenant(request)
+        try:
+            tokens = rt.device_management.group_device_tokens(
+                request.match_info["token"], request.query.get("role", "")
+            )
+        except KeyError:
+            return web.json_response({"error": "unknown group"}, status=404)
+        return web.json_response({"device_tokens": tokens})
 
     # -- assets ----------------------------------------------------------
     async def list_assets(self, request) -> web.Response:

@@ -174,6 +174,7 @@ class SiteWhereInstance(LifecycleComponent):
         self._updates_task: Optional[asyncio.Task] = None
         self._autosave_task: Optional[asyncio.Task] = None
         self._shared_targets: Optional[list] = None  # see _on_shared_input
+        self._profiling = False  # jax.profiler trace active (profile_dir)
         # ONE instance-level subscription for the shared input pattern; it
         # routes to opted-in tenants (cfg.shared_input) or — if none opted
         # in — to the sole tenant. With >=2 tenants and no flag it routes
@@ -505,6 +506,20 @@ class SiteWhereInstance(LifecycleComponent):
 
     # -- lifecycle -------------------------------------------------------
     async def on_start(self) -> None:
+        if self.config.debug_nans:
+            import jax
+
+            jax.config.update("jax_debug_nans", True)
+        if self.config.profile_dir and not self._profiling:
+            import jax
+
+            try:
+                jax.profiler.start_trace(self.config.profile_dir)
+                self._profiling = True
+            except Exception as exc:  # noqa: BLE001 - the profiler is
+                # process-global (an already-active trace raises); losing
+                # the trace must not keep the instance from booting
+                self._record_error("profiler-start", exc)
         self.bus.subscribe(self.bus.naming.tenant_model_updates(), "instance")
         self._updates_task = asyncio.create_task(
             self._updates_loop(), name=f"{self.name}-tenant-updates"
@@ -549,6 +564,15 @@ class SiteWhereInstance(LifecycleComponent):
         self._updates_task = None
         await cancel_and_wait(getattr(self, "_autosave_task", None))
         self._autosave_task = None
+        if self._profiling:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001 - a profiler fault
+                # must not break shutdown
+                self._record_error("profiler-stop", exc)
+            self._profiling = False
 
     async def _updates_loop(self) -> None:
         while True:

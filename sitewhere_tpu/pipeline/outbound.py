@@ -190,6 +190,197 @@ class MqttTopicConnector(OutboundConnector):
         return await super().deliver_batch(batch)
 
 
+class SearchIndexConnector(OutboundConnector):
+    """Local search indexer — the Solr-indexer analog (reference:
+    solr outbound connector [U]) without an external service: events index
+    into an in-proc inverted index, queryable by term with AND semantics.
+
+    Segment design (bounded memory, columnar-friendly): each delivered
+    MeasurementBatch becomes ONE segment carrying the batch's columns plus
+    a per-unique-(device,name) term map; object events batch into small
+    segments. Queries walk segments newest-first; eviction drops whole
+    segments (no per-doc index surgery). Terms are lowercase
+    whitespace/punct-split tokens of device token, measurement name,
+    alert type/message, area/assignment tokens."""
+
+    def __init__(self, name: str = "search", max_segments: int = 256, **kw) -> None:
+        super().__init__(name, **kw)
+        self.max_segments = max_segments
+        self._segments: List[dict] = []  # newest last
+        self.indexed = 0
+
+    @staticmethod
+    def _tokens(*fields: str) -> set:
+        out: set = set()
+        for f in fields:
+            if not f:
+                continue
+            for t in str(f).lower().replace("-", " ").replace("/", " ") \
+                    .replace(":", " ").replace("_", " ").split():
+                out.add(t)
+        return out
+
+    def _push(self, seg: dict) -> None:
+        self._segments.append(seg)
+        if len(self._segments) > self.max_segments:
+            del self._segments[: len(self._segments) - self.max_segments]
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        terms = self._tokens(
+            e.device_token,
+            getattr(e, "name", ""),
+            getattr(e, "alert_type", ""),
+            getattr(e, "message", ""),
+            e.area_token,
+            e.assignment_token,
+            e.EVENT_TYPE.value,
+        )
+        self._push({"kind": "event", "event": e, "terms": terms})
+        self.indexed += 1
+
+    async def deliver_batch(self, batch: MeasurementBatch) -> int:
+        if self.filters:
+            return await super().deliver_batch(batch)
+        if batch.n == 0:
+            return 0
+        # one segment per batch: per-unique-pair terms → row indices, no
+        # per-row Python (uniques come from the batch's cached indices)
+        pair = batch.pair_codes()
+        terms_by_pair: Dict[int, set] = {}
+        rows_by_pair: Dict[int, list] = {}
+        for code in np.unique(pair):
+            sel = np.nonzero(pair == code)[0]
+            rows_by_pair[int(code)] = sel
+            i = sel[0]
+            terms_by_pair[int(code)] = self._tokens(
+                str(batch.device_tokens[i]), str(batch.names[i]),
+                "measurement",
+            )
+        self._push({
+            "kind": "batch", "batch": batch,
+            "terms_by_pair": terms_by_pair, "rows_by_pair": rows_by_pair,
+        })
+        self.indexed += batch.n
+        return batch.n
+
+    def search(self, query: str, limit: int = 100) -> List[DeviceEvent]:
+        """All-terms-must-match search, newest first."""
+        want = self._tokens(query)
+        if not want:
+            return []
+        out: List[DeviceEvent] = []
+        for seg in reversed(self._segments):
+            if len(out) >= limit:
+                break
+            if seg["kind"] == "event":
+                if want <= seg["terms"]:
+                    out.append(seg["event"])
+                continue
+            batch = seg["batch"]
+            for code, terms in seg["terms_by_pair"].items():
+                if not want <= terms:
+                    continue
+                rows = seg["rows_by_pair"][code]
+                take = rows[: max(0, limit - len(out))]
+                out.extend(batch.select(np.asarray(take)).to_events())
+                if len(out) >= limit:
+                    break
+        return out[:limit]
+
+
+class QueueConnector(OutboundConnector):
+    """Generic queue bridge — the SQS/EventHub/RabbitMQ-connector analog.
+    Two backends share the connector:
+
+    - ``bus``: republish onto a named in-proc bus topic (columnar batches
+      forwarded as-is — zero-copy fan-out to any in-process consumer);
+    - ``amqp``: publish event JSON to a queue over a REAL AMQP 0-9-1
+      socket via the in-repo protocol client (``comm.amqp``)."""
+
+    def __init__(
+        self,
+        name: str,
+        backend: str = "bus",
+        bus: Optional[EventBus] = None,
+        topic: str = "sitewhere.outbound",
+        host: str = "127.0.0.1",
+        port: int = 5672,
+        queue: str = "sitewhere.outbound",
+        **kw,
+    ) -> None:
+        super().__init__(name, **kw)
+        if backend not in ("bus", "amqp"):
+            raise ValueError(f"unknown queue backend '{backend}'")
+        if backend == "bus" and bus is None:
+            raise ValueError("bus backend needs a bus")
+        self.backend = backend
+        self.bus = bus
+        self.topic = topic
+        self.host, self.port, self.queue = host, port, queue
+        self._amqp = None
+        self._amqp_lock = asyncio.Lock()  # one dial/drop at a time: the
+        # base class runs deliveries concurrently, and a double-connect
+        # would leak the overwritten client's socket + read loop
+
+    async def on_stop(self) -> None:
+        await self._drop_amqp()
+
+    async def _drop_amqp(self) -> None:
+        async with self._amqp_lock:
+            client, self._amqp = self._amqp, None
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001 - already broken
+                pass
+
+    async def _amqp_client(self):
+        async with self._amqp_lock:
+            if self._amqp is None:
+                from sitewhere_tpu.comm.amqp import AmqpClient
+
+                client = await asyncio.wait_for(
+                    AmqpClient(self.host, self.port).connect(), 10.0
+                )
+                try:
+                    await client.queue_declare(self.queue)
+                except BaseException:
+                    await client.close()
+                    raise
+                self._amqp = client
+            return self._amqp
+
+    async def deliver(self, e: DeviceEvent) -> None:
+        if self.backend == "bus":
+            await self.bus.publish(self.topic, e)
+            return
+        client = await self._amqp_client()
+        try:
+            await client.publish(self.queue, e.to_json().encode())
+        except Exception:
+            await self._drop_amqp()  # close + reconnect on next delivery
+            raise
+
+    async def deliver_batch(self, batch: MeasurementBatch) -> int:
+        if self.filters:
+            return await super().deliver_batch(batch)
+        if self.backend == "bus":
+            # columnar fast path: the batch rides the topic unchanged
+            await self.bus.publish(self.topic, batch)
+            return batch.n
+        # AMQP wire is per-message JSON: one compact message per row
+        client = await self._amqp_client()
+        n = 0
+        try:
+            for e in batch.to_events():
+                await client.publish(self.queue, e.to_json().encode())
+                n += 1
+        except Exception:
+            await self._drop_amqp()
+            raise
+        return n
+
+
 class WebhookConnector(OutboundConnector):
     """HTTP POST per event via aiohttp (gated on a reachable endpoint)."""
 

@@ -117,6 +117,14 @@ class InstanceConfig:
     # port). CONNECT username/password = tenant token/auth token, checked
     # through the same authenticate_device gate as CoAP/HTTP/WS ingest
     mqtt_broker_port: Optional[int] = None
+    # non-empty: capture a jax.profiler trace for the instance's lifetime
+    # into this directory (start() → stop()) — the SURVEY §5 tracing
+    # plan's second half, beside the per-stage envelope timestamps
+    profile_dir: str = ""
+    # debug mode: make XLA raise on NaN/Inf in any compiled computation
+    # (jax_debug_nans) — the SURVEY §5 sanitizer-analog flag. Costly
+    # (disables async dispatch); for debugging sessions, never production
+    debug_nans: bool = False
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

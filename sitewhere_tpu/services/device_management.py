@@ -227,6 +227,12 @@ class DeviceManagement:
     def get_group(self, token: str) -> Optional[DeviceGroup]:
         return self.groups.get(token)
 
+    def list_groups(self, page: int = 1, page_size: int = 100):
+        return self.groups.page(page, page_size)
+
+    def delete_group(self, token: str) -> None:
+        self.groups.delete(token)
+
     def group_device_tokens(self, token: str, role: str = "") -> List[str]:
         """Flatten a group (incl. nested groups) to device tokens."""
         g = self.groups.require(token)

@@ -217,3 +217,52 @@ async def test_topology_reports_template():
     async with running_instance() as instance:
         topo = instance.topology()
         assert topo["tenants"]["acme"]["template"] == "iot-temperature"
+
+
+async def test_profile_dir_captures_trace(tmp_path):
+    """InstanceConfig.profile_dir wraps the instance lifetime in a
+    jax.profiler trace (SURVEY §5 tracing plan, second half)."""
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+
+    prof = tmp_path / "trace"
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="prof",
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=1),
+        profile_dir=str(prof),
+    ))
+    await inst.start()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        float(jax.jit(lambda x: x * 2)(jnp.ones(())))  # something to trace
+    finally:
+        await inst.terminate()
+    files = list(prof.rglob("*"))
+    assert any(f.is_file() for f in files), "no trace files captured"
+
+
+async def test_debug_nans_flag():
+    """InstanceConfig.debug_nans turns on the XLA NaN sanitizer (SURVEY
+    §5 race/sanitizer plan): a NaN-producing computation raises instead
+    of propagating silently."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="nan",
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=1),
+        debug_nans=True,
+    ))
+    await inst.start()
+    try:
+        with _pytest.raises(Exception, match="(?i)nan"):
+            jax.jit(lambda x: 0.0 / x)(jnp.zeros(()))
+    finally:
+        jax.config.update("jax_debug_nans", False)
+        await inst.terminate()

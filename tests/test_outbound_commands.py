@@ -1,5 +1,6 @@
 """Outbound connectors, command delivery, sim broker round-trips."""
 
+import asyncio
 import json
 
 import pytest
@@ -215,3 +216,99 @@ class TestSimulator:
         assert len(acks) == 1
         assert acks[0]["type"] == "command_response"
         assert acks[0]["originating_event_id"] == "inv1"
+
+
+class TestSearchIndexConnector:
+    """Local Solr-indexer analog: columnar indexing + term search."""
+
+    async def test_batch_index_and_search(self):
+        from sitewhere_tpu.core.batch import MeasurementBatch
+        from sitewhere_tpu.pipeline.outbound import SearchIndexConnector
+        import numpy as np
+
+        c = SearchIndexConnector()
+        b = MeasurementBatch.from_column_chunks("t", [
+            ("pump-01", "temperature", np.asarray([20.0, 21.0], np.float32),
+             np.asarray([1.0, 2.0])),
+            ("pump-02", "pressure", np.asarray([5.0], np.float32),
+             np.asarray([3.0])),
+            ("fan-01", "temperature", np.asarray([30.0], np.float32),
+             np.asarray([4.0])),
+        ])
+        assert await c.process_batch(b) == 4
+        hits = c.search("temperature")
+        assert {h.device_token for h in hits} == {"pump-01", "fan-01"}
+        hits = c.search("pump temperature")  # AND semantics
+        assert {h.device_token for h in hits} == {"pump-01"}
+        assert len(c.search("pump")) == 3
+        assert c.search("nosuchterm") == []
+
+    async def test_object_events_and_eviction(self):
+        from sitewhere_tpu.pipeline.outbound import SearchIndexConnector
+
+        c = SearchIndexConnector(max_segments=2)
+        for i in range(4):
+            await c.process(DeviceMeasurement(
+                device_token=f"dev-{i}", name="humidity", value=float(i),
+            ))
+        # only the 2 newest segments survive
+        hits = c.search("humidity")
+        assert {h.device_token for h in hits} == {"dev-2", "dev-3"}
+        alert = DeviceAlert(device_token="dev-9", alert_type="overheat",
+                            message="core too hot")
+        await c.process(alert)
+        assert c.search("overheat")[0].device_token == "dev-9"
+        assert c.search("hot core")[0].alert_type == "overheat"
+
+
+class TestQueueConnector:
+    async def test_bus_backend_forwards_batches_columnar(self):
+        from sitewhere_tpu.core.batch import MeasurementBatch
+        from sitewhere_tpu.pipeline.outbound import QueueConnector
+        from sitewhere_tpu.runtime.bus import EventBus
+        import numpy as np
+
+        bus = EventBus()
+        bus.subscribe("q.out", "probe")
+        c = QueueConnector("q", backend="bus", bus=bus, topic="q.out")
+        b = MeasurementBatch.from_arrays(
+            "t", np.arange(3), np.ones(3, np.float32))
+        assert await c.process_batch(b) == 3
+        await c.process(DeviceMeasurement(device_token="d1", value=2.0))
+        items = await bus.consume("q.out", "probe", 16, timeout_s=0)
+        assert len(items) == 2
+        assert isinstance(items[0], MeasurementBatch)  # columnar, as-is
+        assert items[1].device_token == "d1"
+
+    async def test_amqp_backend_real_socket(self):
+        from sitewhere_tpu.comm.amqp import AmqpBroker, AmqpClient
+        from sitewhere_tpu.pipeline.outbound import QueueConnector
+
+        broker = AmqpBroker(port=0)
+        await broker.initialize()
+        await broker.start()
+        try:
+            c = QueueConnector(
+                "q", backend="amqp", host="127.0.0.1",
+                port=broker.bound_port, queue="out.q",
+            )
+            got = []
+            consumer = await AmqpClient("127.0.0.1", broker.bound_port).connect()
+            await consumer.queue_declare("out.q")
+
+            async def on_msg(body, queue):
+                got.append(json.loads(body))
+
+            await consumer.consume("out.q", on_msg)
+            await c.process(DeviceMeasurement(
+                device_token="d7", name="t", value=3.5))
+            for _ in range(200):
+                if got:
+                    break
+                await asyncio.sleep(0.02)
+            assert got and got[0]["device_token"] == "d7"
+            await c.stop() if hasattr(c, "stop") else None
+            await consumer.close()
+            await c.on_stop()
+        finally:
+            await broker.terminate()

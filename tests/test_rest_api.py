@@ -260,3 +260,87 @@ async def test_viewer_cannot_mutate():
         # reads still allowed for the viewer
         resp = await client.get("/api/devices", headers=vh)
         assert resp.status == 200
+
+
+async def test_device_group_routes():
+    """Round-5 parity: /api/devicegroups CRUD + flattened device listing
+    with nested groups and role filters (SURVEY.md:190)."""
+    async with client_ctx() as (client, inst):
+        # nested group first
+        resp = await client.post("/api/devicegroups", json={
+            "token": "grp-inner", "name": "inner",
+            "elements": [
+                {"device_token": "dev-00003", "roles": ["probe"]},
+            ],
+        })
+        assert resp.status == 201, await resp.text()
+        resp = await client.post("/api/devicegroups", json={
+            "token": "grp-outer", "name": "outer", "roles": ["fleet"],
+            "elements": [
+                {"device_token": "dev-00001", "roles": ["probe"]},
+                {"device_token": "dev-00002", "roles": ["other"]},
+                {"nested_group_token": "grp-inner", "roles": ["probe"]},
+            ],
+        })
+        assert resp.status == 201
+        resp = await client.get("/api/devicegroups")
+        body = await resp.json()
+        assert body["total"] == 2
+        resp = await client.get("/api/devicegroups/grp-outer")
+        assert (await resp.json())["name"] == "outer"
+        # flattened: all devices, nested group walked
+        resp = await client.get("/api/devicegroups/grp-outer/devices")
+        toks = (await resp.json())["device_tokens"]
+        assert set(toks) == {"dev-00001", "dev-00002", "dev-00003"}
+        # role filter: only 'probe' elements (and through the nested group)
+        resp = await client.get("/api/devicegroups/grp-outer/devices?role=probe")
+        toks = (await resp.json())["device_tokens"]
+        assert set(toks) == {"dev-00001", "dev-00003"}
+        # unknown group → 404
+        resp = await client.get("/api/devicegroups/nope/devices")
+        assert resp.status == 404
+        # delete
+        resp = await client.delete("/api/devicegroups/grp-inner")
+        assert resp.status == 200
+        resp = await client.get("/api/devicegroups")
+        assert (await resp.json())["total"] == 1
+
+
+async def test_admin_console_and_ws_query_auth():
+    """L7 console: /admin serves the static shell without auth; the WS
+    feed accepts the jwt as ?access_token (browsers can't set headers on
+    WebSocket upgrades) and rejects a bad one."""
+    async with client_ctx() as (client, inst):
+        import aiohttp
+
+        async with aiohttp.ClientSession() as raw:
+            async with raw.get(client.make_url("/admin")) as resp:
+                assert resp.status == 200
+                body = await resp.text()
+                assert "SiteWhere-TPU" in body and "/api/ws/events" in body
+            # bad query token → 401 before upgrade
+            async with raw.get(
+                client.make_url("/api/ws/events?access_token=bogus")
+            ) as resp:
+                assert resp.status == 401
+        # good query token upgrades and streams
+        resp = await client.post(
+            "/api/authapi/jwt",
+            json={"username": "admin", "password": "password"},
+        )
+        token = (await resp.json())["token"]
+        async with aiohttp.ClientSession() as raw:
+            ws = await raw.ws_connect(client.make_url(
+                f"/api/ws/events?access_token={token}&tenant=default"
+            ))
+            rt = inst.tenants["default"]
+            from sitewhere_tpu.core.events import DeviceMeasurement
+
+            await inst.bus.publish(
+                inst.bus.naming.persisted_events("default"),
+                DeviceMeasurement(device_token="dev-00001", name="t",
+                                  value=9.0, tenant="default"),
+            )
+            msg = await asyncio.wait_for(ws.receive_json(), 10.0)
+            assert msg["device_token"] == "dev-00001"
+            await ws.close()
