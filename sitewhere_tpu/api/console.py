@@ -92,6 +92,7 @@ async function login() {
     $("app").style.display = "";
     $("whoami").textContent = $("user").value;
     await loadTenants();
+    openFeed();
     refresh();
     setInterval(refresh, 5000);
   } catch (e) { $("err").textContent = e.message; }
@@ -166,9 +167,6 @@ function openFeed() {
     while (feed.childNodes.length > 200) feed.removeChild(feed.lastChild);
   };
 }
-// feed opens after first refresh so the tenant selector is settled
-const _origLoad = loadTenants;
-loadTenants = async () => { await _origLoad(); openFeed(); };
 </script>
 </body>
 </html>

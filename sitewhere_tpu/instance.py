@@ -175,6 +175,7 @@ class SiteWhereInstance(LifecycleComponent):
         self._autosave_task: Optional[asyncio.Task] = None
         self._shared_targets: Optional[list] = None  # see _on_shared_input
         self._profiling = False  # jax.profiler trace active (profile_dir)
+        self._debug_nans_set = False  # we flipped the global NaN flag
         # ONE instance-level subscription for the shared input pattern; it
         # routes to opted-in tenants (cfg.shared_input) or — if none opted
         # in — to the sole tenant. With >=2 tenants and no flag it routes
@@ -510,6 +511,7 @@ class SiteWhereInstance(LifecycleComponent):
             import jax
 
             jax.config.update("jax_debug_nans", True)
+            self._debug_nans_set = True
         if self.config.profile_dir and not self._profiling:
             import jax
 
@@ -573,6 +575,14 @@ class SiteWhereInstance(LifecycleComponent):
                 # must not break shutdown
                 self._record_error("profiler-stop", exc)
             self._profiling = False
+        if self._debug_nans_set:
+            # the flag is process-global: restore it, or a debug session's
+            # instance leaks disabled-async-dispatch + raise-on-NaN into
+            # every later instance in the process
+            import jax
+
+            jax.config.update("jax_debug_nans", False)
+            self._debug_nans_set = False
 
     async def _updates_loop(self) -> None:
         while True:

@@ -1,0 +1,153 @@
+"""Native runtime components (C, built in-tree, loaded via ctypes).
+
+The reference's runtime keeps its hot wire paths native (JVM protobuf
+parsers — SURVEY.md §2.1 [U]; reference mount empty, see provenance
+banner); this package is the rebuild's analog: small C libraries compiled
+on first use with the toolchain baked into the image (``cc``), bound with
+ctypes (no pybind11 in-image), and ALWAYS paired with a pure-Python
+fallback — a missing compiler degrades speed, never capability.
+
+Current components:
+- ``jsonwire``: bulk parser for the dominant JSON telemetry wire shape,
+  feeding the columnar ingest path directly (values f32 / event_ts f64
+  into preallocated numpy buffers).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "csrc" / "jsonwire.c"
+_LIB: Optional[ctypes.CDLL] = None
+_BUILT = threading.Event()
+
+SW_UNSUPPORTED, SW_MALFORMED, SW_OVERFLOW = -1, -2, -3
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    """Compile (once, content-hashed) and load the jsonwire library.
+    Returns None when no toolchain is available — callers fall back."""
+    try:
+        src = _SRC.read_bytes()
+    except OSError:
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    build_dir = _HERE / "_build"
+    so_path = build_dir / f"jsonwire-{tag}.so"
+    if not so_path.exists():
+        build_dir.mkdir(parents=True, exist_ok=True)
+        tmp = so_path.with_suffix(f".tmp{os.getpid()}")
+        cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+            os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+        except (OSError, subprocess.SubprocessError):
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
+            return None
+    try:
+        lib = ctypes.CDLL(str(so_path))
+    except OSError:
+        return None
+    lib.sw_parse_bulk.restype = ctypes.c_long
+    lib.sw_parse_bulk.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,          # buf, len
+        ctypes.POINTER(ctypes.c_float),          # vals out
+        ctypes.POINTER(ctypes.c_double),         # ets out
+        ctypes.c_long,                           # cap
+        ctypes.c_char_p, ctypes.c_long,          # device out buf
+        ctypes.c_char_p, ctypes.c_long,          # name out buf
+    ]
+    return lib
+
+
+def _bg_build() -> None:
+    global _LIB
+    try:
+        _LIB = _build_lib()
+    finally:
+        _BUILT.set()
+
+
+# compile in the BACKGROUND at import time: the first cold-cache build
+# takes cc a few hundred ms, which must never stall the ingest event
+# loop's first payload; until the build lands, the hot path simply
+# reports "no library" and the Python decoder carries traffic
+threading.Thread(
+    target=_bg_build, name="jsonwire-build", daemon=True
+).start()
+
+
+def jsonwire_lib(wait: bool = True) -> Optional[ctypes.CDLL]:
+    """The compiled library, or None. ``wait=False`` (the per-payload hot
+    path) never blocks on an in-progress build."""
+    if wait:
+        _BUILT.wait(timeout=180.0)
+    return _LIB if _BUILT.is_set() else None
+
+
+# string scratch: device tokens / measurement names are short identifiers
+_STR_CAP = 512
+
+
+class _Scratch:
+    """Per-thread reusable output buffers (the decode pump is effectively
+    single-threaded; a fresh malloc per payload would dominate)."""
+
+    __slots__ = ("vals", "ets", "dev", "name", "cap")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = cap
+        self.vals = np.empty((cap,), np.float32)
+        self.ets = np.empty((cap,), np.float64)
+        self.dev = ctypes.create_string_buffer(_STR_CAP)
+        self.name = ctypes.create_string_buffer(_STR_CAP)
+
+
+_scratch = threading.local()
+
+
+def parse_json_bulk(payload: bytes) -> Optional[Tuple[str, str, np.ndarray, np.ndarray]]:
+    """Parse the hot JSON wire shape natively.
+
+    Returns ``(device, name, values f32[n] copy, event_ts f64[n] copy)``
+    or None when the payload needs the general Python decoder (shape
+    outside the fast path, malformed input, or no native library)."""
+    lib = jsonwire_lib(wait=False)
+    if lib is None or not payload:
+        return None
+    sc = getattr(_scratch, "s", None)
+    # events are >= ~40 bytes each on the wire; len/16 over-allocates
+    need = max(64, len(payload) // 16)
+    if sc is None or sc.cap < need:
+        sc = _Scratch(need)
+        _scratch.s = sc
+    n = lib.sw_parse_bulk(
+        payload, len(payload),
+        sc.vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        sc.ets.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        sc.cap,
+        sc.dev, _STR_CAP,
+        sc.name, _STR_CAP,
+    )
+    if n <= 0:
+        return None  # fallback handles malformed-error reporting uniformly
+    return (
+        sc.dev.value.decode(),
+        sc.name.value.decode(),
+        sc.vals[:n].copy(),
+        sc.ets[:n].copy(),
+    )

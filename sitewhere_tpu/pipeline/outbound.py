@@ -323,11 +323,18 @@ class QueueConnector(OutboundConnector):
         # would leak the overwritten client's socket + read loop
 
     async def on_stop(self) -> None:
-        await self._drop_amqp()
+        await self._drop_amqp(None)
 
-    async def _drop_amqp(self) -> None:
+    async def _drop_amqp(self, failed) -> None:
+        """Close + clear the current client — but only if it IS the one
+        that failed (None = unconditional, for shutdown). A concurrent
+        delivery may already have re-dialed; its healthy client must not
+        be torn down by a late-arriving error from the old one."""
         async with self._amqp_lock:
-            client, self._amqp = self._amqp, None
+            if failed is not None and self._amqp is not failed:
+                client = failed  # stale: close it, keep the current one
+            else:
+                client, self._amqp = self._amqp, None
         if client is not None:
             try:
                 await client.close()
@@ -358,7 +365,7 @@ class QueueConnector(OutboundConnector):
         try:
             await client.publish(self.queue, e.to_json().encode())
         except Exception:
-            await self._drop_amqp()  # close + reconnect on next delivery
+            await self._drop_amqp(client)  # close + reconnect next delivery
             raise
 
     async def deliver_batch(self, batch: MeasurementBatch) -> int:
@@ -376,7 +383,7 @@ class QueueConnector(OutboundConnector):
                 await client.publish(self.queue, e.to_json().encode())
                 n += 1
         except Exception:
-            await self._drop_amqp()
+            await self._drop_amqp(client)
             raise
         return n
 

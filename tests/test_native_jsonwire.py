@@ -1,0 +1,104 @@
+"""Native JSON-wire parser: correctness vs the Python decoder, bail-out
+coverage, and the no-toolchain fallback contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.native import jsonwire_lib, parse_json_bulk
+from sitewhere_tpu.pipeline.decoders import JsonDecoder
+
+
+def _bulk(device="dev-00007", name="temperature", n=20, with_ts=True):
+    return json.dumps({
+        "device": device,
+        "events": [
+            {"type": "measurement", "name": name, "value": 20.0 + 0.25 * j,
+             **({"event_ts": 1700000000000 + j} if with_ts else {})}
+            for j in range(n)
+        ],
+    }).encode()
+
+
+def test_lib_builds():
+    assert jsonwire_lib() is not None, "cc toolchain is baked in; must build"
+
+
+def test_parse_matches_python_decoder():
+    payload = _bulk()
+    fast = parse_json_bulk(payload)
+    assert fast is not None
+    dev, name, vals, ets = fast
+    # reference: the Python columns path on the same payload
+    kind, out = "columns", JsonDecoder._columns_from_obj(
+        json.loads(payload), {}
+    ) or ("requests", None)
+    toks, names, pvals, pets = out if isinstance(out, tuple) else out
+    assert dev == toks[0] and name == names[0]
+    np.testing.assert_allclose(vals, np.asarray(pvals, np.float32))
+    np.testing.assert_allclose(ets, np.asarray(pets, np.float64))
+
+
+def test_decode_any_uses_columns_np():
+    kind, chunks = JsonDecoder().decode_any(_bulk(n=5), {})
+    assert kind == "columns_np"
+    ((dev, name, vals, ets),) = chunks
+    assert dev == "dev-00007" and len(vals) == 5
+    assert vals.dtype == np.float32 and ets.dtype == np.float64
+
+
+@pytest.mark.parametrize("payload", [
+    # client ids must reach the Deduplicator
+    {"device": "d", "events": [{"name": "t", "value": 1, "id": "x"}]},
+    # mixed names / per-event devices break the one-chunk contract
+    {"device": "d", "events": [{"name": "a", "value": 1},
+                               {"name": "b", "value": 2}]},
+    {"device": "d", "events": [{"name": "t", "value": 1,
+                                "device_token": "other"}]},
+    # escapes bail (plain-identifier wire assumption)
+    {"device": 'quo"te', "events": [{"name": "t", "value": 1}]},
+    # non-measurement types
+    {"device": "d", "events": [{"name": "t", "value": 1, "type": "alert"}]},
+    # single-event (non-bulk) shape
+    {"type": "measurement", "device_token": "d", "name": "t", "value": 1},
+])
+def test_bails_to_python_path(payload):
+    raw = json.dumps(payload).encode()
+    assert parse_json_bulk(raw) is None
+    # and the general decoder still handles every one of them
+    kind, out = JsonDecoder().decode_any(raw, {})
+    assert out, (kind, out)
+
+
+def test_malformed_returns_none_then_python_raises():
+    from sitewhere_tpu.pipeline.decoders import DecodeError
+
+    assert parse_json_bulk(b"{nope") is None
+    with pytest.raises(DecodeError):
+        JsonDecoder().decode_any(b"{nope", {})
+
+
+def test_unknown_keys_and_nesting_skipped():
+    raw = json.dumps({
+        "device": "d", "firmware": {"v": [1, 2, {"x": None}]},
+        "events": [{"name": "t", "value": 2.5, "tags": ["a", "b"],
+                    "ok": True}],
+    }).encode()
+    fast = parse_json_bulk(raw)
+    assert fast is not None and fast[2][0] == np.float32(2.5)
+    assert fast[3][0] == 0.0  # missing event_ts → 0 (batch stamps 'now')
+
+
+def test_fallback_without_library(monkeypatch):
+    """No toolchain → capability unchanged (speed only)."""
+    import sitewhere_tpu.pipeline.decoders as dec
+
+    monkeypatch.setattr(dec, "parse_json_bulk", lambda p: None)
+    kind, out = JsonDecoder().decode_any(_bulk(n=3), {})
+    assert kind == "columns" and len(out[2]) == 3
+
+
+def test_large_payload_grows_scratch():
+    fast = parse_json_bulk(_bulk(n=3000))
+    assert fast is not None and len(fast[2]) == 3000
