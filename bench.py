@@ -569,6 +569,110 @@ def bench_e2e(secs: float, n_devices: int, **kw) -> dict:
     return asyncio.run(_bench_e2e(secs, n_devices, **kw))
 
 
+async def _bench_e2e_multitenant(
+    secs: float,
+    n_tenants: int = 32,
+    devices_per_tenant: int = 4,
+    burst: int = 100,
+    max_inflight: int = 6,
+) -> dict:
+    """Config 4 through the PRODUCT path: 32 tenants' pipelines feeding
+    one stacked scorer (ONE jit call scores every tenant per flush) —
+    the engine-only tenants32 config measures the same stack without the
+    host pipeline around it."""
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        MicroBatchConfig,
+    )
+    from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="t32",
+        mesh=MeshConfig(slots_per_shard=n_tenants),
+        inference_max_inflight=max_inflight,
+    ))
+    await inst.start()
+    try:
+        mb = MicroBatchConfig(
+            max_batch=16384, deadline_ms=5.0,
+            buckets=(1024, 4096, 16384), window=32,
+        )
+        for i in range(n_tenants):
+            await inst.tenant_management.create_tenant(
+                f"t{i:02d}", template="iot-temperature", microbatch=mb,
+                decoder="binary", max_streams=2048, wire_dtype="bf16",
+                model_config={"hidden": 64},
+            )
+        await inst.drain_tenant_updates()
+        for _ in range(300):
+            if len(inst.tenants) == n_tenants:
+                break
+            await asyncio.sleep(0.05)
+        sims = []
+        for i in range(n_tenants):
+            tok = f"t{i:02d}"
+            inst.tenants[tok].device_management.bootstrap_fleet(
+                devices_per_tenant
+            )
+            sims.append(DeviceSimulator(
+                inst.broker,
+                SimProfile(n_devices=devices_per_tenant, seed=i,
+                           samples_per_message=burst, wire="binary"),
+                topic_pattern=f"sitewhere/{tok}/input/{{device}}",
+            ))
+        await asyncio.get_running_loop().run_in_executor(
+            None, inst.inference.prewarm
+        )
+        for s in sims:
+            await s.publish_round(0.0)
+        scored = inst.metrics.counter("tpu_inference.scored_total")
+        warm = n_tenants * devices_per_tenant * burst
+        for _ in range(600):
+            if scored.value >= warm:
+                break
+            await asyncio.sleep(0.05)
+        rounds = [s.pregenerate(16, t0=1.0) for s in sims]
+        start = scored.value
+        t0 = time.perf_counter()
+        step = 0
+        while time.perf_counter() - t0 < secs:
+            rr = step % 16
+            for s, r in zip(sims, rounds):
+                await s.publish_pregenerated(r[rr])
+            step += 1
+            await asyncio.sleep(0)
+        pumped = step * warm
+        drain_converged = False
+        for _ in range(1200):
+            if scored.value - start >= pumped - warm:
+                drain_converged = True
+                break
+            await asyncio.sleep(0.05)
+        dt = time.perf_counter() - t0
+        n = scored.value - start
+        flushes = inst.metrics.counter("tpu_inference.flushes").value
+        return {
+            "events_per_sec": n / dt,
+            "n_tenants": n_tenants,
+            "devices": n_tenants * devices_per_tenant,
+            "scored": int(n),
+            "duration_s": dt,
+            "drain_converged": drain_converged,
+            "rows_per_flush": (
+                inst.metrics.counter("tpu_inference.flush_rows").value
+                / max(flushes, 1)
+            ),
+        }
+    finally:
+        await inst.terminate()
+
+
+def bench_e2e_multitenant(secs: float, **kw) -> dict:
+    return asyncio.run(_bench_e2e_multitenant(secs, **kw))
+
+
 def bench_e2e_cpu_subprocess(secs: float) -> dict:
     """Run the E2E latency phase on the CPU backend (RTT=0) in a fresh
     subprocess — isolates host+collect latency from the tunnel RTT, per
@@ -665,7 +769,8 @@ def main() -> None:
                         "only the compact headline)")
     args = p.parse_args()
     which = set(args.configs.split(",")) if args.configs != "all" else {
-        "e2e", "e2e-json", "e2e-cpu", "lstm", "deepar", "tenants32", "vit"
+        "e2e", "e2e-json", "e2e-cpu", "e2e-32t", "lstm", "deepar",
+        "tenants32", "vit"
     }
 
     import jax
@@ -751,6 +856,12 @@ def main() -> None:
         log(f"  -> {details['e2e_pipeline_json']['events_per_sec']:.0f} "
             f"ev/s e2e (json)")
 
+    if "e2e-32t" in which:
+        log("config 4b: 32-tenant FULL pipeline (stacked flushes) ...")
+        details["e2e_pipeline_32t"] = bench_e2e_multitenant(10.0)
+        log(f"  -> {details['e2e_pipeline_32t']['events_per_sec']:.0f} "
+            f"ev/s across {details['e2e_pipeline_32t']['n_tenants']} tenants")
+
     if "e2e-cpu" in which:
         log("config 1c: E2E latency on the CPU backend (RTT=0) ...")
         details["e2e_pipeline_cpu"] = bench_e2e_cpu_subprocess(6.0)
@@ -812,6 +923,7 @@ def main() -> None:
             details, "e2e_pipeline", "saturation", "drain_converged"),
         "e2e_paced_p99_ms": pick(details, "e2e_pipeline", "paced", "p99_ms"),
         "e2e_json_ev_s": pick(details, "e2e_pipeline_json", "events_per_sec"),
+        "e2e_32t_ev_s": pick(details, "e2e_pipeline_32t", "events_per_sec"),
         "e2e_cpu_p99_ms": pick(
             details, "e2e_pipeline_cpu", "paced", "p99_ms"),
         "deepar_fc_s": pick(details, "deepar_replay", "forecasts_per_sec"),

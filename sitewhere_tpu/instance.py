@@ -176,6 +176,7 @@ class SiteWhereInstance(LifecycleComponent):
         self._shared_targets: Optional[list] = None  # see _on_shared_input
         self._profiling = False  # jax.profiler trace active (profile_dir)
         self._debug_nans_set = False  # we flipped the global NaN flag
+        self._debug_nans_prev = False  # value to restore on stop
         # ONE instance-level subscription for the shared input pattern; it
         # routes to opted-in tenants (cfg.shared_input) or — if none opted
         # in — to the sole tenant. With >=2 tenants and no flag it routes
@@ -510,6 +511,10 @@ class SiteWhereInstance(LifecycleComponent):
         if self.config.debug_nans:
             import jax
 
+            # remember the PRIOR value: the flag is process-global, and
+            # stop() must restore what was there (another live instance or
+            # an external JAX_DEBUG_NANS=1 may own it), not force False
+            self._debug_nans_prev = bool(jax.config.jax_debug_nans)
             jax.config.update("jax_debug_nans", True)
             self._debug_nans_set = True
         if self.config.profile_dir and not self._profiling:
@@ -576,12 +581,12 @@ class SiteWhereInstance(LifecycleComponent):
                 self._record_error("profiler-stop", exc)
             self._profiling = False
         if self._debug_nans_set:
-            # the flag is process-global: restore it, or a debug session's
-            # instance leaks disabled-async-dispatch + raise-on-NaN into
-            # every later instance in the process
+            # restore the pre-start value (see on_start) — a debug
+            # session's instance must not leak raise-on-NaN into later
+            # instances, nor clobber a concurrent owner's setting
             import jax
 
-            jax.config.update("jax_debug_nans", False)
+            jax.config.update("jax_debug_nans", self._debug_nans_prev)
             self._debug_nans_set = False
 
     async def _updates_loop(self) -> None:

@@ -19,9 +19,20 @@
  * Build: cc -O3 -shared -fPIC (see sitewhere_tpu/native/__init__.py).
  */
 
+#define _GNU_SOURCE  /* strtod_l */
 #include <stddef.h>
 #include <stdlib.h>
 #include <string.h>
+#include <locale.h>
+
+/* locale-independent strtod: a host app calling setlocale(LC_NUMERIC)
+ * must not silently defeat '.'-decimal parsing (glibc strtod_l). */
+static locale_t c_locale(void) {
+    static locale_t loc = (locale_t)0;
+    if (loc == (locale_t)0)
+        loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    return loc;
+}
 
 #define SW_UNSUPPORTED (-1)
 #define SW_MALFORMED   (-2)
@@ -55,9 +66,10 @@ static int parse_plain_string(cur_t *c, const char **start, long *len) {
     c->p++;
     *start = c->p;
     while (c->p < c->end) {
-        char ch = *c->p;
+        unsigned char ch = (unsigned char)*c->p;
         if (ch == '"') { *len = c->p - *start; c->p++; return 0; }
         if (ch == '\\') return SW_UNSUPPORTED;
+        if (ch < 0x20) return SW_MALFORMED;  /* raw control char: json.loads rejects */
         c->p++;
     }
     return SW_MALFORMED;
@@ -80,7 +92,9 @@ static int parse_number(cur_t *c, double *out) {
     char *endp = NULL;
     /* the buffer is NUL-bounded by the caller (CPython bytes), so strtod
      * cannot run off the end */
-    *out = strtod(c->p, &endp);
+    locale_t loc = c_locale();
+    *out = loc != (locale_t)0 ? strtod_l(c->p, &endp, loc)
+                              : strtod(c->p, &endp);
     if (endp == c->p) return SW_MALFORMED;
     c->p = endp;
     return 0;
@@ -122,19 +136,34 @@ static int skip_value(cur_t *c, int depth) {
             return SW_MALFORMED;
         }
     }
-    /* number / true / false / null — must consume at least one char of
-     * a plausible atom, or '{"x":}'-style garbage would pass silently */
-    if (!(ch == '-' || (ch >= '0' && ch <= '9') || ch == 't' || ch == 'f'
-          || ch == 'n'))
-        return SW_MALFORMED;
-    const char *start = c->p;
-    while (c->p < c->end) {
-        ch = *c->p;
-        if (ch == ',' || ch == '}' || ch == ']' || ch == ' ' || ch == '\n'
-            || ch == '\t' || ch == '\r') break;
-        c->p++;
+    /* strict atoms: exact literals or a JSON number — anything looser
+     * ('truish', '1.2.3', bare '-') would ingest payloads json.loads
+     * rejects, breaking the speed-not-coverage contract */
+    if (ch == 't') {
+        if (c->end - c->p >= 4 && memcmp(c->p, "true", 4) == 0) {
+            c->p += 4;
+        } else return SW_MALFORMED;
+    } else if (ch == 'f') {
+        if (c->end - c->p >= 5 && memcmp(c->p, "false", 5) == 0) {
+            c->p += 5;
+        } else return SW_MALFORMED;
+    } else if (ch == 'n') {
+        if (c->end - c->p >= 4 && memcmp(c->p, "null", 4) == 0) {
+            c->p += 4;
+        } else return SW_MALFORMED;
+    } else {
+        double d;
+        int rc = parse_number(c, &d);
+        if (rc) return rc;
     }
-    return c->p > start ? 0 : SW_MALFORMED;
+    /* the atom must end at a structural boundary ('truish' / '1.2.3') */
+    if (c->p < c->end) {
+        ch = *c->p;
+        if (!(ch == ',' || ch == '}' || ch == ']' || ch == ' '
+              || ch == '\n' || ch == '\t' || ch == '\r'))
+            return SW_MALFORMED;
+    }
+    return 0;
 }
 
 /* One event object: {"type": "measurement", "name": S, "value": N,
@@ -207,6 +236,9 @@ long sw_parse_bulk(const char *buf, long len,
         if (str_eq(k, kn, "device") || str_eq(k, kn, "device_token")) {
             if ((rc = parse_plain_string(&c, &dev, &dev_len))) return rc;
         } else if (str_eq(k, kn, "events")) {
+            /* duplicate keys: json.loads is last-wins; appending both
+             * arrays would ingest different data than the Python path */
+            if (seen_events) return SW_UNSUPPORTED;
             seen_events = 1;
             if (!expect(&c, '[')) return SW_MALFORMED;
             skip_ws(&c);

@@ -31,10 +31,9 @@ def test_parse_matches_python_decoder():
     assert fast is not None
     dev, name, vals, ets = fast
     # reference: the Python columns path on the same payload
-    kind, out = "columns", JsonDecoder._columns_from_obj(
-        json.loads(payload), {}
-    ) or ("requests", None)
-    toks, names, pvals, pets = out if isinstance(out, tuple) else out
+    out = JsonDecoder._columns_from_obj(json.loads(payload), {})
+    assert out is not None
+    toks, names, pvals, pets = out
     assert dev == toks[0] and name == names[0]
     np.testing.assert_allclose(vals, np.asarray(pvals, np.float32))
     np.testing.assert_allclose(ets, np.asarray(pets, np.float64))
@@ -77,6 +76,32 @@ def test_malformed_returns_none_then_python_raises():
     assert parse_json_bulk(b"{nope") is None
     with pytest.raises(DecodeError):
         JsonDecoder().decode_any(b"{nope", {})
+
+
+@pytest.mark.parametrize("raw", [
+    # shapes json.loads REJECTS — the native path must never ingest them
+    b'{"device":"d","x":truish,"events":[{"name":"t","value":1}]}',
+    b'{"device":"d","x":1.2.3,"events":[{"name":"t","value":1}]}',
+    b'{"device":"d","x":-,"events":[{"name":"t","value":1}]}',
+    b'{"device":"d","x":,"events":[{"name":"t","value":1}]}',
+    b'{"device":"d","events":[{"name":"t","value":0x10}]}',
+    b'{"device":"d","events":[{"name":"t","value":+1}]}',
+    b'{"device":"d\ne","events":[{"name":"t","value":1}]}',  # raw ctrl char
+])
+def test_strictness_matches_json_loads(raw):
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(raw)
+    assert parse_json_bulk(raw) is None
+
+
+def test_duplicate_events_key_bails():
+    # valid JSON, but json.loads is last-wins; concatenating would ingest
+    # different data than the Python path → must fall back
+    raw = (b'{"device":"d","events":[{"name":"t","value":1}],'
+           b'"events":[{"name":"t","value":2}]}')
+    assert parse_json_bulk(raw) is None
+    kind, out = JsonDecoder().decode_any(raw, {})
+    assert len(out[2] if kind == "columns" else out) == 1  # last-wins
 
 
 def test_unknown_keys_and_nesting_skipped():
