@@ -312,3 +312,49 @@ class TestQueueConnector:
             await c.on_stop()
         finally:
             await broker.terminate()
+
+
+class TestQueueConnectorRecovery:
+    async def test_amqp_redials_after_connection_drop(self):
+        """After a connection drop (the post-failure state deliver()
+        leaves behind), the next delivery re-dials transparently."""
+        from sitewhere_tpu.comm.amqp import AmqpBroker, AmqpClient
+        from sitewhere_tpu.pipeline.outbound import QueueConnector
+
+        broker = AmqpBroker(port=0)
+        await broker.initialize()
+        await broker.start()
+        try:
+            port = broker.bound_port
+            c = QueueConnector("q", backend="amqp", host="127.0.0.1",
+                               port=port, queue="rq")
+            got = []
+            consumer = await AmqpClient("127.0.0.1", port).connect()
+            await consumer.queue_declare("rq")
+
+            async def on_msg(body, queue):
+                got.append(json.loads(body))
+
+            await consumer.consume("rq", on_msg)
+            ok = await c.process(DeviceMeasurement(device_token="a", value=1.0))
+            assert ok and c.delivered == 1
+            first_client = c._amqp
+            assert first_client is not None
+            # simulate what a failed publish does: drop the connection
+            await c._drop_amqp(first_client)
+            assert c._amqp is None
+            # next delivery re-dials a FRESH client and still lands
+            ok = await c.process(DeviceMeasurement(device_token="b", value=2.0))
+            assert ok and c._amqp is not None and c._amqp is not first_client
+            for _ in range(200):
+                if len(got) >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            assert [g["device_token"] for g in got] == ["a", "b"]
+            # a stale client's late failure must NOT tear down the fresh one
+            await c._drop_amqp(first_client)
+            assert c._amqp is not None
+            await consumer.close()
+            await c.on_stop()
+        finally:
+            await broker.terminate()

@@ -200,3 +200,30 @@ async def test_disabled_training_tenant_is_masked_in_shared_stack():
         assert diverged("frozen") == 0.0, "frozen tenant's params moved"
     finally:
         await inst.terminate()
+
+
+async def test_wire_dtype_conflict_surfaces():
+    """A second tenant asking a DIFFERENT wire dtype on an existing
+    family stack is surfaced (metric + recorded error), not silent."""
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig, MeshConfig
+
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="wd",
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+    ))
+    await inst.start()
+    try:
+        await inst.tenant_management.create_tenant(
+            "w1", template="iot-temperature", wire_dtype="bf16")
+        await inst.tenant_management.create_tenant(
+            "w2", template="iot-temperature", wire_dtype="f32")
+        await inst.drain_tenant_updates()  # applies both adds synchronously
+        assert "w2" in inst.tenants
+        conflicts = inst.metrics.counter(
+            "tpu_inference.wire_dtype_conflicts")
+        assert conflicts.value == 1
+        # the family runs at the FIRST tenant's wire (documented first-wins)
+        assert inst.inference.scorers["lstm_ad"].wire_dtype == "bf16"
+    finally:
+        await inst.terminate()
