@@ -32,6 +32,8 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+from sitewhere_tpu.runtime import safepickle
+
 import numpy as np
 
 
@@ -91,7 +93,7 @@ class CheckpointManager:
         if not path.exists():
             return None
         with path.open("rb") as fh:
-            return pickle.load(fh)
+            return safepickle.loads(fh.read())
 
     def delete_params(self, tenant: str) -> None:
         for p in (self.root / "params").glob(f"{tenant}.*.ckpt"):
@@ -124,7 +126,7 @@ class CheckpointManager:
         if not path.exists():
             return False
         with path.open("rb") as fh:
-            state = pickle.load(fh)
+            state = safepickle.loads(fh.read())
         bus.restore_state(state)
         return True
 

@@ -37,6 +37,7 @@ import urllib.parse
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
+from sitewhere_tpu.runtime import safepickle
 from sitewhere_tpu.runtime.bus import (
     EventBus,
     PartitionedTopic,
@@ -118,7 +119,7 @@ def read_segments(root: Path) -> List[Tuple[int, Any]]:
             if pos + _LEN.size + n > len(data):
                 break  # torn tail
             try:
-                out.append(pickle.loads(data[pos + _LEN.size:pos + _LEN.size + n]))
+                out.append(safepickle.loads(data[pos + _LEN.size:pos + _LEN.size + n]))
             except Exception:  # noqa: BLE001 - corrupt frame ends the segment
                 break
             pos += _LEN.size + n
@@ -181,7 +182,7 @@ class OffsetsJournal:
             if pos + _LEN.size + n > len(data):
                 break
             try:
-                rec = pickle.loads(data[pos + _LEN.size:pos + _LEN.size + n])
+                rec = safepickle.loads(data[pos + _LEN.size:pos + _LEN.size + n])
             except Exception:  # noqa: BLE001
                 break
             if rec[0] == "s":

@@ -10,11 +10,12 @@ length-prefixed asyncio TCP protocol; ``RemoteEventBus`` implements the
 EventBus surface over one multiplexed connection, so a
 ``SiteWhereInstance`` runs unchanged against either backend.
 
-Wire format: 4-byte big-endian length + pickle. Pickle is acceptable
-HERE because broker and clients are the same trust domain (one
-deployment's processes — the broker is ours, not an open port protocol);
-payloads are arbitrary Python objects (columnar ``MeasurementBatch`` on
-the hot path) exactly as on the in-proc bus.
+Wire format: 4-byte big-endian length + pickle, deserialized through
+the RESTRICTED unpickler (``runtime.safepickle``): only stdlib
+containers, numpy reconstruction, and ``sitewhere_tpu.*`` classes load —
+a compromised peer or tampered frame cannot smuggle an
+arbitrary-constructor gadget. Payloads are arbitrary framework objects
+(columnar ``MeasurementBatch`` on the hot path) exactly as in-proc.
 
 Protocol: requests ``(req_id, op, args)``; responses ``(req_id, ok,
 value)``. ``req_id is None`` marks fire-and-forget (no response) — used
@@ -32,6 +33,7 @@ import pickle
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
+from sitewhere_tpu.runtime import safepickle
 from sitewhere_tpu.runtime.bus import EventBus, FaultPlan, TopicNaming
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 
@@ -49,7 +51,7 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     (n,) = _LEN.unpack(head)
     if n > MAX_FRAME:
         raise ValueError(f"frame too large: {n}")
-    return pickle.loads(await reader.readexactly(n))
+    return safepickle.loads(await reader.readexactly(n))
 
 
 class BusBrokerServer(LifecycleComponent):
@@ -99,6 +101,12 @@ class BusBrokerServer(LifecycleComponent):
                 try:
                     req_id, op, args = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                except (safepickle.UnpicklingError, ValueError) as exc:
+                    # hostile/corrupt frame (gadget class, oversize, bad
+                    # shape): drop THIS connection, quietly — the broker
+                    # and every other client stay up
+                    self._record_error("frame", exc)
                     return
                 # each request runs in its own task so a long-poll can't
                 # block other ops multiplexed on this connection
@@ -280,6 +288,11 @@ class RemoteEventBus:
                 req_id, ok, value = await _read_frame(self._reader)
             except (asyncio.IncompleteReadError, ConnectionResetError,
                     OSError):
+                self._mark_disconnected()
+                return
+            except (safepickle.UnpicklingError, ValueError):
+                # hostile/corrupt broker frame: treat like a dead link —
+                # disconnect and let the reconnect path take over
                 self._mark_disconnected()
                 return
             fut = self._futures.pop(req_id, None)

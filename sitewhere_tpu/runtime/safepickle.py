@@ -1,0 +1,101 @@
+"""Restricted pickle deserialization for wire/disk frames.
+
+The TCP bus broker and the durable log carry arbitrary Python payloads
+(columnar ``MeasurementBatch`` on the hot path) as pickle frames. Plain
+``pickle.loads`` executes arbitrary constructors, so a compromised peer
+or a tampered segment file becomes remote code execution. This module
+keeps pickle's generality for the framework's OWN types while refusing
+everything else:
+
+- stdlib container/scalar types (list/dict/set/tuple/…),
+- the numpy array reconstruction path (ndarray/dtype/_reconstruct/scalar),
+- datetime/uuid (event fields),
+- any class defined under ``sitewhere_tpu.`` (plain dataclasses/enums —
+  none define custom ``__reduce__``).
+
+Anything outside the allowlist (``os.system``, ``subprocess``,
+``functools.partial`` gadget chains, …) raises ``UnpicklingError``
+instead of executing. Serialization stays plain ``pickle.dumps``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+_SAFE_BUILTINS = {
+    "list", "dict", "set", "frozenset", "tuple", "bytearray", "complex",
+    "slice", "range", "bool", "int", "float", "str", "bytes", "object",
+}
+
+# (module, qualname) pairs outside the prefix rules
+_SAFE_EXACT = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy", "bool_"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("datetime", "datetime"),
+    ("datetime", "timezone"),
+    ("datetime", "timedelta"),
+    ("datetime", "date"),
+    ("uuid", "UUID"),
+    ("collections", "OrderedDict"),
+    ("collections", "deque"),
+    ("_codecs", "encode"),  # numpy string-array reconstruction uses it
+}
+
+_SAFE_MODULE_PREFIXES = (
+    "sitewhere_tpu.",
+    "numpy.dtypes",  # numpy 2.x per-dtype classes
+)
+
+
+class UnpicklingError(pickle.UnpicklingError):
+    pass
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):  # noqa: D102
+        # dotted names are CPython's getattr-traversal path: a frame
+        # claiming module='sitewhere_tpu.runtime.dlog', name='os.system'
+        # would pass a bare prefix check and then walk dlog's 'import os'
+        # attribute to an arbitrary callable. No allowlisted class has a
+        # dotted qualname — refuse them outright.
+        if "." in name:
+            raise UnpicklingError(
+                f"refusing dotted global {module}.{name} (attribute "
+                "traversal — see runtime/safepickle.py)"
+            )
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        if (module, name) in _SAFE_EXACT:
+            return super().find_class(module, name)
+        if any(module.startswith(p) for p in _SAFE_MODULE_PREFIXES):
+            return super().find_class(module, name)
+        raise UnpicklingError(
+            f"refusing to unpickle {module}.{name} (not on the wire "
+            "allowlist — see runtime/safepickle.py)"
+        )
+
+
+def loads(data: bytes) -> Any:
+    """Deserialize with the restricted unpickler. EVERY failure — refused
+    global, corrupt bytes (base pickle.UnpicklingError), missing module/
+    attribute, truncation — surfaces as safepickle.UnpicklingError, so
+    call sites catch exactly one type for 'hostile or corrupt frame'."""
+    try:
+        return _RestrictedUnpickler(io.BytesIO(data)).load()
+    except UnpicklingError:
+        raise
+    except Exception as exc:  # noqa: BLE001 - normalize the failure type
+        raise UnpicklingError(f"undecodable frame: {exc}") from exc
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
