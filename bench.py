@@ -673,51 +673,86 @@ def bench_e2e_multitenant(secs: float, **kw) -> dict:
     return asyncio.run(_bench_e2e_multitenant(secs, **kw))
 
 
+def _run_bench_subprocess(
+    flags: list, key: str, timeout_s: float, env=None
+) -> dict:
+    """Shared child-bench harness: run ``bench.py <flags>`` in a fresh
+    process and return details[key]. A hung or failed child reports an
+    error entry instead of taking down the whole run (the driver depends
+    on the one-JSON-line stdout contract)."""
+    import os
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        child_details = tf.name
+    cmd = [sys.executable, __file__, *flags, "--details-out", child_details]
+    try:
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout_s,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            return {"error": f"subprocess timed out ({timeout_s}s): {flags}"}
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or "")[-800:]}
+        try:
+            with open(child_details) as f:
+                return json.load(f)[key]
+        except (OSError, ValueError, KeyError) as exc:
+            return {"error": f"parse: {exc}; stderr tail: {proc.stderr[-400:]}"}
+    finally:
+        try:
+            os.unlink(child_details)
+        except OSError:
+            pass
+
+
+def run_config_subprocess(config: str, key: str, args, timeout_s: float = 1200) -> dict:
+    """Run one bench config in a FRESH process with the parent's e2e
+    flags forwarded. Full runs isolate the heavy e2e configs this way:
+    accumulated per-config state (multi-GB object columns, allocator/GC
+    pressure) otherwise degrades the later configs — measured: e2e-json
+    93k ev/s at the tail of a full run vs 1.14M in isolation."""
+    flags = [
+        "--configs", config,
+        "--e2e-secs", str(args.e2e_secs),
+        "--e2e-wire", args.e2e_wire,
+        "--e2e-slots", str(args.e2e_slots),
+        "--e2e-max-batch", str(args.e2e_max_batch),
+        "--e2e-wire-dtype", args.e2e_wire_dtype,
+        "--e2e-inflight", str(args.e2e_inflight),
+        "--e2e-paced-frac", str(args.e2e_paced_frac),
+        "--e2e-paced-rate", str(args.e2e_paced_rate),
+        "--e2e-burst", str(args.e2e_burst),
+        "--e2e-hidden", str(args.e2e_hidden),
+        "--e2e-window", str(args.e2e_window),
+    ]
+    if args.backend:
+        flags += ["--backend", args.backend]
+    return _run_bench_subprocess(flags, key, timeout_s)
+
+
 def bench_e2e_cpu_subprocess(secs: float) -> dict:
     """Run the E2E latency phase on the CPU backend (RTT=0) in a fresh
     subprocess — isolates host+collect latency from the tunnel RTT, per
     the p99 budget decomposition. Small config: CPU LSTM compute would
     otherwise dominate the very latency being measured."""
     import os
-    import subprocess
-    import tempfile
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
-    with tempfile.NamedTemporaryFile(
-        suffix=".json", delete=False
-    ) as tf:
-        child_details = tf.name
-    try:
-        try:
-            proc = subprocess.run(
-                [sys.executable, __file__, "--configs", "e2e",
-                 "--backend", "cpu",
-                 "--e2e-secs", str(secs), "--e2e-wire", "binary",
-                 "--e2e-slots", "1", "--e2e-max-batch", "256",
-                 "--e2e-burst", "2", "--e2e-paced-rate", "4000",
-                 "--e2e-hidden", "32", "--e2e-window", "16",
-                 "--details-out", child_details],
-                capture_output=True, text=True, timeout=900, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired:
-            # a hung child must not take down the whole bench run (the
-            # driver depends on the one-JSON-line stdout contract)
-            return {"error": "cpu-backend e2e subprocess timed out (900s)"}
-        if proc.returncode != 0:
-            return {"error": (proc.stderr or "")[-800:]}
-        try:
-            with open(child_details) as f:
-                return json.load(f)["e2e_pipeline"]
-        except (OSError, ValueError, KeyError) as exc:
-            return {"error": f"parse: {exc}; stdout tail: {proc.stdout[-400:]}"}
-    finally:
-        try:
-            os.unlink(child_details)
-        except OSError:
-            pass
+    return _run_bench_subprocess(
+        ["--configs", "e2e", "--backend", "cpu",
+         "--e2e-secs", str(secs), "--e2e-wire", "binary",
+         "--e2e-slots", "1", "--e2e-max-batch", "256",
+         "--e2e-burst", "2", "--e2e-paced-rate", "4000",
+         "--e2e-hidden", "32", "--e2e-window", "16"],
+        "e2e_pipeline", timeout_s=900, env=env,
+    )
 
 
 # ---------------------------------------------------------------- main
@@ -826,41 +861,61 @@ def main() -> None:
             f"pipeline ({details['vit_media']['model_only']['frames_per_sec']:.0f} "
             f"model-only; h2d={details['vit_media']['h2d_mbps']:.0f} MB/s)")
 
+    # full runs isolate each heavy e2e config in its own process (see
+    # run_config_subprocess); a single named config executes inline
+    isolate = len(which) > 1
+
     if "e2e" in which:
         log("config 1: full-pipeline E2E (sim -> ... -> outbound) ...")
-        details["e2e_pipeline"] = bench_e2e(
-            args.e2e_secs, n_devices=100, burst=args.e2e_burst,
-            wire=args.e2e_wire,
-            slots_per_shard=args.e2e_slots, max_batch=args.e2e_max_batch,
-            max_inflight=args.e2e_inflight,
-            paced_frac=args.e2e_paced_frac, paced_rate=args.e2e_paced_rate,
-            hidden=args.e2e_hidden, window=args.e2e_window,
-            wire_dtype=args.e2e_wire_dtype,
-        )
-        log(f"  -> {details['e2e_pipeline']['events_per_sec']:.0f} ev/s e2e, "
-            f"p99={details['e2e_pipeline']['p99_ms']:.1f}ms")
+        if isolate:
+            details["e2e_pipeline"] = run_config_subprocess(
+                "e2e", "e2e_pipeline", args)
+        else:
+            details["e2e_pipeline"] = bench_e2e(
+                args.e2e_secs, n_devices=100, burst=args.e2e_burst,
+                wire=args.e2e_wire,
+                slots_per_shard=args.e2e_slots, max_batch=args.e2e_max_batch,
+                max_inflight=args.e2e_inflight,
+                paced_frac=args.e2e_paced_frac, paced_rate=args.e2e_paced_rate,
+                hidden=args.e2e_hidden, window=args.e2e_window,
+                wire_dtype=args.e2e_wire_dtype,
+            )
+        if "error" not in details["e2e_pipeline"]:
+            log(f"  -> {details['e2e_pipeline']['events_per_sec']:.0f} ev/s "
+                f"e2e, p99={details['e2e_pipeline']['p99_ms']:.1f}ms")
 
     if "e2e-json" in which:
         log("config 1b: E2E on the JSON wire ...")
-        # identical workload to config 1 except the wire — the delta
-        # isolates wire format, not burst amortization
-        details["e2e_pipeline_json"] = bench_e2e(
-            min(args.e2e_secs, 8.0), n_devices=100, burst=args.e2e_burst,
-            wire="json",
-            slots_per_shard=args.e2e_slots, max_batch=args.e2e_max_batch,
-            max_inflight=args.e2e_inflight,
-            paced_frac=args.e2e_paced_frac,
-            hidden=args.e2e_hidden, window=args.e2e_window,
-            wire_dtype=args.e2e_wire_dtype,
-        )
-        log(f"  -> {details['e2e_pipeline_json']['events_per_sec']:.0f} "
-            f"ev/s e2e (json)")
+        if isolate:
+            details["e2e_pipeline_json"] = run_config_subprocess(
+                "e2e-json", "e2e_pipeline_json", args)
+        else:
+            # identical workload to config 1 except the wire — the delta
+            # isolates wire format, not burst amortization
+            details["e2e_pipeline_json"] = bench_e2e(
+                min(args.e2e_secs, 8.0), n_devices=100, burst=args.e2e_burst,
+                wire="json",
+                slots_per_shard=args.e2e_slots, max_batch=args.e2e_max_batch,
+                max_inflight=args.e2e_inflight,
+                paced_frac=args.e2e_paced_frac,
+                hidden=args.e2e_hidden, window=args.e2e_window,
+                wire_dtype=args.e2e_wire_dtype,
+            )
+        if "error" not in details["e2e_pipeline_json"]:
+            log(f"  -> {details['e2e_pipeline_json']['events_per_sec']:.0f} "
+                f"ev/s e2e (json)")
 
     if "e2e-32t" in which:
         log("config 4b: 32-tenant FULL pipeline (stacked flushes) ...")
-        details["e2e_pipeline_32t"] = bench_e2e_multitenant(10.0)
-        log(f"  -> {details['e2e_pipeline_32t']['events_per_sec']:.0f} "
-            f"ev/s across {details['e2e_pipeline_32t']['n_tenants']} tenants")
+        if isolate:
+            details["e2e_pipeline_32t"] = run_config_subprocess(
+                "e2e-32t", "e2e_pipeline_32t", args)
+        else:
+            details["e2e_pipeline_32t"] = bench_e2e_multitenant(10.0)
+        if "error" not in details["e2e_pipeline_32t"]:
+            log(f"  -> {details['e2e_pipeline_32t']['events_per_sec']:.0f} "
+                f"ev/s across "
+                f"{details['e2e_pipeline_32t']['n_tenants']} tenants")
 
     if "e2e-cpu" in which:
         log("config 1c: E2E latency on the CPU backend (RTT=0) ...")

@@ -10,12 +10,22 @@ everything else:
 - stdlib container/scalar types (list/dict/set/tuple/…),
 - the numpy array reconstruction path (ndarray/dtype/_reconstruct/scalar),
 - datetime/uuid (event fields),
-- any class defined under ``sitewhere_tpu.`` (plain dataclasses/enums —
-  none define custom ``__reduce__``).
+- CLASSES defined in the DATA layer (``sitewhere_tpu.core.*`` — plain
+  dataclasses/enums whose constructors only assign fields). Everything
+  that legitimately crosses the bus/log/checkpoint boundary is built
+  from these: events, model entities, MeasurementBatch, plus plain
+  containers. Service/runtime classes are NOT admitted — a frame must
+  not be able to invoke a side-effectful constructor (e.g. a manager
+  class whose __init__ touches the filesystem), and module-level
+  functions are refused outright.
+
+Deployments whose connectors publish custom payload classes opt in
+explicitly with ``register_class(cls)``.
 
 Anything outside the allowlist (``os.system``, ``subprocess``,
-``functools.partial`` gadget chains, …) raises ``UnpicklingError``
-instead of executing. Serialization stays plain ``pickle.dumps``.
+``functools.partial`` gadget chains, dotted attribute traversal, …)
+raises ``UnpicklingError`` instead of executing. Serialization stays
+plain ``pickle.dumps``.
 """
 
 from __future__ import annotations
@@ -51,9 +61,24 @@ _SAFE_EXACT = {
 }
 
 _SAFE_MODULE_PREFIXES = (
-    "sitewhere_tpu.",
-    "numpy.dtypes",  # numpy 2.x per-dtype classes
+    "sitewhere_tpu.core.",  # the data layer: dataclasses/enums only
+    "numpy.dtypes",         # numpy 2.x per-dtype classes
 )
+
+# deployment opt-in: custom payload classes admitted by exact identity
+_REGISTERED: set = set()
+
+
+def register_class(cls) -> None:
+    """Admit a custom payload class (exact module+qualname match) for
+    wire/disk deserialization — for deployments whose connectors publish
+    their own event types. Classes only; constructors run during
+    unpickling, so register nothing with a side-effectful __init__."""
+    import inspect
+
+    if not inspect.isclass(cls):
+        raise TypeError(f"register_class needs a class, got {cls!r}")
+    _REGISTERED.add((cls.__module__, cls.__qualname__))
 
 
 class UnpicklingError(pickle.UnpicklingError):
@@ -74,10 +99,21 @@ class _RestrictedUnpickler(pickle.Unpickler):
             )
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
-        if (module, name) in _SAFE_EXACT:
+        if (module, name) in _SAFE_EXACT or (module, name) in _REGISTERED:
             return super().find_class(module, name)
         if any(module.startswith(p) for p in _SAFE_MODULE_PREFIXES):
-            return super().find_class(module, name)
+            import inspect
+
+            resolved = super().find_class(module, name)
+            # classes only: a module-level FUNCTION resolved here would be
+            # an arbitrary-call gadget (REDUCE invokes it with attacker
+            # args). Data-layer class constructors just assign fields.
+            if inspect.isclass(resolved):
+                return resolved
+            raise UnpicklingError(
+                f"refusing non-class global {module}.{name} (functions "
+                "are call gadgets — see runtime/safepickle.py)"
+            )
         raise UnpicklingError(
             f"refusing to unpickle {module}.{name} (not on the wire "
             "allowlist — see runtime/safepickle.py)"

@@ -58,8 +58,6 @@ def test_gadgets_refused():
 def test_dotted_global_traversal_refused():
     """STACK_GLOBAL with module='sitewhere_tpu.…', name='os.system' must
     NOT resolve via attribute traversal (the prefix-allowlist bypass)."""
-    import pickletools
-
     # hand-build a protocol-4 frame: push module+qualname, STACK_GLOBAL,
     # then REDUCE with ('true',) would exec if the global resolved
     frame = (
@@ -72,7 +70,6 @@ def test_dotted_global_traversal_refused():
         b"R" +                                     # REDUCE
         b"."
     )
-    pickletools.dis  # (import exercised; frame is valid pickle)
     with pytest.raises(safepickle.UnpicklingError, match="dotted"):
         safepickle.loads(frame)
 
@@ -93,3 +90,51 @@ def test_corrupt_bytes_raise_the_one_type():
     )
     with pytest.raises(safepickle.UnpicklingError):
         safepickle.loads(frame)
+
+
+def test_service_constructors_and_functions_refused():
+    """Only DATA-layer classes load: a manager class with a filesystem-
+    touching __init__ and module-level functions are call gadgets."""
+    # CheckpointManager('/tmp/...') via REDUCE would mkdir at any path
+    frame = (
+        b"\x80\x04"
+        b"\x8c sitewhere_tpu.runtime.checkpoint"
+        b"\x8c\x11CheckpointManager"
+        b"\x93"
+        b"\x8c\x0f/tmp/pwned-test"
+        b"\x85R."
+    )
+    with pytest.raises(safepickle.UnpicklingError):
+        safepickle.loads(frame)
+    import os
+    assert not os.path.exists("/tmp/pwned-test")
+    # module-level function in an allowlisted-prefix module
+    frame = (
+        b"\x80\x04"
+        b"\x8c\x18sitewhere_tpu.core.batch"
+        b"\x8c\x0emake_event_ids"
+        b"\x93."
+    )
+    with pytest.raises(safepickle.UnpicklingError, match="non-class"):
+        safepickle.loads(frame)
+
+
+class CustomPayload:  # module-level: local classes don't pickle
+    def __init__(self):
+        self.x = 7
+
+
+def test_register_class_opt_in():
+    import pickle as _p
+
+    frame = _p.dumps(CustomPayload())
+    with pytest.raises(safepickle.UnpicklingError):
+        safepickle.loads(frame)
+    safepickle.register_class(CustomPayload)
+    try:
+        assert safepickle.loads(frame).x == 7
+    finally:
+        safepickle._REGISTERED.discard(
+            (CustomPayload.__module__, CustomPayload.__qualname__))
+    with pytest.raises(TypeError):
+        safepickle.register_class(lambda: None)
