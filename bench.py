@@ -883,6 +883,8 @@ def main() -> None:
         if "error" not in details["e2e_pipeline"]:
             log(f"  -> {details['e2e_pipeline']['events_per_sec']:.0f} ev/s "
                 f"e2e, p99={details['e2e_pipeline']['p99_ms']:.1f}ms")
+        else:
+            log(f"  -> FAILED: {details['e2e_pipeline']['error'][:300]}")
 
     if "e2e-json" in which:
         log("config 1b: E2E on the JSON wire ...")
@@ -904,6 +906,8 @@ def main() -> None:
         if "error" not in details["e2e_pipeline_json"]:
             log(f"  -> {details['e2e_pipeline_json']['events_per_sec']:.0f} "
                 f"ev/s e2e (json)")
+        else:
+            log(f"  -> FAILED: {details['e2e_pipeline_json']['error'][:300]}")
 
     if "e2e-32t" in which:
         log("config 4b: 32-tenant FULL pipeline (stacked flushes) ...")
@@ -916,6 +920,8 @@ def main() -> None:
             log(f"  -> {details['e2e_pipeline_32t']['events_per_sec']:.0f} "
                 f"ev/s across "
                 f"{details['e2e_pipeline_32t']['n_tenants']} tenants")
+        else:
+            log(f"  -> FAILED: {details['e2e_pipeline_32t']['error'][:300]}")
 
     if "e2e-cpu" in which:
         log("config 1c: E2E latency on the CPU backend (RTT=0) ...")

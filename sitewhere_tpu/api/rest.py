@@ -161,6 +161,7 @@ class RestApi:
         r.add_delete("/api/assignments/{token}", self.release_assignment)
 
         r.add_get("/api/events", self.list_events)
+        r.add_get("/api/events/search", self.search_events)
         r.add_get("/api/devicegroups", self.list_device_groups)
         r.add_post("/api/devicegroups", self.create_device_group)
         r.add_get("/api/devicegroups/{token}", self.get_device_group)
@@ -572,6 +573,27 @@ class RestApi:
         )
         rt.device_management.create_zone(z)
         return web.json_response(_entity(z), status=201)
+
+    async def search_events(self, request) -> web.Response:
+        """Term search over recent events (the Solr-indexer analog):
+        AND-semantics tokens over device/name/alert/area fields. Needs
+        the tenant's ``search_index`` config flag."""
+        rt = self._tenant(request)
+        if rt.search is None:
+            return web.json_response(
+                {"error": "search_index not enabled for this tenant"},
+                status=400,
+            )
+        q = request.query.get("q", "").strip()
+        if not q:
+            return web.json_response({"error": "missing ?q="}, status=400)
+        limit = min(int(request.query.get("limit", 100)), 1000)
+        hits = rt.search.search(q, limit=limit)
+        return web.json_response({
+            "results": [e.to_dict() for e in hits],
+            "query": q,
+            "indexed": rt.search.indexed,
+        })
 
     # -- device groups ---------------------------------------------------
     @staticmethod

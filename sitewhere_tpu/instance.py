@@ -100,6 +100,7 @@ class TenantRuntime:
     broker_handler: object = None  # tenant input handler (for unsubscribe)
     media_pipeline: object = None  # MediaClassificationPipeline | None
     mqtt_source: object = None     # EventSource over a real MQTT socket
+    search: object = None          # SearchIndexConnector | None
 
     def components(self) -> List[LifecycleComponent]:
         out = [
@@ -337,16 +338,21 @@ class SiteWhereInstance(LifecycleComponent):
         rules = RuleEngine(tenant, self.bus, [
             anomaly_score_rule(f"{tenant}-anomaly", min_score=3.0, cooldown_ms=5000),
         ], self.metrics)
+        connectors = [
+            LogConnector(f"log[{tenant}]"),
+            MqttTopicConnector(
+                f"mqtt-out[{tenant}]", self.broker,
+                topic_pattern=f"sitewhere/{tenant}/output/{{device}}/{{type}}",
+            ),
+        ]
+        search = None
+        if cfg.search_index:
+            from sitewhere_tpu.pipeline.outbound import SearchIndexConnector
+
+            search = SearchIndexConnector(f"search[{tenant}]")
+            connectors.append(search)
         outbound = OutboundDispatcher(
-            tenant, self.bus,
-            [
-                LogConnector(f"log[{tenant}]"),
-                MqttTopicConnector(
-                    f"mqtt-out[{tenant}]", self.broker,
-                    topic_pattern=f"sitewhere/{tenant}/output/{{device}}/{{type}}",
-                ),
-            ],
-            self.metrics,
+            tenant, self.bus, connectors, self.metrics,
         )
         mqtt_source = None
         if cfg.mqtt_ingest:
@@ -425,6 +431,7 @@ class SiteWhereInstance(LifecycleComponent):
             batch=BatchOperationManager(tenant, self.bus, dm, self.metrics),
             schedules=ScheduleManager(tenant, self.bus, self.metrics),
             broker_handler=on_broker_msg,
+            search=search,
         )
 
     async def add_tenant(self, cfg: TenantEngineConfig) -> TenantRuntime:

@@ -79,6 +79,10 @@ class TenantEngineConfig:
     # tenant-scoped 'sitewhere/{tenant}/input/+' pattern is always active.
     # With >1 tenant and no flag, shared-input routes to NO tenant (isolation)
     shared_input: bool = False
+    # opt-in local search indexing (the Solr-connector analog): adds a
+    # SearchIndexConnector to the outbound chain and serves term search
+    # over recent events at GET /api/events/search?q=...
+    search_index: bool = False
 
 
 @dataclass(frozen=True)

@@ -92,6 +92,11 @@ class _RestrictedUnpickler(pickle.Unpickler):
         # would pass a bare prefix check and then walk dlog's 'import os'
         # attribute to an arbitrary callable. No allowlisted class has a
         # dotted qualname — refuse them outright.
+        # explicit registrations match by EXACT identity (no traversal
+        # involved), so nested registered classes (dotted qualnames) are
+        # fine — check them before the dotted-name refusal below
+        if (module, name) in _REGISTERED:
+            return super().find_class(module, name)
         if "." in name:
             raise UnpicklingError(
                 f"refusing dotted global {module}.{name} (attribute "
@@ -99,7 +104,7 @@ class _RestrictedUnpickler(pickle.Unpickler):
             )
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
-        if (module, name) in _SAFE_EXACT or (module, name) in _REGISTERED:
+        if (module, name) in _SAFE_EXACT:
             return super().find_class(module, name)
         if any(module.startswith(p) for p in _SAFE_MODULE_PREFIXES):
             import inspect

@@ -344,3 +344,64 @@ async def test_admin_console_and_ws_query_auth():
             msg = await asyncio.wait_for(ws.receive_json(), 10.0)
             assert msg["device_token"] == "dev-00001"
             await ws.close()
+
+
+async def test_event_search_endpoint():
+    """GET /api/events/search: the Solr-analog term search over the
+    tenant's recent events (search_index opt-in)."""
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="srch",
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=1),
+    ))
+    await inst.start()
+    try:
+        await inst.tenant_management.create_tenant(
+            "s1", template="iot-temperature", search_index=True)
+        await inst.drain_tenant_updates()
+        assert "s1" in inst.tenants
+        rt = inst.tenants["s1"]
+        rt.device_management.bootstrap_fleet(3)
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            inst.users.create_user("admin", "password", ["ROLE_ADMIN"])
+            resp = await client.post(
+                "/api/authapi/jwt",
+                json={"username": "admin", "password": "password"},
+            )
+            token = (await resp.json())["token"]
+            client._session.headers["Authorization"] = f"Bearer {token}"
+            client._session.headers["X-SiteWhere-Tenant"] = "s1"
+            # ingest a few measurements through the pipeline
+            for i in range(3):
+                await inst.broker.publish(
+                    f"sitewhere/s1/input/dev-0000{i}",
+                    json.dumps({"type": "measurement",
+                                "device_token": f"dev-0000{i}",
+                                "name": "humidity" if i == 1 else "temp",
+                                "value": 20.0 + i}).encode(),
+                )
+            idx = rt.search
+            for _ in range(300):
+                if idx.indexed >= 3:
+                    break
+                await asyncio.sleep(0.02)
+            resp = await client.get("/api/events/search?q=humidity")
+            body = await resp.json()
+            assert resp.status == 200, body
+            assert len(body["results"]) == 1
+            assert body["results"][0]["device_token"] == "dev-00001"
+            resp = await client.get("/api/events/search")
+            assert resp.status == 400  # missing ?q=
+            # a tenant WITHOUT the search_index flag → 400, not 500
+            await inst.tenant_management.create_tenant(
+                "s2", template="iot-temperature")
+            await inst.drain_tenant_updates()
+            client._session.headers["X-SiteWhere-Tenant"] = "s2"
+            resp = await client.get("/api/events/search?q=x")
+            assert resp.status == 400
+            assert "not enabled" in (await resp.json())["error"]
+        finally:
+            await client.close()
+    finally:
+        await inst.terminate()
