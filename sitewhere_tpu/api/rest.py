@@ -18,6 +18,8 @@ the reference's tenant auth headers [U].
 
 from __future__ import annotations
 
+import asyncio
+import base64
 import json
 from typing import Any, Callable, Optional
 
@@ -36,6 +38,7 @@ from sitewhere_tpu.core.model import (
     Zone,
 )
 from sitewhere_tpu.instance import SiteWhereInstance, TenantRuntime
+from sitewhere_tpu.runtime.bus import publish_at_least_once
 from sitewhere_tpu.services.batch_operations import BatchOpStatus
 from sitewhere_tpu.services.event_store import EventQuery
 from sitewhere_tpu.services.schedule_management import Schedule
@@ -184,6 +187,10 @@ class RestApi:
         r.add_post("/api/tenants", self.create_tenant)
         r.add_post("/api/tenants/{token}/restart", self.restart_tenant)
         r.add_delete("/api/tenants/{token}", self.delete_tenant)
+        r.add_get("/api/tenants/{token}/deadletter", self.deadletter_list)
+        r.add_post(
+            "/api/tenants/{token}/deadletter/requeue", self.deadletter_requeue
+        )
 
         r.add_get("/api/schedules", self.list_schedules)
         r.add_post("/api/schedules", self.create_schedule)
@@ -745,6 +752,159 @@ class RestApi:
             request.match_info["token"]
         )
         return web.json_response({"deleted": request.match_info["token"]})
+
+    # -- dead-letter inspection / requeue --------------------------------
+    async def _bus_topics(self) -> list:
+        res = self.instance.bus.topics()
+        return await res if asyncio.iscoroutine(res) else res
+
+    async def _bus_peek(self, topic: str, max_items: int) -> dict:
+        res = self.instance.bus.peek(topic, max_items)
+        return await res if asyncio.iscoroutine(res) else res
+
+    def _dlq_stage_topics(self, tenant: str, topics: list) -> dict:
+        """stage name → topic for every dead-letter topic this tenant has
+        (the decode stage's failed-decode topic is surfaced beside them)."""
+        naming = self.instance.bus.naming
+        prefix = naming.dead_letter_prefix(tenant)
+        stages = {
+            t[len(prefix):]: t for t in topics if t.startswith(prefix)
+        }
+        failed = naming.failed_decode(tenant)
+        if failed in topics:
+            stages.setdefault("decode", failed)
+        return stages
+
+    @staticmethod
+    def _dlq_entry_summary(offset: int, entry) -> dict:
+        if not isinstance(entry, dict):
+            return {"offset": offset, "payload_type": type(entry).__name__}
+        out = {
+            k: entry.get(k)
+            for k in ("stage", "attempts", "error", "source_topic", "ts")
+            if k in entry
+        }
+        out["offset"] = offset
+        payload = entry.get("payload")
+        if payload is not None:
+            out["payload_type"] = type(payload).__name__
+            rows = getattr(payload, "n", None)
+            if rows is not None:
+                out["rows"] = int(rows)
+        elif "payload_b64" in entry:
+            out["payload_type"] = "bytes"
+            out["source"] = entry.get("source", "")
+        return out
+
+    async def deadletter_list(self, request) -> web.Response:
+        """Dead-letter inspection: per-stage depth + newest entries
+        (stage / attempts / error / source topic metadata). Cursor-less —
+        listing never disturbs the requeue position."""
+        token = request.match_info["token"]
+        if token not in self.instance.tenants:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        limit = min(int(request.query.get("limit", 50)), 500)
+        stages = self._dlq_stage_topics(token, await self._bus_topics())
+        out = {}
+        depth_total = 0
+        for stage, topic in sorted(stages.items()):
+            view = await self._bus_peek(topic, limit)
+            out[stage] = {
+                "topic": topic,
+                "depth": view["depth"],
+                "entries": [
+                    self._dlq_entry_summary(o, e) for o, e in view["entries"]
+                ],
+            }
+            depth_total += view["depth"]
+        # DLQ depth rides the normal metrics surface too
+        self.instance.metrics.gauge(f"dlq.depth.{token}").set(depth_total)
+        return web.json_response(
+            {"tenant": token, "depth": depth_total, "stages": out}
+        )
+
+    async def deadletter_requeue(self, request) -> web.Response:
+        """Operator-driven redelivery: drain DLQ entries (optionally one
+        stage, body ``{"stage": ...}``) and re-publish each entry's
+        payload to its source topic — events re-enter the NORMAL pipeline
+        path; decode failures resubmit their raw payload to the tenant's
+        event source."""
+        self.instance.users.require_authority(
+            request["claims"], AUTH_TENANT_ADMIN
+        )
+        token = request.match_info["token"]
+        rt = self.instance.tenants.get(token)
+        if rt is None:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        stage_filter = ""
+        if request.can_read_body:
+            try:
+                stage_filter = (await request.json()).get("stage", "")
+            except (ValueError, json.JSONDecodeError):
+                pass
+        bus = self.instance.bus
+        stages = self._dlq_stage_topics(token, await self._bus_topics())
+        requeued: dict = {}
+        for stage, topic in sorted(stages.items()):
+            if stage_filter and stage != stage_filter:
+                continue
+            bus.subscribe(topic, "dlq-requeue")
+            n = 0
+            while True:
+                entries = await bus.consume(
+                    topic, "dlq-requeue", 256, timeout_s=0
+                )
+                if not entries:
+                    break
+                for entry in entries:
+                    n += await self._requeue_entry(rt, entry)
+            if n:
+                requeued[stage] = n
+        total = sum(requeued.values())
+        self.instance.metrics.counter("dlq.requeued").inc(total)
+        return web.json_response({"tenant": token, "requeued": requeued,
+                                  "total": total})
+
+    async def _requeue_entry(self, rt: TenantRuntime, entry) -> int:
+        if not isinstance(entry, dict):
+            return 0
+        if "payload_b64" in entry:
+            # decode-failure entry: the raw wire payload re-enters through
+            # the tenant's event source (same decoder, same dedup)
+            await rt.source.receiver.submit(
+                base64.b64decode(entry["payload_b64"]), topic="dlq-requeue"
+            )
+            return 1
+        payload = entry.get("payload")
+        stage = entry.get("stage", "")
+        if payload is None:
+            return 0
+        if stage.startswith("outbound."):
+            # targeted redelivery: replay into the ONE connector that
+            # failed — republishing to persisted-events would fan the
+            # event into every healthy connector and the rules engine a
+            # second time
+            cid = stage[len("outbound."):]
+            for c in rt.outbound.connectors:
+                if c.connector_id == cid:
+                    from sitewhere_tpu.core.batch import MeasurementBatch
+
+                    if isinstance(payload, MeasurementBatch):
+                        await c.process_batch(payload)
+                    else:
+                        await c.process(payload)
+                    return 1
+            return 0  # connector gone: leave accounted in the DLQ counters
+        topic = entry.get("source_topic", "")
+        if not topic:
+            return 0
+        # the redelivery publish itself must be at-least-once: the DLQ
+        # cursor has already advanced past this entry
+        await publish_at_least_once(
+            self.instance.bus, topic, payload,
+            metrics=self.instance.metrics,
+        )
+        return 1
 
     # -- schedules / batch ----------------------------------------------
     async def list_schedules(self, request) -> web.Response:

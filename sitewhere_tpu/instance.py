@@ -323,9 +323,11 @@ class SiteWhereInstance(LifecycleComponent):
             store = self.checkpoints.load_event_store(tenant)
         dm = dm or DeviceManagement(tenant)
         store = store or EventStore(tenant)
+        ft = cfg.fault_tolerance
         receiver = QueueReceiver(f"recv[{tenant}]")
         source = EventSource(
-            f"mqtt[{tenant}]", tenant, self.bus, receiver, cfg.decoder, self.metrics
+            f"mqtt[{tenant}]", tenant, self.bus, receiver, cfg.decoder,
+            self.metrics, policy=ft,
         )
 
         async def on_broker_msg(topic: str, payload: bytes) -> None:
@@ -337,7 +339,7 @@ class SiteWhereInstance(LifecycleComponent):
 
         rules = RuleEngine(tenant, self.bus, [
             anomaly_score_rule(f"{tenant}-anomaly", min_score=3.0, cooldown_ms=5000),
-        ], self.metrics)
+        ], self.metrics, policy=ft)
         connectors = [
             LogConnector(f"log[{tenant}]"),
             MqttTopicConnector(
@@ -352,7 +354,7 @@ class SiteWhereInstance(LifecycleComponent):
             search = SearchIndexConnector(f"search[{tenant}]")
             connectors.append(search)
         outbound = OutboundDispatcher(
-            tenant, self.bus, connectors, self.metrics,
+            tenant, self.bus, connectors, self.metrics, policy=ft,
         )
         mqtt_source = None
         if cfg.mqtt_ingest:
@@ -396,7 +398,7 @@ class SiteWhereInstance(LifecycleComponent):
                         rec.auth_token if rec is not None else "",
                     )),
                 ),
-                cfg.decoder, self.metrics,
+                cfg.decoder, self.metrics, policy=ft,
             )
         media = StreamingMedia(tenant)
         media_pipe = None
@@ -417,8 +419,12 @@ class SiteWhereInstance(LifecycleComponent):
             media_pipeline=media_pipe,
             mqtt_source=mqtt_source,
             source=source,
-            inbound=InboundProcessor(tenant, self.bus, dm, self.metrics),
-            persistence=EventPersistence(tenant, self.bus, store, self.metrics),
+            inbound=InboundProcessor(
+                tenant, self.bus, dm, self.metrics, policy=ft
+            ),
+            persistence=EventPersistence(
+                tenant, self.bus, store, self.metrics, policy=ft
+            ),
             rules=rules,
             outbound=outbound,
             state=DeviceStateService(tenant, self.bus, self.metrics),
@@ -511,6 +517,11 @@ class SiteWhereInstance(LifecycleComponent):
                 await self.apply_tenant_update(u)
             except Exception as exc:  # noqa: BLE001
                 self._record_error("tenant-update", exc)
+                # the cursor has already advanced: dead-letter the update
+                # so it can be inspected/requeued instead of vanishing
+                from sitewhere_tpu.runtime.tenant import dead_letter_update
+
+                dead_letter_update(self.bus, self.name, u, exc)
         return len(updates)
 
     # -- lifecycle -------------------------------------------------------

@@ -18,6 +18,8 @@ from dataclasses import dataclass
 from typing import Tuple
 
 import jax
+
+from sitewhere_tpu.compat import shard_map
 import jax.numpy as jnp
 
 from sitewhere_tpu.models.common import (
@@ -137,7 +139,7 @@ def backbone_sharded(
             f"context {t} must divide across {n} '{axis_name}' shards"
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_backbone_local, cfg=cfg, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(), P(None, axis_name)),

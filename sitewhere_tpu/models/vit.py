@@ -16,6 +16,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax
+
+from sitewhere_tpu.compat import shard_map
 import jax.numpy as jnp
 
 from sitewhere_tpu.models.common import (
@@ -168,7 +170,7 @@ def apply_tp(
         x = layernorm(rest_p["ln_f"], x)
         return dense(rest_p["head"], x[:, 0], dtype).astype(jnp.float32)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),

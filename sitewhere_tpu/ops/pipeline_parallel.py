@@ -19,6 +19,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+from sitewhere_tpu.compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -87,7 +89,7 @@ def pipeline_apply(
         params = jax.tree_util.tree_map(lambda a: a[0], params_local)
         return pipeline_apply_local(params, xm_in, stage_fn, axis_name)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P()),

@@ -21,6 +21,8 @@ import math
 from functools import partial
 
 import jax
+
+from sitewhere_tpu.compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -96,7 +98,7 @@ def ring_attention(q, k, v, mesh, axis_name: str, causal: bool = True):
     ``axis_name`` of ``mesh`` and run the ring. q/k/v: [B, T, H, D]
     global arrays (T divisible by the axis size)."""
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention_local, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

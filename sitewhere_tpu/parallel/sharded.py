@@ -26,6 +26,8 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional
 
 import jax
+
+from sitewhere_tpu.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -197,7 +199,7 @@ class ShardedScorer:
 
             return jax.vmap(one)(params, state, active, ids, vals, validity)
 
-        smapped = jax.shard_map(
+        smapped = shard_map(
             local_step,
             mesh=mesh,
             in_specs=(
@@ -456,7 +458,7 @@ class ShardedScorer:
                 params, opt_state, values, pos, count, act_f, lr
             )
 
-        smapped = jax.shard_map(
+        smapped = shard_map(
             local_step,
             mesh=mesh,
             in_specs=(

@@ -26,7 +26,8 @@ from sitewhere_tpu.core.events import (
     event_from_dict,
     now_ms,
 )
-from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.bus import EventBus, RetryingConsumer
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.services.device_management import DeviceManagement
@@ -42,6 +43,7 @@ class InboundProcessor(LifecycleComponent):
         device_management: DeviceManagement,
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 1024,
+        policy: Optional[FaultTolerancePolicy] = None,
     ) -> None:
         super().__init__(f"inbound-processing[{tenant}]")
         self.tenant = tenant
@@ -49,6 +51,9 @@ class InboundProcessor(LifecycleComponent):
         self.dm = device_management
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        self.retry = RetryingConsumer(
+            bus, tenant, "inbound", self.group, policy=policy, metrics=self.metrics
+        )
         self._task: Optional[asyncio.Task] = None
 
     @property
@@ -64,14 +69,19 @@ class InboundProcessor(LifecycleComponent):
         self._task = None
 
     async def _run(self) -> None:
-        src = self.bus.naming.decoded_events(self.tenant)
-        while True:
-            requests = await self.bus.consume(src, self.group, self.poll_batch)
-            for req in requests:
-                if isinstance(req, MeasurementBatch):
-                    await self.process_batch(req)
-                else:
-                    await self.process_request(req)
+        # at-least-once: each item runs under the stage retry budget;
+        # exhausted/poison items dead-letter instead of vanishing
+        await self.retry.run(
+            self.bus.naming.decoded_events(self.tenant),
+            self._handle,
+            self.poll_batch,
+        )
+
+    async def _handle(self, req) -> None:
+        if isinstance(req, MeasurementBatch):
+            await self.process_batch(req)
+        else:
+            await self.process_request(req)
 
     async def process_batch(self, batch: MeasurementBatch) -> Optional[MeasurementBatch]:
         """Columnar fast path: validate/enrich a whole batch with ONE

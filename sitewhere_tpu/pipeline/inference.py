@@ -46,7 +46,11 @@ from sitewhere_tpu.models import get_model, make_config
 from sitewhere_tpu.parallel.mesh import MeshManager
 from sitewhere_tpu.parallel.sharded import ShardedScorer
 from sitewhere_tpu.parallel.tenant_router import TenantRouter
-from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.bus import (
+    CircuitBreaker,
+    EventBus,
+    publish_at_least_once,
+)
 from sitewhere_tpu.runtime.config import TenantEngineConfig
 from sitewhere_tpu.runtime.lifecycle import (
     LifecycleState,
@@ -197,9 +201,13 @@ class TpuInferenceEngine(TenantEngine):
             trainable=self.config.training.enabled,
             lr=self.config.training.lr,
         )
-        # a tenant lifecycle event is the unpark signal for its family
+        # a tenant lifecycle event is the unpark signal for its family —
+        # and clears the family breaker's failure history with it
         svc._parked.discard(self.config.model)
         svc._failover_rounds.pop(self.config.model, None)
+        breaker = svc.breakers.get(self.config.model)
+        if breaker is not None:
+            breaker.reset()
 
     async def on_stop(self) -> None:
         svc = self.service
@@ -264,6 +272,9 @@ class TpuInferenceService(MultitenantService):
         self.poll_batch = poll_batch  # bus items (batches) per poll
         self.router = TenantRouter(self.mm.n_tenant_shards, slots_per_shard)
         self.scorers: Dict[str, ShardedScorer] = {}
+        # per-family circuit breaker over scorer dispatch+materialization
+        # (the first tenant's FaultTolerancePolicy pins it, like wire_dtype)
+        self.breakers: Dict[str, CircuitBreaker] = {}
         self._lanes: Dict[str, Dict[Tuple[int, int], _Lane]] = {}
         self._first_pending_ts: Dict[str, float] = {}
         self._loop_super: Optional[SupervisedTask] = None
@@ -329,6 +340,28 @@ class TpuInferenceService(MultitenantService):
             )
             self.scorers[family] = scorer
             self._lanes[family] = {}
+            # the failover→park escalation is the scorer's first-line
+            # healing; by default the breaker must not open mid-escalation
+            # and starve it of failure outcomes (parked families stop
+            # flushing), so its verdict window is floored at the park
+            # budget. Chaos/testing configs set breaker_defer_to_failover
+            # False to let the breaker act first.
+            from dataclasses import replace as _replace
+
+            ft = cfg.fault_tolerance
+            park_budget = (
+                self.failover_threshold * (self.max_failover_rounds + 1) + 1
+            )
+            if (
+                ft.breaker_defer_to_failover
+                and ft.breaker_min_samples < park_budget
+            ):
+                ft = _replace(ft, breaker_min_samples=park_budget)
+            self.breakers[family] = CircuitBreaker(
+                f"tpu_inference.{family}",
+                policy=ft,
+                metrics=self.metrics,
+            )
         return scorer
 
     # -- lifecycle -------------------------------------------------------
@@ -456,8 +489,12 @@ class TpuInferenceService(MultitenantService):
         else:
             # normal path: preserve backpressure toward persistence — a
             # lagging store slows scoring instead of silently evicting
-            # whole batches past retention
-            await self.bus.publish(topic, batch)
+            # whole batches past retention. The batch is already out of
+            # the registry, so a transient publish fault must be retried
+            # here (nowait fallback) or the whole batch would vanish.
+            await publish_at_least_once(
+                self.bus, topic, batch, metrics=self.metrics
+            )
         # latency accounting: sample rows (full per-row recording would be
         # a Python loop over 10^5 rows/s)
         lat = self.metrics.histogram("tpu_inference.latency", unit="s")
@@ -494,6 +531,22 @@ class TpuInferenceService(MultitenantService):
         if not any(l.count for l in lanes.values()):
             self._first_pending_ts.pop(family, None)
             return 0
+        breaker = self.breakers.get(family)
+        if breaker is not None and not breaker.allow():
+            # breaker OPEN: stop hammering the scorer — resolve pending
+            # rows unscored (degraded, never lost) until the half-open
+            # schedule lets a trial flush probe recovery. Trial failures
+            # keep feeding the failover→park escalation below.
+            drained = 0
+            for key in list(lanes):
+                lane = lanes.pop(key)
+                if lane.count:
+                    _i, _v, seqs, rows = lane.pop(lane.count)
+                    await self._resolve_rows(seqs, rows, None)
+                    drained += len(seqs)
+            self._first_pending_ts.pop(family, None)
+            self.metrics.counter("tpu_inference.breaker_short_circuits").inc()
+            return drained
         any_cfg = next(iter(engine_cfgs.values()))
         mb = any_cfg.microbatch
         # acquire the in-flight slot BEFORE popping rows off the lanes:
@@ -539,6 +592,8 @@ class TpuInferenceService(MultitenantService):
             self._first_pending_ts.pop(family, None)
         if moved == 0:
             self._inflight.release()
+            if breaker is not None:
+                breaker.release_trial()  # allowed, but no call was made
             return 0
 
         slots_cat = np.concatenate(tk_slots)
@@ -573,6 +628,8 @@ class TpuInferenceService(MultitenantService):
             # trigger shard failover
             self._record_error("step", exc)
             self._inflight.release()
+            if breaker is not None:
+                breaker.record_failure()
             await self._resolve_rows(taken[2], taken[3], None)
             await self._note_scorer_error(family)
             return moved
@@ -756,6 +813,9 @@ class TpuInferenceService(MultitenantService):
             await self._resolve_rows(seqs, rows, picks)
             self._consec_errors.pop(family, None)  # healthy again
             self._failover_rounds.pop(family, None)
+            breaker = self.breakers.get(family)
+            if breaker is not None:
+                breaker.record_success()
         except asyncio.CancelledError:
             # cancelled mid-flight (forced teardown): the rows were already
             # popped from lanes, so resolve them unscored or they're lost
@@ -768,6 +828,9 @@ class TpuInferenceService(MultitenantService):
             _, _, seqs, rows = taken
             await self._resolve_rows(seqs, rows, None)
             if family:
+                breaker = self.breakers.get(family)
+                if breaker is not None:
+                    breaker.record_failure()
                 await self._note_scorer_error(family)
         finally:
             self._inflight.release()
@@ -830,7 +893,9 @@ class TpuInferenceService(MultitenantService):
                     passthrough = await self._enqueue_events(engine, objects)
                     topic = self.bus.naming.scored_events(tenant)
                     for ev in passthrough:
-                        await self.bus.publish(topic, ev)
+                        await publish_at_least_once(
+                            self.bus, topic, ev, metrics=self.metrics
+                        )
                     moved += len(objects)
             for family, cfgs in fam_cfgs.items():
                 if family not in self.scorers:
@@ -859,7 +924,9 @@ class TpuInferenceService(MultitenantService):
                 if isinstance(item, MeasurementBatch):
                     item.mark("passthrough_stop")
                 if self.state is LifecycleState.STARTED:
-                    await self.bus.publish(topic, item)
+                    await publish_at_least_once(
+                        self.bus, topic, item, metrics=self.metrics
+                    )
                 else:
                     self.bus.publish_nowait(topic, item)
                 pending.pop(0)

@@ -25,11 +25,20 @@ import numpy as np
 
 from sitewhere_tpu.core.batch import MeasurementBatch
 from sitewhere_tpu.core.events import DeviceEvent, EventType
-from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.bus import (
+    CircuitBreaker,
+    EventBus,
+    RetryingConsumer,
+)
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 
 EventFilter = Callable[[DeviceEvent], bool]
+
+
+class CircuitOpenError(RuntimeError):
+    """Delivery short-circuited because the connector's breaker is open."""
 
 
 def type_filter(*types: EventType) -> EventFilter:
@@ -62,6 +71,23 @@ class OutboundConnector(LifecycleComponent):
         self._sem = asyncio.Semaphore(concurrency)
         self.delivered = 0
         self.failed = 0
+        self.retried = 0
+        self.parked = 0  # deliveries short-circuited by an open breaker
+        # fault-tolerance bindings (installed by OutboundDispatcher when a
+        # FaultTolerancePolicy is configured; None = legacy single-attempt
+        # delivery with isolated errors, exactly the pre-policy behavior)
+        self.breaker: Optional[CircuitBreaker] = None
+        self._ft: Optional[RetryingConsumer] = None
+        self._ft_source_topic = ""
+
+    def bind_fault_tolerance(
+        self, ft: RetryingConsumer, breaker: CircuitBreaker,
+        source_topic: str,
+    ) -> None:
+        """Install retry budget + breaker + DLQ routing (dispatcher call)."""
+        self._ft = ft
+        self.breaker = breaker
+        self._ft_source_topic = source_topic
 
     def accepts(self, e: DeviceEvent) -> bool:
         return all(f(e) for f in self.filters)
@@ -80,29 +106,63 @@ class OutboundConnector(LifecycleComponent):
                 n += 1
         return n
 
+    _FAILED = object()  # _attempt sentinel (deliver() legitimately returns None)
+
+    async def _attempt(self, fn, item, kind: str):
+        """One delivery under breaker gating + the retry budget; exhausted
+        (or breaker-parked) items dead-letter instead of vanishing.
+        Returns fn's result, or ``_FAILED`` when delivery failed."""
+        max_attempts = max(
+            1, self._ft.policy.max_attempts if self._ft is not None else 1
+        )
+        last: Optional[BaseException] = None
+        calls = 0
+        for attempt in range(1, max_attempts + 1):
+            if self.breaker is not None and not self.breaker.allow():
+                # park instead of hammering a dead target: route straight
+                # to the connector's DLQ with the breaker named
+                self.parked += 1
+                last = CircuitOpenError(f"breaker '{self.breaker.name}' open")
+                break
+            try:
+                calls += 1
+                result = await fn(item)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - connector errors are isolated
+                last = exc
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                if attempt < max_attempts:
+                    self.retried += 1
+                    await asyncio.sleep(self._ft._backoff(attempt))
+                continue
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return result
+        self.failed += 1
+        self._record_error(kind, last)
+        if self._ft is not None:
+            await self._ft.dead_letter(item, self._ft_source_topic, calls, last)
+        return self._FAILED
+
     async def process(self, e: DeviceEvent) -> bool:
         if not self.accepts(e):
             return False
         async with self._sem:
-            try:
-                await self.deliver(e)
-                self.delivered += 1
-                return True
-            except Exception as exc:  # noqa: BLE001 - connector errors are isolated
-                self.failed += 1
-                self._record_error("deliver", exc)
+            result = await self._attempt(self.deliver, e, "deliver")
+            if result is self._FAILED:
                 return False
+            self.delivered += 1
+            return True
 
     async def process_batch(self, batch: MeasurementBatch) -> int:
         async with self._sem:
-            try:
-                n = await self.deliver_batch(batch)
-                self.delivered += n
-                return n
-            except Exception as exc:  # noqa: BLE001 - connector errors are isolated
-                self.failed += 1
-                self._record_error("deliver_batch", exc)
+            n = await self._attempt(self.deliver_batch, batch, "deliver_batch")
+            if n is self._FAILED:
                 return 0
+            self.delivered += n
+            return n
 
 
 class LogConnector(OutboundConnector):
@@ -438,12 +498,14 @@ class OutboundDispatcher(LifecycleComponent):
         connectors: Optional[Sequence[OutboundConnector]] = None,
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 4096,
+        policy: Optional[FaultTolerancePolicy] = None,
     ) -> None:
         super().__init__(f"outbound-connectors[{tenant}]")
         self.tenant = tenant
         self.bus = bus
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        self.policy = policy
         self._task: Optional[asyncio.Task] = None
         for c in connectors or []:
             self.add_child(c)
@@ -454,12 +516,33 @@ class OutboundDispatcher(LifecycleComponent):
 
     def add_connector(self, c: OutboundConnector) -> None:
         self.add_child(c)
+        self._bind_connector(c)
+
+    def _bind_connector(self, c: OutboundConnector) -> None:
+        """Give one connector its retry budget, breaker, and per-connector
+        DLQ (``dead-letter.outbound.<connector_id>``). Requeued entries
+        re-enter at the persisted-events topic — the normal path."""
+        if self.policy is None or c._ft is not None:
+            return
+        c.bind_fault_tolerance(
+            RetryingConsumer(
+                self.bus, self.tenant, f"outbound.{c.connector_id}",
+                self.group, policy=self.policy, metrics=self.metrics,
+            ),
+            CircuitBreaker(
+                f"outbound[{self.tenant}].{c.connector_id}",
+                policy=self.policy, metrics=self.metrics,
+            ),
+            self.bus.naming.persisted_events(self.tenant),
+        )
 
     @property
     def group(self) -> str:
         return f"outbound-connectors[{self.tenant}]"
 
     async def on_start(self) -> None:
+        for c in self.connectors:
+            self._bind_connector(c)
         self.bus.subscribe(
             self.bus.naming.persisted_events(self.tenant), self.group
         )

@@ -12,7 +12,8 @@ import asyncio
 from typing import Optional
 
 from sitewhere_tpu.core.batch import MeasurementBatch
-from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.bus import EventBus, RetryingConsumer
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.services.event_store import EventStore
@@ -28,6 +29,7 @@ class EventPersistence(LifecycleComponent):
         store: EventStore,
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 4096,
+        policy: Optional[FaultTolerancePolicy] = None,
     ) -> None:
         super().__init__(f"event-persistence[{tenant}]")
         self.tenant = tenant
@@ -35,6 +37,13 @@ class EventPersistence(LifecycleComponent):
         self.store = store
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        self.retry = RetryingConsumer(
+            bus, tenant, "persistence", self.group,
+            policy=policy, metrics=self.metrics,
+        )
+        # hoisted out of the per-item handler (hot path)
+        self._out_topic = bus.naming.persisted_events(tenant)
+        self._persisted = self.metrics.counter("event_management.persisted")
         self._task: Optional[asyncio.Task] = None
 
     @property
@@ -50,19 +59,20 @@ class EventPersistence(LifecycleComponent):
         self._task = None
 
     async def _run(self) -> None:
-        src = self.bus.naming.scored_events(self.tenant)
-        out = self.bus.naming.persisted_events(self.tenant)
-        persisted = self.metrics.counter("event_management.persisted")
-        while True:
-            items = await self.bus.consume(src, self.group, self.poll_batch)
-            for item in items:
-                if isinstance(item, MeasurementBatch):
-                    # columnar fast path: ONE append + ONE re-publish per batch
-                    self.store.add_measurement_batch(item)
-                    persisted.inc(item.n)
-                    item.mark("persisted")
-                    await self.bus.publish(out, item)
-                else:
-                    self.store.add_event(item)
-                    persisted.inc()
-                    await self.bus.publish(out, item)
+        await self.retry.run(
+            self.bus.naming.scored_events(self.tenant),
+            self._handle,
+            self.poll_batch,
+        )
+
+    async def _handle(self, item) -> None:
+        if isinstance(item, MeasurementBatch):
+            # columnar fast path: ONE append + ONE re-publish per batch
+            self.store.add_measurement_batch(item)
+            self._persisted.inc(item.n)
+            item.mark("persisted")
+            await self.retry.publish(self._out_topic, item)
+        else:
+            self.store.add_event(item)
+            self._persisted.inc()
+            await self.retry.publish(self._out_topic, item)

@@ -46,7 +46,8 @@ from sitewhere_tpu.core.events import (
     DeviceMeasurement,
     EventType,
 )
-from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.bus import EventBus, RetryingConsumer
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 
@@ -455,6 +456,7 @@ class RuleEngine(LifecycleComponent):
         rules: Optional[List[Rule]] = None,
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 4096,
+        policy: Optional[FaultTolerancePolicy] = None,
     ) -> None:
         super().__init__(f"rule-processing[{tenant}]")
         self.tenant = tenant
@@ -462,6 +464,9 @@ class RuleEngine(LifecycleComponent):
         self.rules: List[Rule] = list(rules or [])
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        self.retry = RetryingConsumer(
+            bus, tenant, "rules", self.group, policy=policy, metrics=self.metrics
+        )
         self._task: Optional[asyncio.Task] = None
 
     @property
@@ -485,14 +490,20 @@ class RuleEngine(LifecycleComponent):
         self._task = None
 
     async def _run(self) -> None:
-        src = self.bus.naming.persisted_events(self.tenant)
-        while True:
-            items = await self.bus.consume(src, self.group, self.poll_batch)
-            for item in items:
-                if isinstance(item, MeasurementBatch):
-                    await self.process_batch(item)
-                else:
-                    await self.process_event(item)
+        # per-rule faults are isolated inside process_batch/process_event;
+        # the retry wrapper covers stage-level faults (derived-event
+        # publishes, batch materialization) and dead-letters poison items
+        await self.retry.run(
+            self.bus.naming.persisted_events(self.tenant),
+            self._handle,
+            self.poll_batch,
+        )
+
+    async def _handle(self, item) -> None:
+        if isinstance(item, MeasurementBatch):
+            await self.process_batch(item)
+        else:
+            await self.process_event(item)
 
     async def process_batch(self, batch: MeasurementBatch) -> List[DeviceEvent]:
         """Columnar evaluation: rules with a ``vector_where`` run one numpy
@@ -574,11 +585,11 @@ class RuleEngine(LifecycleComponent):
         for d in derived_out:
             d.mark("rule")
             if d.EVENT_TYPE is EventType.COMMAND_INVOCATION:
-                await self.bus.publish(
+                await self.retry.publish(
                     self.bus.naming.command_invocations(self.tenant), d
                 )
             else:
-                await self.bus.publish(
+                await self.retry.publish(
                     self.bus.naming.scored_events(self.tenant), d
                 )
 

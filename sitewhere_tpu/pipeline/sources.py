@@ -28,7 +28,8 @@ from sitewhere_tpu.pipeline.decoders import (
     EventDecoder,
     get_decoder,
 )
-from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.bus import EventBus, RetryingConsumer
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 
@@ -39,12 +40,36 @@ class InboundReceiver(LifecycleComponent):
     def __init__(self, name: str) -> None:
         super().__init__(name)
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=65536)
+        self.shed_total = 0
+        # EventSource attaches the instance registry so sheds surface as
+        # ``receiver_shed_total`` on the normal /metrics scrape
+        self.metrics: Optional[MetricsRegistry] = None
 
     async def submit(self, payload: bytes, **context: Any) -> None:
         await self.queue.put((payload, context))
 
     def submit_nowait(self, payload: bytes, **context: Any) -> None:
-        self.queue.put_nowait((payload, context))
+        """Non-blocking submit for network receiver loops. A full queue
+        sheds the OLDEST queued payload (newest data wins under burst —
+        counted, never raised into the receiver loop)."""
+        try:
+            self.queue.put_nowait((payload, context))
+            return
+        except asyncio.QueueFull:
+            pass
+        try:
+            self.queue.get_nowait()  # shed oldest
+        except asyncio.QueueEmpty:  # pragma: no cover - racing consumer
+            pass
+        self.shed_total += 1
+        if self.metrics is not None:
+            self.metrics.counter("receiver_shed_total").inc()
+        try:
+            self.queue.put_nowait((payload, context))
+        except asyncio.QueueFull:  # pragma: no cover - racing producer
+            self.shed_total += 1
+            if self.metrics is not None:
+                self.metrics.counter("receiver_shed_total").inc()
 
 
 class QueueReceiver(InboundReceiver):
@@ -185,6 +210,7 @@ class EventSource(LifecycleComponent):
         decoder: EventDecoder | str = "json",
         metrics: Optional[MetricsRegistry] = None,
         dedup: bool = True,
+        policy: Optional[FaultTolerancePolicy] = None,
     ) -> None:
         super().__init__(f"event-source[{source_id}]")
         self.source_id = source_id
@@ -195,6 +221,13 @@ class EventSource(LifecycleComponent):
         self.metrics = metrics or MetricsRegistry()
         self.dedup = Deduplicator() if dedup else None
         self._pump: Optional[asyncio.Task] = None
+        receiver.metrics = self.metrics
+        # decode is the first at-least-once stage: publishes ride a retry
+        # budget; undecodable payloads dead-letter to failed-decode
+        self.retry = RetryingConsumer(
+            bus, tenant, "decode", f"event-source[{source_id}]",
+            policy=policy, metrics=self.metrics,
+        )
         self.add_child(receiver)
 
     async def on_start(self) -> None:
@@ -243,11 +276,19 @@ class EventSource(LifecycleComponent):
 
             async def report_failed(payload, context, exc) -> None:
                 failed.inc()
-                await self.bus.publish(
+                # failed-decode IS the decode stage's dead-letter topic:
+                # carry the same stage/attempt metadata the uniform DLQ
+                # entries do, so the REST surface lists them together.
+                # Non-blocking like every DLQ write: an idle requeue
+                # cursor must never backpressure the decode pump shut
+                self.bus.publish_nowait(
                     failed_topic,
                     {
+                        "stage": "decode",
+                        "tenant": self.tenant,
+                        "attempts": 1,  # decode is deterministic: poison
                         "source": self.source_id,
-                        "error": str(exc),
+                        "error": f"{type(exc).__name__}: {exc}",
                         "payload_b64": base64.b64encode(payload).decode(),
                         "context": {k: str(v) for k, v in context.items()},
                         "ts": now,
@@ -332,7 +373,7 @@ class EventSource(LifecycleComponent):
                         )
             for mb in out_batches:
                 mb.mark("decoded")
-                await self.bus.publish(decoded_topic, mb)
+                await self.retry.publish(decoded_topic, mb)
                 decoded_ctr.inc(mb.n)
 
     async def _route_requests(
@@ -350,7 +391,7 @@ class EventSource(LifecycleComponent):
                 measurements.append(req)
             else:
                 req["_source"] = self.source_id
-                await self.bus.publish(decoded_topic, req)
+                await self.retry.publish(decoded_topic, req)
                 decoded_ctr.inc()
 
 

@@ -5,8 +5,15 @@ from sitewhere_tpu.runtime.lifecycle import (
     LifecycleException,
     LifecycleState,
 )
-from sitewhere_tpu.runtime.bus import EventBus, Topic, TopicNaming
+from sitewhere_tpu.runtime.bus import (
+    CircuitBreaker,
+    EventBus,
+    RetryingConsumer,
+    Topic,
+    TopicNaming,
+)
 from sitewhere_tpu.runtime.config import (
+    FaultTolerancePolicy,
     InstanceConfig,
     MicroserviceConfig,
     TenantEngineConfig,
@@ -15,8 +22,11 @@ from sitewhere_tpu.runtime.metrics import Counter, Gauge, Histogram, MetricsRegi
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
 __all__ = [
+    "CircuitBreaker",
     "Counter",
     "EventBus",
+    "FaultTolerancePolicy",
+    "RetryingConsumer",
     "Gauge",
     "Histogram",
     "InstanceConfig",

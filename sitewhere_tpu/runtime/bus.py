@@ -25,7 +25,9 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
@@ -71,14 +73,43 @@ class TopicNaming:
     def tenant_model_updates(self) -> str:
         return self.global_topic("tenant-model-updates")
 
+    # dead-letter topics (at-least-once: exhausted/poison items per stage;
+    # the decode stage's failed-decode topic predates this naming and is
+    # surfaced beside them by the DLQ REST endpoints)
+    def dead_letter(self, tenant: str, stage: str) -> str:
+        return self.tenant_topic(tenant, f"dead-letter.{stage}")
+
+    def dead_letter_prefix(self, tenant: str) -> str:
+        return self.tenant_topic(tenant, "dead-letter.")
+
+
+class TransientPublishError(RuntimeError):
+    """An injected (or backend) publish failure that a well-behaved
+    at-least-once producer should retry — see ``FaultPlan.fail_p``."""
+
+
+def is_transient_publish_error(exc: BaseException) -> bool:
+    """True for retryable publish faults — locally raised, or surfaced
+    across the netbus wire (where exceptions flatten to strings)."""
+    return isinstance(exc, TransientPublishError) or (
+        "TransientPublishError" in str(exc)
+    )
+
 
 @dataclass
 class FaultPlan:
-    """Fault injection knobs for tests (drop/delay/duplicate)."""
+    """Fault injection knobs for tests (drop/delay/duplicate/fail).
+
+    ``drop_p`` loses the publish SILENTLY (the unrecoverable network-loss
+    case loss-detection tests want); ``fail_p`` raises
+    ``TransientPublishError`` instead — a failed/timed-out ack the
+    at-least-once retry layer (``RetryingConsumer``) is expected to
+    absorb, so chaos runs with ``fail_p`` must show zero event loss."""
 
     drop_p: float = 0.0
     dup_p: float = 0.0
     delay_s: float = 0.0
+    fail_p: float = 0.0
     rng: random.Random = field(default_factory=lambda: random.Random(0))
 
 
@@ -137,6 +168,11 @@ class Topic:
             f = self.fault
             if f.delay_s:
                 await asyncio.sleep(f.delay_s)
+            if f.fail_p and f.rng.random() < f.fail_p:
+                # retryable: the publish "ack" failed, nothing was appended
+                raise TransientPublishError(
+                    f"injected publish failure on '{self.name}'"
+                )
             if f.drop_p and f.rng.random() < f.drop_p:
                 return self._next_offset  # silently dropped
             if f.dup_p and f.rng.random() < f.dup_p:
@@ -297,6 +333,11 @@ class Topic:
             except asyncio.TimeoutError:
                 return []
 
+    def peek(self, max_items: int = 100) -> List[Tuple[int, Any]]:
+        """Cursor-less read of the NEWEST retained entries (operator
+        inspection — dead-letter listing — must not advance any group)."""
+        live = self._log[self._head :]
+        return list(live[-max_items:]) if max_items else list(live)
 
 
 def partition_key_hash(key: Any) -> int:
@@ -426,6 +467,12 @@ class PartitionedTopic:
                 await asyncio.wait_for(self._any_data.wait(), remaining)
             except asyncio.TimeoutError:
                 return []
+
+    def peek(self, max_items: int = 100) -> List[Tuple[int, Any]]:
+        out: List[Tuple[int, Any]] = []
+        for p in self.parts:
+            out.extend(p.peek(max_items))
+        return out[-max_items:] if max_items else out
 
     # -- lifecycle / chaos / durability ----------------------------------
     def drop(self) -> None:
@@ -560,6 +607,27 @@ class EventBus:
         """Lift a tombstone (tenant re-add): topics recreate lazily again."""
         self._dropped_prefixes.discard(prefix)
 
+    REQUEUE_GROUP = "dlq-requeue"
+
+    def peek(self, topic: str, max_items: int = 100) -> Dict[str, Any]:
+        """Cursor-less view of a topic's newest retained entries plus its
+        depth — the DLQ-inspection read (no group cursor moves). Depth is
+        the un-requeued backlog once the requeue group exists, else the
+        retained entry count."""
+        t = self.topic(topic)
+        entries = t.peek(max_items)
+        if self.REQUEUE_GROUP in t.group_offsets:
+            depth = t.lag(self.REQUEUE_GROUP)
+        elif isinstance(t, PartitionedTopic):
+            depth = sum(p._live_len() for p in t.parts)
+        else:
+            depth = t._live_len()
+        return {
+            "entries": entries,
+            "depth": depth,
+            "latest": t.latest_offset,
+        }
+
     def inject_faults(self, topic: str, plan: FaultPlan) -> None:
         self.topic(topic).fault = plan
 
@@ -591,3 +659,301 @@ class EventBus:
     def restore_state(self, state: Dict[str, dict]) -> None:
         for name, st in state.items():
             self.topic(name).restore_state(st)
+
+
+# ----------------------------------------------------------------------
+# Fault-tolerance layer: circuit breakers + at-least-once stage consumption
+# (retry budgets → per-tenant, per-stage dead-letter topics). See
+# docs/ROBUSTNESS.md for the failure-domain map.
+# ----------------------------------------------------------------------
+
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy  # noqa: E402
+from sitewhere_tpu.runtime.metrics import (  # noqa: E402
+    BREAKER_STATE_VALUES,
+    MetricsRegistry,
+)
+
+
+class CircuitBreaker:
+    """Closed / open / half-open breaker over a rolling outcome window.
+
+    - CLOSED: calls flow; outcomes land in a rolling window. When the
+      failure rate over ≥ ``breaker_min_samples`` samples reaches
+      ``breaker_failure_rate`` the breaker trips OPEN.
+    - OPEN: ``allow()`` is False (stop hammering the dependency) until
+      ``breaker_open_s`` elapses, then HALF-OPEN.
+    - HALF-OPEN: up to ``breaker_half_open_max`` trial calls may proceed;
+      the first recorded success closes the breaker, a failure re-opens
+      it (and restarts the open timer).
+
+    Callers MUST pair every allowed call with exactly one
+    ``record_success``/``record_failure`` (the half-open trial budget is
+    reclaimed there). State transitions publish through the metrics
+    registry as ``breaker.<name>.state`` (see
+    ``metrics.BREAKER_STATE_VALUES``) plus ``.opened``/``.transitions``
+    counters, so breaker health rides the normal /metrics scrape.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        policy: Optional[FaultTolerancePolicy] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.name = name
+        self.policy = policy or FaultTolerancePolicy()
+        self.metrics = metrics
+        self._clock = clock
+        self._state = "closed"
+        # window floored at min_samples: a window smaller than the sample
+        # floor could never accumulate a verdict and would silently
+        # disable the breaker
+        self._outcomes: deque = deque(
+            maxlen=max(
+                1, self.policy.breaker_window, self.policy.breaker_min_samples
+            )
+        )
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+        self._publish_state(initial=True)
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _publish_state(self, initial: bool = False) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.gauge(f"breaker.{self.name}.state").set(
+            BREAKER_STATE_VALUES[self._state]
+        )
+        if not initial:
+            self.metrics.counter(f"breaker.{self.name}.transitions").inc()
+            if self._state == "open":
+                self.metrics.counter(f"breaker.{self.name}.opened").inc()
+
+    def _set_state(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        self._publish_state()
+
+    def allow(self) -> bool:
+        """May a call proceed now? Handles open→half-open on schedule."""
+        if self._state == "open":
+            if self._clock() - self._opened_at < self.policy.breaker_open_s:
+                return False
+            self._half_open_inflight = 0
+            self._set_state("half_open")
+        if self._state == "half_open":
+            if self._half_open_inflight >= max(
+                1, self.policy.breaker_half_open_max
+            ):
+                return False
+            self._half_open_inflight += 1
+        return True
+
+    def record_success(self) -> None:
+        if self._state == "half_open":
+            self._half_open_inflight = max(0, self._half_open_inflight - 1)
+            self._outcomes.clear()
+            self._set_state("closed")
+            return
+        self._outcomes.append(True)
+
+    def record_failure(self) -> None:
+        if self._state == "half_open":
+            self._half_open_inflight = max(0, self._half_open_inflight - 1)
+            self._trip()
+            return
+        self._outcomes.append(False)
+        p = self.policy
+        if (
+            self._state == "closed"
+            and len(self._outcomes) >= max(1, p.breaker_min_samples)
+        ):
+            failures = sum(1 for ok in self._outcomes if not ok)
+            if failures / len(self._outcomes) >= p.breaker_failure_rate:
+                self._trip()
+
+    def _trip(self) -> None:
+        self._opened_at = self._clock()
+        self._outcomes.clear()
+        self._set_state("open")
+
+    def release_trial(self) -> None:
+        """Return an unused half-open trial slot (the caller passed
+        ``allow()`` but ended up making no call, so no outcome will be
+        recorded for it)."""
+        if self._state == "half_open":
+            self._half_open_inflight = max(0, self._half_open_inflight - 1)
+
+    def reset(self) -> None:
+        """Force-close (tenant lifecycle events clear breaker history)."""
+        self._outcomes.clear()
+        self._half_open_inflight = 0
+        self._set_state("closed")
+
+
+async def publish_at_least_once(
+    bus: "EventBus",
+    topic: str,
+    payload: Any,
+    key: Any = None,
+    policy: Optional[FaultTolerancePolicy] = None,
+    metrics: Optional[MetricsRegistry] = None,
+    rng: Optional[random.Random] = None,
+) -> int:
+    """Awaited publish that retries transient failures (exponential
+    backoff + jitter) and falls back to a non-blocking append on
+    exhaustion: a producer whose input cursor already advanced must never
+    drop the item because its onward publish hiccuped."""
+    p = policy or FaultTolerancePolicy()
+    r = rng or random
+    max_attempts = max(1, p.max_attempts)
+    for attempt in range(1, max_attempts + 1):
+        try:
+            return await bus.publish(topic, payload, key)
+        except asyncio.CancelledError:
+            bus.publish_nowait(topic, payload, key)
+            raise
+        except Exception as exc:  # noqa: BLE001
+            if not is_transient_publish_error(exc):
+                raise
+            if metrics is not None:
+                metrics.counter("retry.publish_attempts").inc()
+            if attempt >= max_attempts:
+                if metrics is not None:
+                    metrics.counter("retry.publish_fallbacks").inc()
+                return bus.publish_nowait(topic, payload, key)
+            d = min(p.backoff_base_s * (2 ** (attempt - 1)), p.backoff_max_s)
+            if p.backoff_jitter:
+                d *= 1.0 + p.backoff_jitter * (2.0 * r.random() - 1.0)
+            await asyncio.sleep(max(d, 0.0))
+    raise AssertionError("unreachable")
+
+
+class RetryingConsumer:
+    """At-least-once consumption for ONE pipeline stage.
+
+    Wraps the stage's per-item handler with a bounded retry budget
+    (exponential backoff + jitter); items that exhaust the budget — or
+    poison items that fail deterministically — route to the tenant's
+    per-stage dead-letter topic (``TopicNaming.dead_letter``) carrying
+    the original payload, stage name, attempt count, last error and
+    source topic, so an operator can inspect and requeue them through
+    the REST surface (``/api/tenants/{t}/deadletter``).
+
+    Also provides ``publish`` — an awaited publish that retries
+    transient failures (``FaultPlan.fail_p`` / backend acks) and falls
+    back to a non-blocking append on exhaustion: once a stage's cursor
+    has advanced past an item, that item must never vanish because its
+    onward publish hiccuped.
+    """
+
+    def __init__(
+        self,
+        bus: "EventBus",
+        tenant: str,
+        stage: str,
+        group: str,
+        policy: Optional[FaultTolerancePolicy] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.bus = bus
+        self.tenant = tenant
+        self.stage = stage
+        self.group = group
+        self.policy = policy or FaultTolerancePolicy()
+        self.metrics = metrics or MetricsRegistry()
+        self.rng = rng or random.Random()
+        self.dlq_topic = bus.naming.dead_letter(tenant, stage)
+
+    # -- internals --------------------------------------------------------
+    @property
+    def _max_attempts(self) -> int:
+        return max(1, self.policy.max_attempts)
+
+    def _backoff(self, attempt: int) -> float:
+        p = self.policy
+        d = min(p.backoff_base_s * (2 ** (attempt - 1)), p.backoff_max_s)
+        if p.backoff_jitter:
+            d *= 1.0 + p.backoff_jitter * (2.0 * self.rng.random() - 1.0)
+        return max(d, 0.0)
+
+    # -- producer side ----------------------------------------------------
+    async def publish(self, topic: str, payload: Any, key: Any = None) -> int:
+        return await publish_at_least_once(
+            self.bus, topic, payload, key,
+            policy=self.policy, metrics=self.metrics, rng=self.rng,
+        )
+
+    # -- consumer side ----------------------------------------------------
+    async def process(
+        self, item: Any, handler: Callable, source_topic: str = ""
+    ) -> bool:
+        """Run ``handler(item)`` under the retry budget; dead-letter on
+        exhaustion. Returns True when handled, False when dead-lettered."""
+        last: Optional[BaseException] = None
+        for attempt in range(1, self._max_attempts + 1):
+            try:
+                await handler(item)
+                if attempt > 1:
+                    self.metrics.counter("retry.recovered").inc()
+                return True
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001
+                last = exc
+                self.metrics.counter("retry.attempts").inc()
+                self.metrics.counter(f"retry.attempts.{self.stage}").inc()
+                if attempt < self._max_attempts:
+                    await asyncio.sleep(self._backoff(attempt))
+        await self.dead_letter(item, source_topic, self._max_attempts, last)
+        return False
+
+    async def dead_letter(
+        self,
+        item: Any,
+        source_topic: str,
+        attempts: int,
+        error: Optional[BaseException],
+    ) -> None:
+        entry = {
+            "stage": self.stage,
+            "tenant": self.tenant,
+            "attempts": int(attempts),
+            "error": f"{type(error).__name__}: {error}" if error else "",
+            "source_topic": source_topic,
+            "ts": int(time.time() * 1000),
+            "payload": item,
+        }
+        # non-blocking on purpose: the DLQ is the lossless fallback and
+        # must never be backpressured (or fault-injected) shut; it is
+        # bounded by topic retention like any other topic. It must also
+        # never RAISE — a dead-letter failure (oversized frame, detached
+        # remote writer) killing the stage loop would trade one lost item
+        # for a dead stage
+        try:
+            self.bus.publish_nowait(self.dlq_topic, entry)
+        except Exception as exc:  # noqa: BLE001
+            self.metrics.counter("dlq.dropped").inc()
+            import logging
+
+            logging.getLogger("sitewhere.bus").error(
+                "dead-letter publish failed for stage %s: %r", self.stage, exc
+            )
+            return
+        self.metrics.counter("dlq.enqueued").inc()
+        self.metrics.counter(f"dlq.enqueued.{self.stage}").inc()
+
+    async def run(
+        self, topic: str, handler: Callable, max_items: int = 1024
+    ) -> None:
+        """The standard stage loop: consume → per-item retry → DLQ."""
+        while True:
+            items = await self.bus.consume(topic, self.group, max_items)
+            for item in items:
+                await self.process(item, handler, topic)

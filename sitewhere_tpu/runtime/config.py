@@ -38,6 +38,39 @@ class MicroBatchConfig:
 
 
 @dataclass(frozen=True)
+class FaultTolerancePolicy:
+    """At-least-once knobs for every pipeline stage (retry budgets, DLQ,
+    circuit breakers) — see docs/ROBUSTNESS.md.
+
+    Retries: a stage handler (or publish) that raises gets re-run up to
+    ``max_attempts`` with exponential backoff + jitter; exhausted or
+    poison items route to the tenant's per-stage dead-letter topic with
+    stage / attempt / error metadata attached.
+
+    Breakers: the scorer (per model family) and each outbound connector
+    sit behind a closed/open/half-open breaker driven by the failure
+    rate over a rolling window of outcomes. An open breaker stops
+    hammering the dependency (events pass through unscored / park on
+    the DLQ) and half-opens after ``breaker_open_s`` to probe recovery.
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.02    # first retry delay; doubles per attempt
+    backoff_max_s: float = 1.0
+    backoff_jitter: float = 0.2     # ± fraction of the computed delay
+    breaker_window: int = 32        # rolling outcome-sample window
+    breaker_failure_rate: float = 0.5
+    breaker_min_samples: int = 10   # no verdict before this many samples
+    breaker_open_s: float = 2.0     # open → half-open schedule
+    breaker_half_open_max: int = 1  # concurrent trial calls while half-open
+    # scorer breakers only: defer to the shard-failover → park escalation
+    # (the breaker's verdict window is floored at the park budget so the
+    # first-line healing is never starved of failure outcomes). Set False
+    # in chaos/testing configs to let the scorer breaker act first.
+    breaker_defer_to_failover: bool = True
+
+
+@dataclass(frozen=True)
 class TrainingConfig:
     """Live on-device training cadence (rebuild-only: per-tenant models
     diverge by training on their RESIDENT window state — zero bytes move
@@ -56,6 +89,9 @@ class TenantEngineConfig:
     model_config: Dict[str, Any] = field(default_factory=dict)
     microbatch: MicroBatchConfig = field(default_factory=MicroBatchConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
+    fault_tolerance: FaultTolerancePolicy = field(
+        default_factory=FaultTolerancePolicy
+    )
     max_streams: int = 4096         # window-state capacity (series slots)
     decoder: str = "json"
     # host↔device wire dtype for scoring values/scores ("f32" | "bf16" |
@@ -204,12 +240,14 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
     d = dict(d)
     mb = d.pop("microbatch", None) or {}
     tr = d.pop("training", None) or {}
+    ft = d.pop("fault_tolerance", None) or {}
     if "buckets" in mb:
         mb["buckets"] = tuple(mb["buckets"])
     # drop unknown keys at EVERY level: a manifest written by a newer build
     # (extra knobs) must degrade gracefully, not abort the whole restore
     mb_known = MicroBatchConfig.__dataclass_fields__
     tr_known = TrainingConfig.__dataclass_fields__
+    ft_known = FaultTolerancePolicy.__dataclass_fields__
     known = TenantEngineConfig.__dataclass_fields__
     return TenantEngineConfig(
         microbatch=MicroBatchConfig(
@@ -218,10 +256,14 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
         training=TrainingConfig(
             **{k: v for k, v in tr.items() if k in tr_known}
         ),
+        fault_tolerance=FaultTolerancePolicy(
+            **{k: v for k, v in ft.items() if k in ft_known}
+        ),
         **{
             k: v
             for k, v in d.items()
-            if k in known and k not in ("microbatch", "training")
+            if k in known
+            and k not in ("microbatch", "training", "fault_tolerance")
         },
     )
 

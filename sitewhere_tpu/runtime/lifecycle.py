@@ -75,16 +75,23 @@ async def cancel_and_wait(task: Optional["asyncio.Task"]) -> None:
     if task is None or task.done():
         return
     task.cancel()
-    try:
-        await task
-    except asyncio.CancelledError:
-        cur = asyncio.current_task()
-        # Task.cancelling() is 3.11+; older interpreters fall back to the
-        # pre-fix behavior (swallow) rather than crashing shutdown
-        if cur is not None and getattr(cur, "cancelling", lambda: 0)():
-            raise  # the cancel was meant for US — propagate
-    except Exception:  # noqa: BLE001 - the task died before our cancel
-        logger.exception("task %r crashed before stop", task.get_name())
+    # Await through ``asyncio.wait`` rather than ``await task``: when the
+    # CURRENT task is cancelled while directly awaiting the child, the
+    # interpreter routes the cancel into the child's (already-cancelled)
+    # future instead of our frame — ``Task.cancelling()`` never sees it on
+    # < 3.11, the swallow eats it, and the caller loops forever on its
+    # next await (observed: instance.terminate() racing the tenant-updates
+    # loop, deterministic on 3.10). With ``wait`` our wakeup future is
+    # wait()'s own, so a concurrent outer cancel raises HERE and
+    # propagates, while the child's terminal CancelledError is absorbed as
+    # its result — correct on every interpreter version.
+    await asyncio.wait({task})
+    if task.done() and not task.cancelled():
+        exc = task.exception()
+        if exc is not None:
+            logger.error(
+                "task %r crashed before stop: %r", task.get_name(), exc
+            )
 
 
 class LifecycleComponent:

@@ -15,6 +15,16 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 
+# circuit-breaker state → gauge value (runtime.bus.CircuitBreaker publishes
+# its transitions through a ``breaker.<name>.state`` gauge using this map,
+# so breaker health rides the normal /metrics scrape + snapshot surface)
+BREAKER_STATE_VALUES: Dict[str, float] = {
+    "closed": 0.0,
+    "open": 1.0,
+    "half_open": 2.0,
+}
+
+
 class Counter:
     __slots__ = ("name", "_v", "_lock")
 

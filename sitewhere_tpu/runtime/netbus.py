@@ -41,9 +41,32 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
 
 
-def _dump(obj: Any) -> bytes:
+class FrameTooLargeError(ValueError):
+    """A frame that would exceed MAX_FRAME, rejected on the WRITE path.
+
+    The read path always enforced the cap; without the write-path check an
+    oversized payload reached the peer, which dropped the whole connection
+    — poisoning every topic multiplexed on it. Rejecting at the producer
+    turns that into a per-call error naming the offending topic."""
+
+
+def _dump(obj: Any, topic: Optional[str] = None) -> bytes:
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(data) > MAX_FRAME:
+        where = f" for topic '{topic}'" if topic else ""
+        raise FrameTooLargeError(
+            f"refusing to send a {len(data)}-byte frame{where}: exceeds "
+            f"MAX_FRAME ({MAX_FRAME} bytes); the peer would drop the "
+            f"connection"
+        )
     return _LEN.pack(len(data)) + data
+
+
+def _publish_topic(op: str, args: tuple) -> Optional[str]:
+    """The topic a payload-bearing op targets (for write-path errors)."""
+    if op in ("publish", "publish_nowait") and args:
+        return str(args[0])
+    return None
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
@@ -133,8 +156,15 @@ class BusBrokerServer(LifecycleComponent):
             self._record_error(op, exc)
         if req_id is None:
             return
+        try:
+            frame = _dump((req_id, ok, value))
+        except FrameTooLargeError as exc:
+            # an oversized RESPONSE (e.g. a giant consume batch) must not
+            # poison the connection either — surface it as a call error
+            frame = _dump((req_id, False, f"{type(exc).__name__}: {exc}"))
+            self._record_error(op, exc)
         async with write_lock:
-            writer.write(_dump((req_id, ok, value)))
+            writer.write(frame)
             await writer.drain()
 
     async def _dispatch(self, op: str, args: tuple) -> Any:
@@ -177,10 +207,16 @@ class BusBrokerServer(LifecycleComponent):
             return bus.snapshot_state()
         if op == "restore_state":
             return bus.restore_state(*args)
+        if op == "peek":
+            return bus.peek(*args)
         if op == "inject_faults":
-            drop_p, dup_p, delay_s, topic = args
+            drop_p, dup_p, delay_s, topic, *rest = args
+            fail_p = rest[0] if rest else 0.0
             return bus.inject_faults(
-                topic, FaultPlan(drop_p=drop_p, dup_p=dup_p, delay_s=delay_s)
+                topic,
+                FaultPlan(
+                    drop_p=drop_p, dup_p=dup_p, delay_s=delay_s, fail_p=fail_p
+                ),
             )
         if op == "clear_faults":
             return bus.clear_faults(*args)
@@ -308,10 +344,14 @@ class RemoteEventBus:
         while True:
             await self._ensure_connected()
             req_id = next(self._ids)
+            # write-path frame cap: an oversized publish fails THIS call
+            # (naming the topic) instead of poisoning the peer connection;
+            # serialized before the future registers so nothing leaks
+            frame = _dump((req_id, op, args), _publish_topic(op, args))
             fut: asyncio.Future = loop.create_future()
             self._futures[req_id] = fut
             try:
-                self._writer.write(_dump((req_id, op, args)))
+                self._writer.write(frame)
                 await self._writer.drain()
                 return await fut
             except ConnectionError:
@@ -331,9 +371,10 @@ class RemoteEventBus:
         on reconnect; cursors live durably broker-side)."""
         if op == "subscribe":
             self._subs.add(args)
+        frame = _dump((None, op, args), _publish_topic(op, args))
         if self._writer is None:
             return
-        self._writer.write(_dump((None, op, args)))
+        self._writer.write(frame)
 
     # -- EventBus surface -------------------------------------------------
     async def publish(self, topic: str, payload: Any, key: Any = None) -> int:
@@ -392,10 +433,14 @@ class RemoteEventBus:
     async def topics(self) -> List[str]:
         return await self._call("topics")
 
+    async def peek(self, topic: str, max_items: int = 100) -> dict:
+        return await self._call("peek", topic, max_items)
+
     def inject_faults(self, topic: str, plan: FaultPlan) -> None:
         # the plan's rng doesn't pickle usefully; send the knobs
         self._send_nowait(
-            "inject_faults", plan.drop_p, plan.dup_p, plan.delay_s, topic
+            "inject_faults", plan.drop_p, plan.dup_p, plan.delay_s, topic,
+            plan.fail_p,
         )
 
     def clear_faults(self, topic: str) -> None:

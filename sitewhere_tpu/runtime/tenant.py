@@ -144,14 +144,41 @@ class MultitenantService(LifecycleComponent):
         )
         for u in updates:
             # the cursor is already committed for the whole poll batch: one
-            # bad update must not drop the rest of the batch
+            # bad update must not drop the rest of the batch — it dead-
+            # letters (with the failing service + error attached) so an
+            # operator can inspect and requeue it
             try:
                 await self.apply_tenant_update(u)
-            except Exception:  # noqa: BLE001
+            except Exception as exc:  # noqa: BLE001
                 logger.exception(
                     "%s: failed to apply tenant update %r", self.name, u
                 )
+                dead_letter_update(self.bus, self.name, u, exc)
         return len(updates)
+
+
+def dead_letter_update(
+    bus: EventBus, applier: str, update: dict, error: BaseException
+) -> None:
+    """Route one failed tenant-model update to the affected tenant's
+    ``dead-letter.tenant-update`` topic (non-blocking: control-plane DLQ
+    writes must never stall the drain loop)."""
+    import time
+
+    tenant = update.get("tenant", "") or "_global"
+    bus.publish_nowait(
+        bus.naming.dead_letter(tenant, "tenant-update"),
+        {
+            "stage": "tenant-update",
+            "tenant": tenant,
+            "attempts": 1,
+            "error": f"{type(error).__name__}: {error}",
+            "source_topic": bus.naming.tenant_model_updates(),
+            "applier": applier,
+            "ts": int(time.time() * 1000),
+            "payload": update,
+        },
+    )
 
 
 async def broadcast_tenant_update(bus: EventBus, update: dict) -> None:

@@ -1,0 +1,363 @@
+"""Chaos accounting: under combined publish faults (failed acks, delays,
+duplicates), scorer crash bursts, a store outage, and a flapping outbound
+connector, every accepted event is accounted for — persisted (scored or
+unscored) or sitting in a dead-letter entry with stage + attempt
+metadata — and operator-driven requeue redelivers the rest through the
+normal pipeline path. Value-level accounting: every injected measurement
+carries a unique integer value, so loss (and masking-by-duplicate) is
+detected exactly."""
+
+import asyncio
+import json
+import random
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from sitewhere_tpu.api.rest import make_app
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.pipeline.outbound import OutboundConnector
+from sitewhere_tpu.runtime.bus import FaultPlan
+from sitewhere_tpu.runtime.config import (
+    FaultTolerancePolicy,
+    InstanceConfig,
+    MeshConfig,
+    MicroBatchConfig,
+)
+from sitewhere_tpu.services.user_management import AUTH_ADMIN
+
+pytestmark = pytest.mark.chaos
+
+N_DEVICES = 6
+
+CHAOS_FT = FaultTolerancePolicy(
+    max_attempts=3,
+    backoff_base_s=0.002,
+    backoff_max_s=0.02,
+    breaker_window=8,
+    breaker_min_samples=4,
+    breaker_failure_rate=0.5,
+    breaker_open_s=0.2,
+    breaker_half_open_max=1,
+    breaker_defer_to_failover=False,  # chaos runs breaker-first
+)
+
+
+class FlakyConnector(OutboundConnector):
+    """Outbound endpoint that flaps: raises while ``fail`` is set."""
+
+    def __init__(self) -> None:
+        super().__init__("flaky")
+        self.fail = True
+        self.delivered_values: set = set()
+
+    async def deliver(self, e) -> None:
+        if self.fail:
+            raise RuntimeError("endpoint down")
+        v = getattr(e, "value", None)
+        if v is not None:
+            self.delivered_values.add(int(v))
+
+    async def deliver_batch(self, batch) -> int:
+        if self.fail:
+            raise RuntimeError("endpoint down")
+        self.delivered_values.update(
+            int(v) for v in np.asarray(batch.values).tolist()
+        )
+        return batch.n
+
+
+async def _instance():
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="chaos",
+        mesh=MeshConfig(tenant_axis=2, data_axis=1, slots_per_shard=2),
+    ))
+    await inst.start()
+    await inst.tenant_management.create_tenant(
+        "acme", template="iot-temperature",
+        microbatch=MicroBatchConfig(
+            max_batch=256, deadline_ms=1.0, buckets=(64, 256), window=16
+        ),
+        model_config={"hidden": 16},
+        max_streams=256,
+        fault_tolerance=CHAOS_FT,
+    )
+    await inst.drain_tenant_updates()
+    for _ in range(100):
+        if "acme" in inst.tenants:
+            break
+        await asyncio.sleep(0.02)
+    inst.tenants["acme"].device_management.bootstrap_fleet(N_DEVICES)
+    return inst
+
+
+def _payload(dev_i: int, values) -> bytes:
+    return json.dumps({
+        "device": f"dev-{dev_i:05d}",
+        "events": [
+            {"name": "temperature", "value": float(v)} for v in values
+        ],
+    }).encode()
+
+
+async def _send_values(rt, values, per_message: int = 5,
+                       wave_sleep: float = 0.0) -> None:
+    """Inject measurements with the given (unique) integer values."""
+    values = list(values)
+    for k, i in enumerate(range(0, len(values), per_message)):
+        chunk = values[i:i + per_message]
+        await rt.source.receiver.submit(
+            _payload(k % N_DEVICES, chunk), topic="chaos/input"
+        )
+        if wave_sleep:
+            await asyncio.sleep(wave_sleep)
+
+
+def _store_values(store) -> set:
+    cols = store.measurements.columns()
+    return {int(v) for v in np.asarray(cols["value"]).tolist()}
+
+
+def _dlq_values(inst, tenant: str) -> set:
+    out: set = set()
+    prefix = inst.bus.naming.dead_letter_prefix(tenant)
+    for t in inst.bus.topics():
+        if not t.startswith(prefix):
+            continue
+        for _off, entry in inst.bus.peek(t, 100000)["entries"]:
+            payload = entry.get("payload") if isinstance(entry, dict) else None
+            vals = getattr(payload, "values", None)
+            if vals is not None:
+                out.update(int(v) for v in np.asarray(vals).tolist())
+    return out
+
+
+async def _wait_for(cond, timeout_s=30.0, interval=0.02):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while True:
+        if cond():
+            return True
+        if loop.time() >= deadline:
+            return False
+        await asyncio.sleep(interval)
+
+
+async def _admin_client(inst):
+    inst.users.create_user("admin", "password", [AUTH_ADMIN])
+    client = TestClient(TestServer(make_app(inst)))
+    await client.start_server()
+    resp = await client.post(
+        "/api/authapi/jwt",
+        json={"username": "admin", "password": "password"},
+    )
+    token = (await resp.json())["token"]
+    client._session.headers["Authorization"] = f"Bearer {token}"
+    return client
+
+
+async def test_chaos_zero_event_loss_with_dlq_and_requeue():
+    inst = await _instance()
+    client = None
+    try:
+        rt = inst.tenants["acme"]
+        store = rt.event_store
+        naming = inst.bus.naming
+        client = await _admin_client(inst)
+        sent: set = set()
+
+        # -- phase A: healthy warm-up -------------------------------------
+        a = set(range(0, 200))
+        await _send_values(rt, a)
+        sent |= a
+        assert await _wait_for(lambda: a <= _store_values(store)), \
+            "healthy traffic did not all persist"
+
+        # -- phase B: bus faults (failed acks + delay + duplicates) plus a
+        # scorer crash burst; the retry layer must absorb ALL of it -------
+        inst.bus.inject_faults(
+            naming.decoded_events("acme"),
+            FaultPlan(fail_p=0.3, dup_p=0.15, delay_s=0.0002,
+                      rng=random.Random(7)),
+        )
+        inst.bus.inject_faults(
+            naming.scored_events("acme"),
+            FaultPlan(fail_p=0.3, dup_p=0.1, rng=random.Random(8)),
+        )
+        inst.inference.scorers["lstm_ad"].fault_steps = 6
+        b = set(range(200, 600))
+        await _send_values(rt, b, wave_sleep=0.002)
+        sent |= b
+        assert await _wait_for(
+            lambda: b <= (_store_values(store) | _dlq_values(inst, "acme"))
+        ), "events vanished under publish faults + scorer crashes"
+        # the scorer breaker tripped (breaker-first chaos policy) and rows
+        # kept flowing unscored instead of hammering the crashing scorer
+        assert (
+            inst.metrics.counter("breaker.tpu_inference.lstm_ad.opened").value
+            >= 1
+        )
+        inst.bus.clear_faults(naming.decoded_events("acme"))
+        inst.bus.clear_faults(naming.scored_events("acme"))
+        assert await _wait_for(lambda: b <= _store_values(store)), \
+            "faulted-phase events did not fully persist after faults cleared"
+
+        # -- phase C: store outage → persistence DLQ → operator requeue ---
+        orig_add = store.add_measurement_batch
+        store.add_measurement_batch = lambda batch: (_ for _ in ()).throw(
+            RuntimeError("injected store outage")
+        )
+        c = set(range(600, 800))
+        await _send_values(rt, c, wave_sleep=0.002)
+        sent |= c
+        assert await _wait_for(lambda: c <= _dlq_values(inst, "acme")), \
+            "store-outage events did not dead-letter"
+        assert not (c & _store_values(store))
+        # DLQ entries carry stage + attempt metadata through REST
+        resp = await client.get("/api/tenants/acme/deadletter")
+        assert resp.status == 200
+        body = await resp.json()
+        pstage = body["stages"]["persistence"]
+        assert pstage["depth"] > 0
+        entry = pstage["entries"][-1]
+        assert entry["stage"] == "persistence"
+        assert entry["attempts"] == CHAOS_FT.max_attempts
+        assert "injected store outage" in entry["error"]
+        assert entry["source_topic"] == naming.scored_events("acme")
+        # heal the store, requeue: redelivery rides the NORMAL path
+        store.add_measurement_batch = orig_add
+        resp = await client.post(
+            "/api/tenants/acme/deadletter/requeue",
+            json={"stage": "persistence"},
+        )
+        assert resp.status == 200
+        assert (await resp.json())["total"] > 0
+        assert await _wait_for(lambda: c <= _store_values(store)), \
+            "requeued events did not persist"
+        resp = await client.get("/api/tenants/acme/deadletter")
+        assert (await resp.json())["stages"]["persistence"]["depth"] == 0
+
+        # -- phase D: flapping outbound connector → breaker opens → parked
+        # deliveries dead-letter → heal → half-open trial closes it ------
+        flaky = FlakyConnector()
+        rt.outbound.add_connector(flaky)
+        await flaky.initialize()
+        await flaky.start()
+        assert flaky.breaker is not None, "policy wiring missing"
+        d = set(range(800, 900))
+        await _send_values(rt, d, wave_sleep=0.02)
+        sent |= d
+        assert await _wait_for(lambda: flaky.breaker.state == "open", 20.0), \
+            "connector breaker never opened"
+        assert inst.metrics.gauge(
+            "breaker.outbound[acme].flaky.state"
+        ).value == 1.0
+        assert await _wait_for(lambda: d <= _store_values(store)), \
+            "connector flap must not affect persistence"
+        assert await _wait_for(
+            lambda: d <= (flaky.delivered_values | _dlq_values(inst, "acme"))
+        ), "flapped deliveries neither delivered nor dead-lettered"
+        assert flaky.parked > 0, "open breaker should park deliveries"
+        # heal the endpoint; requeue redelivers; the half-open trial closes
+        flaky.fail = False
+        await asyncio.sleep(CHAOS_FT.breaker_open_s)
+        resp = await client.post(
+            "/api/tenants/acme/deadletter/requeue",
+            json={"stage": "outbound.flaky"},
+        )
+        assert resp.status == 200
+        assert await _wait_for(lambda: d <= flaky.delivered_values, 20.0), \
+            "requeued deliveries never reached the healed connector"
+        assert await _wait_for(
+            lambda: flaky.breaker.state == "closed", 10.0
+        ), "breaker did not close after successful redelivery"
+
+        # -- final accounting: nothing vanished ---------------------------
+        missing = sent - _store_values(store)
+        assert not missing, f"lost events: {sorted(missing)[:20]}"
+        # breaker + DLQ counters are visible on the metrics REST surface
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert "breaker_outbound_acme__flaky_state" in text.replace("[", "_").replace("]", "_") or "flaky" in text
+        assert "dlq_enqueued" in text
+    finally:
+        if client is not None:
+            await client.close()
+        await inst.terminate()
+
+
+async def test_chaos_decode_poison_and_requeue_roundtrip():
+    """Poison payloads dead-letter at decode (failed-decode topic) with
+    metadata; requeueing a HEALED payload path resubmits raw bytes through
+    the tenant's source."""
+    inst = await _instance()
+    client = None
+    try:
+        rt = inst.tenants["acme"]
+        client = await _admin_client(inst)
+        await rt.source.receiver.submit(b"\xff\xfenot json", topic="t")
+        good = set(range(1000, 1005))
+        await _send_values(rt, good)
+        assert await _wait_for(
+            lambda: good <= _store_values(rt.event_store)
+        )
+        resp = await client.get("/api/tenants/acme/deadletter")
+        body = await resp.json()
+        assert body["stages"]["decode"]["depth"] == 1
+        entry = body["stages"]["decode"]["entries"][0]
+        assert entry["stage"] == "decode"
+        assert entry["payload_type"] == "bytes"
+        # requeue: the raw payload re-enters decode; still poison, so it
+        # dead-letters AGAIN rather than vanishing (counted twice)
+        resp = await client.post("/api/tenants/acme/deadletter/requeue",
+                                 json={"stage": "decode"})
+        assert (await resp.json())["total"] == 1
+        assert await _wait_for(
+            lambda: inst.metrics.counter(
+                "event_sources.failed_decode"
+            ).value >= 2
+        )
+    finally:
+        if client is not None:
+            await client.close()
+        await inst.terminate()
+
+
+@pytest.mark.slow
+async def test_chaos_sustained_soak_zero_loss():
+    """Longer soak for tools/run_chaos.sh: continuous faulted traffic with
+    rolling scorer crashes; exact value accounting at the end."""
+    inst = await _instance()
+    try:
+        rt = inst.tenants["acme"]
+        naming = inst.bus.naming
+        inst.bus.inject_faults(
+            naming.decoded_events("acme"),
+            FaultPlan(fail_p=0.25, dup_p=0.2, delay_s=0.0005,
+                      rng=random.Random(11)),
+        )
+        inst.bus.inject_faults(
+            naming.scored_events("acme"),
+            FaultPlan(fail_p=0.25, dup_p=0.1, rng=random.Random(12)),
+        )
+        sent: set = set()
+        base = 10_000
+        for round_i in range(20):
+            vals = set(range(base, base + 200))
+            if round_i % 4 == 1:
+                inst.inference.scorers["lstm_ad"].fault_steps = 5
+            await _send_values(rt, vals, wave_sleep=0.001)
+            sent |= vals
+            base += 200
+        inst.bus.clear_faults(naming.decoded_events("acme"))
+        inst.bus.clear_faults(naming.scored_events("acme"))
+        store = rt.event_store
+        ok = await _wait_for(
+            lambda: sent <= (_store_values(store) | _dlq_values(inst, "acme")),
+            timeout_s=120.0,
+        )
+        missing = sent - _store_values(store) - _dlq_values(inst, "acme")
+        assert ok and not missing, f"lost events: {sorted(missing)[:20]}"
+    finally:
+        await inst.terminate()

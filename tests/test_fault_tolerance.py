@@ -1,0 +1,213 @@
+"""Fault-tolerance layer units: retry budgets → dead-letter topics,
+circuit breaker state machine, at-least-once publish under injected
+ack failures, and burst shedding at the receiver edge."""
+
+import asyncio
+
+import pytest
+
+from sitewhere_tpu.pipeline.sources import QueueReceiver
+from sitewhere_tpu.runtime.bus import (
+    CircuitBreaker,
+    EventBus,
+    FaultPlan,
+    RetryingConsumer,
+    TransientPublishError,
+    publish_at_least_once,
+)
+from sitewhere_tpu.runtime.config import FaultTolerancePolicy
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+FAST = FaultTolerancePolicy(
+    max_attempts=3, backoff_base_s=0.001, backoff_max_s=0.005,
+    breaker_window=8, breaker_min_samples=4, breaker_failure_rate=0.5,
+    breaker_open_s=0.05, breaker_half_open_max=1,
+)
+
+
+# -- circuit breaker ------------------------------------------------------
+
+def test_breaker_opens_at_failure_rate_and_half_opens_on_schedule():
+    now = [0.0]
+    metrics = MetricsRegistry()
+    b = CircuitBreaker("dep", FAST, metrics, clock=lambda: now[0])
+    assert b.state == "closed"
+    # below min_samples: no verdict even at 100% failure
+    for _ in range(3):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == "closed"
+    # 4th sample crosses min_samples at rate 1.0 → OPEN
+    assert b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow(), "open breaker must reject calls"
+    assert metrics.gauge("breaker.dep.state").value == 1.0
+    assert metrics.counter("breaker.dep.opened").value == 1.0
+    # before the schedule: still open
+    now[0] += 0.01
+    assert not b.allow()
+    # after breaker_open_s: half-open admits ONE trial
+    now[0] += 0.05
+    assert b.allow()
+    assert b.state == "half_open"
+    assert metrics.gauge("breaker.dep.state").value == 2.0
+    assert not b.allow(), "half-open admits only breaker_half_open_max trials"
+    # trial failure → re-open (timer restarts)
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+    # next trial succeeds → closed, window cleared
+    now[0] += 0.06
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    assert metrics.gauge("breaker.dep.state").value == 0.0
+    # mostly-healthy traffic never trips
+    for _ in range(20):
+        assert b.allow()
+        b.record_success()
+    b.record_failure()
+    assert b.state == "closed"
+
+
+def test_breaker_release_trial_returns_half_open_slot():
+    now = [0.0]
+    b = CircuitBreaker("dep2", FAST, clock=lambda: now[0])
+    for _ in range(4):
+        b.allow()
+        b.record_failure()
+    now[0] += 0.06
+    assert b.allow()          # consumes the single trial slot
+    b.release_trial()         # caller made no call after all
+    assert b.allow(), "released trial slot must be reusable"
+
+
+# -- retrying consumer ----------------------------------------------------
+
+async def test_retry_recovers_transient_handler_fault(bus):
+    metrics = MetricsRegistry()
+    rc = RetryingConsumer(bus, "t1", "persistence", "g", FAST, metrics)
+    calls = {"n": 0}
+
+    async def flaky(item):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient store outage")
+
+    ok = await rc.process({"v": 1}, flaky, "src.topic")
+    assert ok and calls["n"] == 3
+    assert metrics.counter("retry.recovered").value == 1
+    # nothing dead-lettered
+    assert bus.peek(bus.naming.dead_letter("t1", "persistence"))["depth"] == 0
+
+
+async def test_poison_item_dead_letters_with_metadata(bus):
+    metrics = MetricsRegistry()
+    rc = RetryingConsumer(bus, "t1", "inbound", "g", FAST, metrics)
+
+    async def poison(item):
+        raise ValueError("unparseable forever")
+
+    ok = await rc.process({"v": 42}, poison, "src.topic")
+    assert not ok
+    dlq = bus.naming.dead_letter("t1", "inbound")
+    view = bus.peek(dlq)
+    assert view["depth"] == 1
+    _, entry = view["entries"][0]
+    assert entry["stage"] == "inbound"
+    assert entry["tenant"] == "t1"
+    assert entry["attempts"] == FAST.max_attempts
+    assert "ValueError: unparseable forever" in entry["error"]
+    assert entry["source_topic"] == "src.topic"
+    assert entry["payload"] == {"v": 42}
+    assert metrics.counter("dlq.enqueued.inbound").value == 1
+
+
+async def test_run_loop_dead_letters_poison_and_continues(bus):
+    rc = RetryingConsumer(
+        bus, "t1", "rules", "g",
+        FaultTolerancePolicy(max_attempts=2, backoff_base_s=0.001),
+    )
+    seen = []
+
+    async def handler(item):
+        if item == "poison":
+            raise RuntimeError("boom")
+        seen.append(item)
+
+    bus.subscribe("in.topic", "g")
+    for item in ("a", "poison", "b"):
+        await bus.publish("in.topic", item)
+    task = asyncio.create_task(rc.run("in.topic", handler, max_items=16))
+    for _ in range(200):
+        if len(seen) == 2 and bus.peek(rc.dlq_topic)["depth"] == 1:
+            break
+        await asyncio.sleep(0.01)
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+    assert seen == ["a", "b"], "poison item must not block the rest"
+    assert bus.peek(rc.dlq_topic)["depth"] == 1
+
+
+# -- at-least-once publish under injected ack failures --------------------
+
+async def test_publish_retries_injected_ack_failures(bus):
+    import random
+
+    metrics = MetricsRegistry()
+    bus.subscribe("t.f", "g")
+    bus.inject_faults(
+        "t.f", FaultPlan(fail_p=0.7, rng=random.Random(3))
+    )
+    n = 50
+    for i in range(n):
+        await publish_at_least_once(
+            bus, "t.f", i,
+            policy=FaultTolerancePolicy(
+                max_attempts=4, backoff_base_s=0.0005, backoff_max_s=0.002
+            ),
+            metrics=metrics,
+        )
+    got = await bus.consume("t.f", "g", n * 2, timeout_s=0)
+    assert sorted(got) == list(range(n)), "no publish may be lost"
+    assert metrics.counter("retry.publish_attempts").value > 0
+
+
+async def test_publish_fail_p_certain_falls_back_to_nowait(bus):
+    bus.subscribe("t.dead", "g")
+    bus.inject_faults("t.dead", FaultPlan(fail_p=1.0))
+    rc = RetryingConsumer(bus, "t1", "decode", "g", FAST, MetricsRegistry())
+    await rc.publish("t.dead", "x")
+    # the nowait fallback bypasses fault hooks: the event still landed
+    got = await bus.consume("t.dead", "g", 10, timeout_s=0)
+    assert got == ["x"]
+    assert rc.metrics.counter("retry.publish_fallbacks").value == 1
+
+
+# -- receiver burst shedding ----------------------------------------------
+
+async def test_submit_nowait_sheds_oldest_and_counts():
+    r = QueueReceiver("recv")
+    r.queue = asyncio.Queue(maxsize=4)
+    metrics = MetricsRegistry()
+    r.metrics = metrics
+    for i in range(10):
+        r.submit_nowait(b"p%d" % i, topic="t")
+    assert r.queue.qsize() == 4
+    kept = [r.queue.get_nowait()[0] for _ in range(4)]
+    assert kept == [b"p6", b"p7", b"p8", b"p9"], "newest data wins"
+    assert r.shed_total == 6
+    assert metrics.counter("receiver_shed_total").value == 6
+
+
+async def test_fault_plan_roundtrip_includes_fail_p(bus):
+    plan = FaultPlan(fail_p=1.0)
+    bus.inject_faults("t.x", plan)
+    with pytest.raises(TransientPublishError):
+        await bus.publish("t.x", "boom")
+    bus.clear_faults("t.x")
+    await bus.publish("t.x", "ok")

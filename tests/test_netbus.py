@@ -92,6 +92,80 @@ async def test_fault_injection_forwarded():
         assert await bus.consume("t.f", "g", 10, timeout_s=1) == ["kept"]
 
 
+async def test_oversized_publish_rejected_on_write_path(monkeypatch):
+    """MAX_FRAME is enforced at the PRODUCER: an oversized payload fails
+    its own publish with an error naming the topic instead of reaching
+    the peer and poisoning the whole multiplexed connection."""
+    from sitewhere_tpu.runtime import netbus
+
+    monkeypatch.setattr(netbus, "MAX_FRAME", 4096)
+    async with remote_bus() as (bus, _):
+        bus.subscribe("t.big", "g")
+        with pytest.raises(netbus.FrameTooLargeError, match="t.big"):
+            await bus.publish("t.big", b"x" * 8192)
+        with pytest.raises(netbus.FrameTooLargeError, match="t.big"):
+            bus.publish_nowait("t.big", b"y" * 8192)
+        # the connection survives: a normal publish still round-trips
+        await bus.publish("t.big", "small")
+        assert await bus.consume("t.big", "g", 10, timeout_s=1) == ["small"]
+
+
+@pytest.mark.chaos
+async def test_broker_restart_resumes_from_committed_cursors(tmp_path):
+    """Broker-restart chaos: a DURABLE broker killed and restarted on the
+    same port redelivers nothing the consumer group already committed
+    (no duplicate scoring) and loses nothing published before the kill."""
+    from sitewhere_tpu.runtime.dlog import DurableEventBus
+
+    naming = TopicNaming("rb")
+
+    def make_broker(port=0):
+        return BusBrokerServer(
+            host="127.0.0.1", port=port,
+            bus=DurableEventBus(tmp_path, naming, retention=4096),
+        )
+
+    broker = make_broker()
+    await broker.initialize()
+    await broker.start()
+    port = broker.bound_port
+    bus = RemoteEventBus("127.0.0.1", port, naming=naming,
+                         reconnect_window_s=10.0)
+    await bus.connect()
+    try:
+        bus.subscribe("t.score", "scoring")
+        for i in range(10):
+            await bus.publish("t.score", i)
+        # consume+commit the first batch (commit lands at the NEXT poll —
+        # Kafka auto-commit semantics), so poll twice
+        first = await bus.consume("t.score", "scoring", 6, timeout_s=1)
+        assert first == list(range(6))
+        second = await bus.consume("t.score", "scoring", 2, timeout_s=1)
+        assert second == [6, 7]
+        # hard broker restart on the same port + data dir
+        await broker.terminate()
+        broker = make_broker(port)
+        await broker.initialize()
+        await broker.start()
+        # publishes keep flowing through the reconnect window
+        for i in range(10, 15):
+            await bus.publish("t.score", i)
+        got = []
+        for _ in range(50):
+            got += await bus.consume("t.score", "scoring", 64, timeout_s=1)
+            if got and got[-1] == 14:
+                break
+        # items 0..5 were committed (second poll acked them); 6..7 were
+        # served but NOT yet acked at kill time → redelivered, which
+        # at-least-once allows — but nothing may be missing and nothing
+        # COMMITTED may come back
+        assert got[0] >= 6, f"committed items redelivered: {got}"
+        assert sorted(set(got)) == list(range(got[0], 15)), f"lost events: {got}"
+    finally:
+        await bus.close()
+        await broker.terminate()
+
+
 async def test_full_pipeline_e2e_on_tcp_backend():
     """The whole platform — sources → inbound → tpu-inference → persist →
     rules → outbound — runs unchanged with every topic hop crossing a real

@@ -64,8 +64,10 @@ def test_local_memory_is_block_sized():
 
     from jax.sharding import PartitionSpec as P
 
+    from sitewhere_tpu.compat import shard_map
+
     spec = P(None, "seq", None, None)
-    jax.shard_map(probe, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(
+    shard_map(probe, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(
         q, k, v
     )
     assert seen["shape"][1] == 64 // 8

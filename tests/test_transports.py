@@ -257,12 +257,14 @@ async def test_ws_live_event_feed():
                 )
                 assert r.status == 202
             got = []
-            for _ in range(5):
+            while len(got) < 5:
                 msg = await asyncio.wait_for(feed.receive_json(), 10.0)
-                got.append(msg)
+                # the feed carries the full persisted stream: derived
+                # alerts (live scoring) may interleave with measurements
+                if "value" in msg:
+                    got.append(msg)
             assert len(got) == 5
             assert all(m["device_token"] == "dev-00000" for m in got)
-            assert all("value" in m for m in got)
             await feed.close()
         finally:
             await client.close()

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Run the chaos suite: fault-injection tests that prove at-least-once
+# delivery (retry budgets, dead-letter topics, circuit breakers) under
+# drop/delay/duplicate/fail publishes, scorer crashes, and flapping
+# outbound connectors. Includes the slow chaos runs tier-1 skips.
+#
+# Usage: tools/run_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
