@@ -191,6 +191,10 @@ class RestApi:
         r.add_post(
             "/api/tenants/{token}/deadletter/requeue", self.deadletter_requeue
         )
+        r.add_get("/api/tenants/{token}/slo", self.tenant_slo)
+
+        r.add_get("/api/traces", self.list_traces)
+        r.add_get("/api/traces/{id}", self.get_trace)
 
         r.add_get("/api/schedules", self.list_schedules)
         r.add_post("/api/schedules", self.create_schedule)
@@ -334,10 +338,70 @@ class RestApi:
         return web.Response(text=CONSOLE_HTML, content_type="text/html")
 
     async def metrics(self, request) -> web.Response:
+        # refresh scrape-time gauges (per-topic depth, consumer lag,
+        # receiver queue depth) so labels are current at scrape time
+        self.instance.collect_bus_gauges()
+        bus = self.instance.bus
+        from sitewhere_tpu.runtime.bus import EventBus as _InProcBus
+
+        if not isinstance(bus, _InProcBus) and hasattr(bus, "lags"):
+            # remote backend (netbus RemoteEventBus): lags() is a wire
+            # round trip, awaited here; a broker outage must not break
+            # the scrape — the rest of the metrics still render
+            try:
+                self.instance.apply_lag_gauges(await bus.lags())
+            except Exception as exc:  # noqa: BLE001
+                self.instance._record_error("lags-scrape", exc)
         return web.Response(
             text=self.instance.metrics.prometheus_text(),
             content_type="text/plain",
         )
+
+    # -- tracing ---------------------------------------------------------
+    async def list_traces(self, request) -> web.Response:
+        """Retained traces, newest first (tail-based sampling decides
+        retention — docs/OBSERVABILITY.md). ``?tenant=`` filters,
+        ``?active=1`` includes in-flight traces, ``?flush=1`` forces every
+        in-flight trace through its tail decision now (diagnostics)."""
+        tracer = self.instance.tracer
+        if request.query.get("flush", "") in ("1", "true"):
+            tracer.gc(force=True)
+        else:
+            tracer.gc()
+        limit = min(int(request.query.get("limit", 100)), 1000)
+        include_active = request.query.get("active", "") in ("1", "true")
+        traces = tracer.store.list(
+            tenant=request.query.get("tenant", ""),
+            limit=limit,
+            include_active=include_active,
+        )
+        return web.json_response({
+            "results": [t.summary() for t in traces],
+            "active": tracer.store.active_count(),
+            "retained": tracer.store.retained_count(),
+        })
+
+    async def get_trace(self, request) -> web.Response:
+        """One trace: span list plus a Chrome trace-event export
+        (``chrome://tracing`` / Perfetto — load ``.traceEvents``)."""
+        from sitewhere_tpu.runtime.tracing import chrome_trace_events
+
+        tracer = self.instance.tracer
+        tracer.gc()
+        tr = tracer.store.peek(request.match_info["id"])
+        if tr is None:
+            return web.json_response({"error": "unknown trace"}, status=404)
+        d = tr.to_dict()
+        d["traceEvents"] = chrome_trace_events(tr)
+        return web.json_response(d)
+
+    async def tenant_slo(self, request) -> web.Response:
+        """Per-tenant SLO report: stage latency summaries + tail-sampling
+        retention counts against the tenant's configured slo_ms."""
+        token = request.match_info["token"]
+        if token not in self.instance.tenants:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        return web.json_response(self.instance.tenant_slo_report(token))
 
     async def topology(self, request) -> web.Response:
         return web.json_response(self.instance.topology())
@@ -781,7 +845,8 @@ class RestApi:
             return {"offset": offset, "payload_type": type(entry).__name__}
         out = {
             k: entry.get(k)
-            for k in ("stage", "attempts", "error", "source_topic", "ts")
+            for k in ("stage", "attempts", "error", "source_topic", "ts",
+                      "trace_id")  # trace_id links to GET /api/traces/{id}
             if k in entry
         }
         out["offset"] = offset

@@ -74,6 +74,11 @@ class MeasurementBatch:
     # batch-level trace marks (stage → epoch ms) — the columnar analog of
     # DeviceEvent.trace for p99 accounting
     trace: Dict[str, float] = field(default_factory=dict)
+    # end-to-end trace context (core.trace.TraceContext | None), minted at
+    # the ingest edge when the tenant has tracing enabled; one trace per
+    # batch — the columnar unit of tracing (per-row spans would put a
+    # Python loop back on the hot path)
+    trace_ctx: Optional[object] = None
     # cached group indices: (uniq object[], inverse int32[]) for the token /
     # name columns. np.unique over object arrays is a string argsort — the
     # single biggest per-batch host cost when every stage re-derives it —
@@ -292,6 +297,7 @@ class MeasurementBatch:
             area_tokens=cut(self.area_tokens),
             scores=cut(self.scores),
             trace=dict(self.trace),
+            trace_ctx=self.trace_ctx,
         )
 
     def to_events(self) -> List[DeviceMeasurement]:
@@ -392,6 +398,11 @@ class MeasurementBatch:
             received_ts=np.concatenate([b.received_ts for b in bs]),
             valid=np.concatenate([b.valid for b in bs]),
             scores=_cat_opt("scores", np.nan, np.float32),
+            # a combined batch keeps the FIRST input's trace identity (one
+            # trace per batch; the others' traces decide at idle timeout)
+            trace_ctx=next(
+                (b.trace_ctx for b in bs if b.trace_ctx is not None), None
+            ),
             **{c: _cat_opt(c, "", object) for c in MeasurementBatch.OBJ_COLS},
         )
 
@@ -426,6 +437,7 @@ class MeasurementBatch:
             valid=np.concatenate([self.valid, np.zeros((pad,), bool)]),
             scores=_pad_opt(self.scores, np.nan, np.float32),
             trace=dict(self.trace),
+            trace_ctx=self.trace_ctx,
             **{
                 c: _pad_opt(getattr(self, c), "", object)
                 for c in self.OBJ_COLS

@@ -71,6 +71,10 @@ class DeviceEvent:
     received_ts: int = field(default_factory=now_ms)
     metadata: Dict[str, str] = field(default_factory=dict)
     trace: Dict[str, float] = field(default_factory=dict)
+    # end-to-end trace context (core.trace.TraceContext | None) — carried
+    # in-proc / over the wire beside the per-stage ``trace`` marks so the
+    # tracing layer can correlate this event into its full trace
+    trace_ctx: Optional[Any] = field(default=None, repr=False)
 
     EVENT_TYPE: EventType = field(default=EventType.MEASUREMENT, repr=False)
 
@@ -95,6 +99,8 @@ class DeviceEvent:
         }
         if self.trace:
             d["trace"] = dict(self.trace)
+        if self.trace_ctx is not None:
+            d["trace_id"] = self.trace_ctx.trace_id
         d.update(self._payload_dict())
         return d
 

@@ -1,0 +1,95 @@
+"""Trace context — the propagation half of end-to-end event tracing.
+
+A ``TraceContext`` is minted at an ingest edge (MQTT/HTTP/WS/CoAP event
+sources, the gRPC event API, netbus-published payloads) and rides the
+payload through every pipeline stage: ``MeasurementBatch.trace_ctx`` on
+the columnar hot path, ``DeviceEvent.trace_ctx`` on the object path, and
+the ``"_trace"`` key on decoded request dicts. It deliberately lives in
+the DATA layer (``sitewhere_tpu.core``): the restricted wire unpickler
+(``runtime.safepickle``) admits core classes, so a context crosses the
+netbus/durable-log boundary inside its payload with zero extra plumbing.
+
+The recording half (spans, tail-based sampling, the bounded in-process
+store) lives in ``runtime.tracing`` — contexts are plain data and carry
+no reference to it.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(slots=True)
+class TraceContext:
+    """Identity + baggage for one end-to-end event trace.
+
+    ``span_id`` is the id of the most recently recorded span on this
+    context's chain — the recorder advances it so the next stage's span
+    parents correctly. Baggage (tenant / device / source topic) is fixed
+    at mint time.
+    """
+
+    trace_id: str = field(default_factory=new_trace_id)
+    span_id: str = field(default_factory=new_span_id)
+    tenant: str = ""
+    device: str = ""
+    source_topic: str = ""
+
+    def child(self) -> "TraceContext":
+        """A derived context (rule-derived events, command invocations):
+        same trace, parented at this chain's current span."""
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=self.span_id,
+            tenant=self.tenant,
+            device=self.device,
+            source_topic=self.source_topic,
+        )
+
+    # -- header round trip (gRPC metadata / external wire formats) -------
+    def to_headers(self) -> Dict[str, str]:
+        return {
+            "x-sw-trace-id": self.trace_id,
+            "x-sw-span-id": self.span_id,
+            "x-sw-tenant": self.tenant,
+            "x-sw-device": self.device,
+            "x-sw-source": self.source_topic,
+        }
+
+    @staticmethod
+    def from_headers(h: Dict[str, str]) -> Optional["TraceContext"]:
+        tid = h.get("x-sw-trace-id", "")
+        if not tid:
+            return None
+        return TraceContext(
+            trace_id=tid,
+            span_id=h.get("x-sw-span-id", "") or new_span_id(),
+            tenant=h.get("x-sw-tenant", ""),
+            device=h.get("x-sw-device", ""),
+            source_topic=h.get("x-sw-source", ""),
+        )
+
+
+def trace_ctx_of(item: Any) -> Optional[TraceContext]:
+    """The one extractor every stage / DLQ writer uses: pull the trace
+    context off any pipeline payload shape (batch, event, request dict)."""
+    ctx = getattr(item, "trace_ctx", None)
+    if ctx is not None:
+        return ctx
+    if isinstance(item, dict):
+        ctx = item.get("_trace")
+        if isinstance(ctx, TraceContext):
+            return ctx
+        payload = item.get("payload")  # dead-letter entries wrap payloads
+        if payload is not None and payload is not item:
+            return trace_ctx_of(payload)
+    return None

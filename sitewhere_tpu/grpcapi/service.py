@@ -188,15 +188,25 @@ class EventManagementServicer:
         topic = bus.naming.decoded_events(tenant)
         now = now_ms()
         accepted = 0
+        tracer = getattr(self.instance, "tracer", None)
+        # gRPC is an ingest edge like any event source: mint here so
+        # pipeline spans trace API-originated events too (guarded — a
+        # tracing-disabled tenant pays no per-measurement mint)
+        traced = tracer is not None and tracer.enabled_for(tenant)
         for m in req.measurements:
-            await bus.publish(topic, {
+            r = {
                 "type": "measurement",
                 "device_token": m.device_token,
                 "name": m.name,
                 "value": m.value,
                 "event_ts": m.event_ts or now,
                 "received_ts": now,
-            })
+            }
+            if traced:
+                r["_trace"] = tracer.mint(
+                    tenant, device=m.device_token, source_topic="grpc"
+                )
+            await bus.publish(topic, r)
             accepted += 1
         return pb.AddMeasurementsResponse(accepted=accepted)
 

@@ -59,6 +59,7 @@ from sitewhere_tpu.runtime.lifecycle import (
     cancel_and_wait,
 )
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.tracing import Tracer
 from sitewhere_tpu.services.asset_management import AssetManagement
 from sitewhere_tpu.services.batch_operations import BatchOperationManager
 from sitewhere_tpu.services.device_management import DeviceManagement
@@ -74,6 +75,13 @@ from sitewhere_tpu.services.user_management import (
     UserManagement,
 )
 from sitewhere_tpu.sim.broker import SimBroker
+
+
+def _count_by(values) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for v in values:
+        out[v] = out.get(v, 0) + 1
+    return out
 
 
 @dataclass
@@ -143,12 +151,20 @@ class SiteWhereInstance(LifecycleComponent):
         self.checkpoints = (
             CheckpointManager(cfg.data_dir) if cfg.checkpointing else None
         )
+        # end-to-end tracing: ONE tracer shared by every stage of every
+        # tenant; per-tenant knobs (enabled/sample_rate/slo_ms) register
+        # from TenantEngineConfig.tracing at tenant build time
+        self.tracer = Tracer(self.metrics)
         self.inference = TpuInferenceService(
             self.bus, self.mesh, self.metrics,
             slots_per_shard=cfg.mesh.slots_per_shard,
             max_inflight=cfg.inference_max_inflight,
             checkpoints=self.checkpoints,
+            tracer=self.tracer,
         )
+        # profile hooks: annotate scoring dispatches inside the jax
+        # profiler trace when the instance is capturing one
+        self.inference.profile_annotations = bool(cfg.profile_dir)
         self.add_child(self.inference)
         self.tenants: Dict[str, TenantRuntime] = {}
         self.coap: object = None
@@ -324,10 +340,13 @@ class SiteWhereInstance(LifecycleComponent):
         dm = dm or DeviceManagement(tenant)
         store = store or EventStore(tenant)
         ft = cfg.fault_tolerance
+        # register the tenant's tracing policy BEFORE building stages (the
+        # event source checks it to decide receive-timestamping)
+        self.tracer.configure_tenant(tenant, cfg.tracing)
         receiver = QueueReceiver(f"recv[{tenant}]")
         source = EventSource(
             f"mqtt[{tenant}]", tenant, self.bus, receiver, cfg.decoder,
-            self.metrics, policy=ft,
+            self.metrics, policy=ft, tracer=self.tracer,
         )
 
         async def on_broker_msg(topic: str, payload: bytes) -> None:
@@ -339,7 +358,7 @@ class SiteWhereInstance(LifecycleComponent):
 
         rules = RuleEngine(tenant, self.bus, [
             anomaly_score_rule(f"{tenant}-anomaly", min_score=3.0, cooldown_ms=5000),
-        ], self.metrics, policy=ft)
+        ], self.metrics, policy=ft, tracer=self.tracer)
         connectors = [
             LogConnector(f"log[{tenant}]"),
             MqttTopicConnector(
@@ -355,6 +374,7 @@ class SiteWhereInstance(LifecycleComponent):
             connectors.append(search)
         outbound = OutboundDispatcher(
             tenant, self.bus, connectors, self.metrics, policy=ft,
+            tracer=self.tracer,
         )
         mqtt_source = None
         if cfg.mqtt_ingest:
@@ -398,7 +418,7 @@ class SiteWhereInstance(LifecycleComponent):
                         rec.auth_token if rec is not None else "",
                     )),
                 ),
-                cfg.decoder, self.metrics, policy=ft,
+                cfg.decoder, self.metrics, policy=ft, tracer=self.tracer,
             )
         media = StreamingMedia(tenant)
         media_pipe = None
@@ -420,10 +440,12 @@ class SiteWhereInstance(LifecycleComponent):
             mqtt_source=mqtt_source,
             source=source,
             inbound=InboundProcessor(
-                tenant, self.bus, dm, self.metrics, policy=ft
+                tenant, self.bus, dm, self.metrics, policy=ft,
+                tracer=self.tracer,
             ),
             persistence=EventPersistence(
-                tenant, self.bus, store, self.metrics, policy=ft
+                tenant, self.bus, store, self.metrics, policy=ft,
+                tracer=self.tracer,
             ),
             rules=rules,
             outbound=outbound,
@@ -458,6 +480,7 @@ class SiteWhereInstance(LifecycleComponent):
     async def remove_tenant(self, tenant: str) -> None:
         rt = self.tenants.pop(tenant, None)
         self._shared_targets = None
+        self.tracer.remove_tenant(tenant)
         if rt is None:
             return
         # stop broker ingress FIRST: the closure would otherwise keep
@@ -473,6 +496,10 @@ class SiteWhereInstance(LifecycleComponent):
         # would backpressure future publishers (topics recreate lazily if
         # the tenant is ever re-added)
         self.bus.drop_topics(self.bus.naming.tenant_topic(tenant, ""))
+        # drop the tenant's labeled metric children + inference timer:
+        # label cardinality must track LIVE tenants, not historical churn
+        self.inference._stage_timers.pop(tenant, None)
+        self.metrics.drop_labeled(tenant=tenant)
 
     async def restart_tenant(self, tenant: str) -> None:
         rt = self.tenants.get(tenant)
@@ -695,6 +722,81 @@ class SiteWhereInstance(LifecycleComponent):
                 )
             await self.add_tenant(cfg)
         return len(manifest)
+
+    # -- observability ---------------------------------------------------
+    def collect_bus_gauges(self) -> None:
+        """Refresh per-topic depth + per-group consumer-lag gauges (and
+        per-tenant receiver queue depths) from live state. Called by the
+        /metrics scrape handler so the labels are current at scrape time —
+        a 10^3-topic instance pays this only when someone is looking."""
+        m = self.metrics
+        m.describe("bus_topic_depth", "retained entries per bus topic")
+        m.describe(
+            "bus_consumer_lag",
+            "unconsumed entries per (topic, consumer group)",
+        )
+        m.describe(
+            "receiver_queue_depth", "pending raw payloads per tenant receiver"
+        )
+        if isinstance(self.bus, EventBus):
+            # remote buses answer lags() over the wire — the async
+            # /metrics handler awaits it and feeds apply_lag_gauges
+            self.apply_lag_gauges(self.bus.lags())
+        for token, rt in self.tenants.items():
+            m.gauge("receiver_queue_depth", tenant=token).set(
+                rt.source.receiver.queue.qsize()
+            )
+
+    def apply_lag_gauges(self, lags: Dict[str, dict]) -> None:
+        """Feed one ``bus.lags()`` result (in-proc or RemoteEventBus) into
+        the per-topic depth / per-group lag gauges."""
+        m = self.metrics
+        for topic, info in lags.items():
+            m.gauge("bus_topic_depth", topic=topic).set(info["depth"])
+            for group, lag in info["groups"].items():
+                m.gauge(
+                    "bus_consumer_lag", topic=topic, group=group
+                ).set(lag)
+
+    def tenant_slo_report(self, tenant: str) -> dict:
+        """Per-tenant SLO view: the tracing policy, per-stage latency
+        summaries (from the labeled stage histograms), and tail-sampling
+        retention counters — the GET /api/tenants/{t}/slo payload."""
+        pol = self.tracer.policy_for(tenant)
+        stages: Dict[str, dict] = {}
+        fam = self.metrics._labeled.get("pipeline_stage_seconds", {})
+        wait_fam = self.metrics._labeled.get(
+            "pipeline_stage_queue_wait_seconds", {}
+        )
+        for key, h in fam.items():
+            labels = dict(key)
+            if labels.get("tenant") != tenant:
+                continue
+            stage = labels.get("stage", "?")
+            stages[stage] = {"service": h.summary()}
+        for key, h in wait_fam.items():
+            labels = dict(key)
+            if labels.get("tenant") != tenant:
+                continue
+            stages.setdefault(labels.get("stage", "?"), {})[
+                "queue_wait"
+            ] = h.summary()
+        self.tracer.gc()
+        traces = self.tracer.store.list(tenant=tenant, limit=10_000,
+                                        include_active=False)
+        breaches = sum(1 for t in traces if t.duration_ms >= pol.slo_ms)
+        return {
+            "tenant": tenant,
+            "slo_ms": pol.slo_ms,
+            "tracing_enabled": pol.enabled,
+            "sample_rate": pol.sample_rate,
+            "stages": stages,
+            "traces_retained": len(traces),
+            "slo_breach_traces": breaches,
+            "retained_by_reason": _count_by(
+                t.decision for t in traces
+            ),
+        }
 
     # -- introspection ---------------------------------------------------
     def topology(self) -> dict:

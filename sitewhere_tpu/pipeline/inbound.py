@@ -44,6 +44,7 @@ class InboundProcessor(LifecycleComponent):
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 1024,
         policy: Optional[FaultTolerancePolicy] = None,
+        tracer=None,
     ) -> None:
         super().__init__(f"inbound-processing[{tenant}]")
         self.tenant = tenant
@@ -51,8 +52,13 @@ class InboundProcessor(LifecycleComponent):
         self.dm = device_management
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        self.tracer = tracer
+        from sitewhere_tpu.runtime.tracing import StageTimer
+
+        self.stage_timer = StageTimer(tracer, self.metrics, tenant, "inbound")
         self.retry = RetryingConsumer(
-            bus, tenant, "inbound", self.group, policy=policy, metrics=self.metrics
+            bus, tenant, "inbound", self.group, policy=policy,
+            metrics=self.metrics, tracer=tracer,
         )
         self._task: Optional[asyncio.Task] = None
 
@@ -89,6 +95,20 @@ class InboundProcessor(LifecycleComponent):
         processed = self.metrics.counter("inbound.processed")
         unregistered = self.metrics.counter("inbound.unregistered")
         rejected = self.metrics.counter("inbound.rejected")
+        import time as _time
+
+        t0 = _time.time() * 1000.0
+        if (
+            batch.trace_ctx is None
+            and self.tracer is not None
+            and self.tracer.enabled_for(self.tenant)
+        ):
+            # netbus-published batches enter decoded-events without a
+            # context (remote producer may predate tracing) — mint here so
+            # the rest of the pipeline still traces them
+            batch.trace_ctx = self.tracer.mint(
+                self.tenant, source_topic="bus"
+            )
 
         tokens = batch.device_tokens
         uniq, inverse = batch.token_index()
@@ -139,6 +159,10 @@ class InboundProcessor(LifecycleComponent):
             else asg_by_u[inverse]
         out.area_tokens = area_by_u[inverse][keep] if keep.size != batch.n \
             else area_by_u[inverse]
+        self.stage_timer.observe(
+            out, t0, _time.time() * 1000.0, n_events=int(keep.size),
+            unregistered=int(unknown_rows.size),
+        )
         out.mark("inbound")
         await self.bus.publish(self.bus.naming.inbound_events(self.tenant), out)
         processed.inc(keep.size)
@@ -173,8 +197,12 @@ class InboundProcessor(LifecycleComponent):
             rejected.inc()
             return None
 
+        import time as _time
+
+        t0 = _time.time() * 1000.0
         enriched = dict(req)
         enriched.pop("_source", None)
+        trace_ctx = enriched.pop("_trace", None)
         enriched["tenant"] = self.tenant
         enriched["assignment_token"] = assignment.token
         enriched["area_token"] = assignment.area_token
@@ -186,6 +214,8 @@ class InboundProcessor(LifecycleComponent):
         except (ValueError, KeyError):
             rejected.inc()
             return None
+        event.trace_ctx = trace_ctx
+        self.stage_timer.observe(event, t0, _time.time() * 1000.0)
         event.mark("inbound")
         await self.bus.publish(
             self.bus.naming.inbound_events(self.tenant), event

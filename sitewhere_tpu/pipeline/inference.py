@@ -61,6 +61,24 @@ from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
 
+def _profiler_annotation(enabled: bool, family: str):
+    """A ``jax.profiler.TraceAnnotation`` around the scoring dispatch when
+    the instance is capturing a profile (InstanceConfig.profile_dir), so
+    per-family device time is attributable inside the trace; a cheap
+    nullcontext otherwise — and on any profiler fault (the profiler is
+    process-global and can be owned elsewhere)."""
+    import contextlib
+
+    if not enabled:
+        return contextlib.nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(f"tpu_scoring/{family}")
+    except Exception:  # noqa: BLE001 - never let profiling break scoring
+        return contextlib.nullcontext()
+
+
 class StreamRegistry:
     """Per-tenant map (device_token, name) → (data_shard, local_id).
 
@@ -263,11 +281,22 @@ class TpuInferenceService(MultitenantService):
         poll_batch: int = 64,
         max_inflight: int = 8,
         checkpoints=None,
+        tracer=None,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
         self.metrics = metrics or MetricsRegistry()
         self.checkpoints = checkpoints  # CheckpointManager | None
+        # tracing + scoring profile hooks: per-tenant inference spans, a
+        # compile-count per (family, bucket) shape (the first flush at a
+        # shape IS the XLA compile — a mid-traffic recompile is the p99
+        # cliff SURVEY §7 warns about), and optional jax.profiler
+        # annotations so device time shows up in profile_dir traces
+        self.tracer = tracer
+        self._stage_timers: Dict[str, object] = {}
+        self._seen_shapes: set = set()
+        self._last_flush: Dict[str, dict] = {}
+        self.profile_annotations = False
         self.slots_per_shard = slots_per_shard
         self.poll_batch = poll_batch  # bus items (batches) per poll
         self.router = TenantRouter(self.mm.n_tenant_shards, slots_per_shard)
@@ -424,6 +453,7 @@ class TpuInferenceService(MultitenantService):
         self._next_seq += 1
         entry = [batch, n]
         self._batches[seq] = entry
+        batch.mark("inference_enqueue")  # inference span start / lane wait
 
         # per-row (dshard, local_id): one registry lookup per UNIQUE
         # (device, name) series, scattered back via inverse indices — no
@@ -478,8 +508,34 @@ class TpuInferenceService(MultitenantService):
             await self._publish_batch(s, nowait=publish_nowait)
         return done
 
+    def _stage_timer(self, tenant: str):
+        t = self._stage_timers.get(tenant)
+        if t is None:
+            from sitewhere_tpu.runtime.tracing import StageTimer
+
+            t = self._stage_timers[tenant] = StageTimer(
+                self.tracer, self.metrics, tenant, "inference"
+            )
+        return t
+
     async def _publish_batch(self, seq: int, nowait: bool = False) -> None:
         batch, _ = self._batches.pop(seq)
+        # inference span: start = lane enqueue, queue wait = bus time since
+        # the inbound stage published; annotations carry the family's last
+        # flush profile (dispatch time, whether it compiled a new shape)
+        t_now = time.time() * 1000.0
+        enq = batch.trace.get("inference_enqueue", t_now)
+        prev = max(
+            (v for k, v in batch.trace.items() if k != "inference_enqueue"),
+            default=enq,
+        )
+        engine = self.engines.get(batch.tenant)
+        family = engine.config.model if engine is not None else ""
+        self._stage_timer(batch.tenant).observe(
+            batch, enq, t_now, n_events=batch.n,
+            queue_wait_ms=max(0.0, enq - prev),
+            **self._last_flush.get(family, {}),
+        )
         batch.mark("scored")
         topic = self.bus.naming.scored_events(batch.tenant)
         if nowait:
@@ -603,12 +659,36 @@ class TpuInferenceService(MultitenantService):
             np.concatenate(tk_seqs),
             np.concatenate(tk_rows),
         )
+        shape_key = (family, b_lane)
+        compiling = shape_key not in self._seen_shapes
         try:
             t_disp = time.perf_counter()
-            scores_dev = scorer.step_counts(ids, vals, counts)  # async dispatch
+            with _profiler_annotation(self.profile_annotations, family):
+                scores_dev = scorer.step_counts(ids, vals, counts)  # async dispatch
+            dispatch_s = time.perf_counter() - t_disp
             self.metrics.histogram("tpu_inference.dispatch", unit="s").record(
-                time.perf_counter() - t_disp
+                dispatch_s
             )
+            self.metrics.histogram(
+                "tpu_inference_dispatch_seconds", family=family
+            ).record(dispatch_s)
+            if compiling:
+                # first flush at this (family, bucket) shape = XLA compile;
+                # a counter bump here is how a mid-traffic recompile (new
+                # bucket, missed prewarm) becomes attributable instead of
+                # an anonymous p99 cliff
+                self._seen_shapes.add(shape_key)
+                self.metrics.counter("tpu_inference.compiles").inc()
+                self.metrics.counter(
+                    "tpu_inference_compiles", family=family,
+                    bucket=str(b_lane),
+                ).inc()
+            self._last_flush[family] = {
+                "family": family,
+                "dispatch_s": round(dispatch_s, 6),
+                "compiled": compiling,
+                "bucket": b_lane,
+            }
             self.metrics.counter("tpu_inference.flushes").inc()
             self.metrics.counter("tpu_inference.flush_rows").inc(moved)
             # d2h diet: when ONE slot carries this flush's rows (the common
@@ -684,6 +764,11 @@ class TpuInferenceService(MultitenantService):
         if scorer is not None:
             try:
                 scorer.rebuild_runtime()
+                # the rebuilt jit cache recompiles every shape: reset the
+                # family's seen-shape set so the compile counter stays true
+                self._seen_shapes = {
+                    k for k in self._seen_shapes if k[0] != family
+                }
             except Exception as exc:  # noqa: BLE001 - device may be gone
                 self._record_error("rebuild", exc)
         for tenant, engine in list(self.engines.items()):

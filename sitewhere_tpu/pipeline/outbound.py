@@ -499,6 +499,7 @@ class OutboundDispatcher(LifecycleComponent):
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 4096,
         policy: Optional[FaultTolerancePolicy] = None,
+        tracer=None,
     ) -> None:
         super().__init__(f"outbound-connectors[{tenant}]")
         self.tenant = tenant
@@ -506,6 +507,12 @@ class OutboundDispatcher(LifecycleComponent):
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
         self.policy = policy
+        self.tracer = tracer
+        from sitewhere_tpu.runtime.tracing import StageTimer
+
+        # outbound is the TERMINAL stage: its span seals the trace and
+        # triggers the tail-based sampling decision (runtime.tracing)
+        self.stage_timer = StageTimer(tracer, self.metrics, tenant, "outbound")
         self._task: Optional[asyncio.Task] = None
         for c in connectors or []:
             self.add_child(c)
@@ -528,6 +535,7 @@ class OutboundDispatcher(LifecycleComponent):
             RetryingConsumer(
                 self.bus, self.tenant, f"outbound.{c.connector_id}",
                 self.group, policy=self.policy, metrics=self.metrics,
+                tracer=self.tracer,
             ),
             CircuitBreaker(
                 f"outbound[{self.tenant}].{c.connector_id}",
@@ -553,18 +561,29 @@ class OutboundDispatcher(LifecycleComponent):
         self._task = None
 
     async def _run(self) -> None:
+        import time as _time
+
         src = self.bus.naming.persisted_events(self.tenant)
         delivered = self.metrics.counter("outbound.delivered")
         while True:
             items = await self.bus.consume(src, self.group, self.poll_batch)
             for item in items:
+                t0 = _time.time() * 1000.0
                 if isinstance(item, MeasurementBatch):
                     results = await asyncio.gather(
                         *(c.process_batch(item) for c in self.connectors)
                     )
-                    delivered.inc(sum(results))
+                    n_del = sum(results)
+                    delivered.inc(n_del)
+                    n = item.n
                 else:
                     results = await asyncio.gather(
                         *(c.process(item) for c in self.connectors)
                     )
-                    delivered.inc(sum(bool(r) for r in results))
+                    n_del = sum(bool(r) for r in results)
+                    delivered.inc(n_del)
+                    n = 1
+                self.stage_timer.observe(
+                    item, t0, _time.time() * 1000.0, n_events=n,
+                    delivered=n_del,
+                )

@@ -30,6 +30,7 @@ class EventPersistence(LifecycleComponent):
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 4096,
         policy: Optional[FaultTolerancePolicy] = None,
+        tracer=None,
     ) -> None:
         super().__init__(f"event-persistence[{tenant}]")
         self.tenant = tenant
@@ -37,9 +38,14 @@ class EventPersistence(LifecycleComponent):
         self.store = store
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        from sitewhere_tpu.runtime.tracing import StageTimer
+
+        self.stage_timer = StageTimer(
+            tracer, self.metrics, tenant, "persistence"
+        )
         self.retry = RetryingConsumer(
             bus, tenant, "persistence", self.group,
-            policy=policy, metrics=self.metrics,
+            policy=policy, metrics=self.metrics, tracer=tracer,
         )
         # hoisted out of the per-item handler (hot path)
         self._out_topic = bus.naming.persisted_events(tenant)
@@ -66,13 +72,21 @@ class EventPersistence(LifecycleComponent):
         )
 
     async def _handle(self, item) -> None:
+        import time as _time
+
+        t0 = _time.time() * 1000.0
         if isinstance(item, MeasurementBatch):
             # columnar fast path: ONE append + ONE re-publish per batch
             self.store.add_measurement_batch(item)
             self._persisted.inc(item.n)
+            self.stage_timer.observe(
+                item, t0, _time.time() * 1000.0, n_events=item.n
+            )
             item.mark("persisted")
             await self.retry.publish(self._out_topic, item)
         else:
             self.store.add_event(item)
             self._persisted.inc()
+            self.stage_timer.observe(item, t0, _time.time() * 1000.0)
+            item.mark("persisted")
             await self.retry.publish(self._out_topic, item)

@@ -457,6 +457,7 @@ class RuleEngine(LifecycleComponent):
         metrics: Optional[MetricsRegistry] = None,
         poll_batch: int = 4096,
         policy: Optional[FaultTolerancePolicy] = None,
+        tracer=None,
     ) -> None:
         super().__init__(f"rule-processing[{tenant}]")
         self.tenant = tenant
@@ -464,8 +465,12 @@ class RuleEngine(LifecycleComponent):
         self.rules: List[Rule] = list(rules or [])
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        from sitewhere_tpu.runtime.tracing import StageTimer
+
+        self.stage_timer = StageTimer(tracer, self.metrics, tenant, "rules")
         self.retry = RetryingConsumer(
-            bus, tenant, "rules", self.group, policy=policy, metrics=self.metrics
+            bus, tenant, "rules", self.group, policy=policy,
+            metrics=self.metrics, tracer=tracer,
         )
         self._task: Optional[asyncio.Task] = None
 
@@ -500,10 +505,17 @@ class RuleEngine(LifecycleComponent):
         )
 
     async def _handle(self, item) -> None:
+        t0 = time.time() * 1000.0
         if isinstance(item, MeasurementBatch):
-            await self.process_batch(item)
+            derived = await self.process_batch(item)
+            n = item.n
         else:
-            await self.process_event(item)
+            derived = await self.process_event(item)
+            n = 1
+        self.stage_timer.observe(
+            item, t0, time.time() * 1000.0, n_events=n,
+            fired=len(derived),
+        )
 
     async def process_batch(self, batch: MeasurementBatch) -> List[DeviceEvent]:
         """Columnar evaluation: rules with a ``vector_where`` run one numpy
@@ -578,11 +590,21 @@ class RuleEngine(LifecycleComponent):
                 if derived:
                     fired.inc()
                     derived_out.extend(derived)
-        await self._emit_derived(derived_out)
+        await self._emit_derived(derived_out, parent=batch)
         return derived_out
 
-    async def _emit_derived(self, derived_out: List[DeviceEvent]) -> None:
+    async def _emit_derived(
+        self, derived_out: List[DeviceEvent], parent=None
+    ) -> None:
+        from sitewhere_tpu.core.trace import trace_ctx_of
+
+        parent_ctx = trace_ctx_of(parent) if parent is not None else None
         for d in derived_out:
+            if d.trace_ctx is None and parent_ctx is not None:
+                # derived events (alerts, command invocations) stay on the
+                # origin event's trace: their persistence/outbound spans
+                # show up as children of the rule that fired
+                d.trace_ctx = parent_ctx.child()
             d.mark("rule")
             if d.EVENT_TYPE is EventType.COMMAND_INVOCATION:
                 await self.retry.publish(
@@ -610,5 +632,5 @@ class RuleEngine(LifecycleComponent):
                 derived_out.extend(derived)
         # derived alerts re-enter at the scored stage (they get persisted +
         # fanned out); alerts don't match measurement rules so no feedback loop
-        await self._emit_derived(derived_out)
+        await self._emit_derived(derived_out, parent=e)
         return derived_out

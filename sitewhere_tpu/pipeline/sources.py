@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import time
 from typing import Any, Dict, List, Optional
 
 from sitewhere_tpu.core.batch import MeasurementBatch
@@ -44,14 +45,23 @@ class InboundReceiver(LifecycleComponent):
         # EventSource attaches the instance registry so sheds surface as
         # ``receiver_shed_total`` on the normal /metrics scrape
         self.metrics: Optional[MetricsRegistry] = None
+        # set by EventSource when the tenant has tracing enabled: payloads
+        # get a receive stamp so the decode span's queue-wait (time spent
+        # in this receiver queue) is measurable. Guarded — an untraced
+        # tenant's submit path stays allocation-identical to before.
+        self.stamp_recv_ts = False
 
     async def submit(self, payload: bytes, **context: Any) -> None:
+        if self.stamp_recv_ts:
+            context["_recv_t"] = time.time() * 1000.0
         await self.queue.put((payload, context))
 
     def submit_nowait(self, payload: bytes, **context: Any) -> None:
         """Non-blocking submit for network receiver loops. A full queue
         sheds the OLDEST queued payload (newest data wins under burst —
         counted, never raised into the receiver loop)."""
+        if self.stamp_recv_ts:
+            context["_recv_t"] = time.time() * 1000.0
         try:
             self.queue.put_nowait((payload, context))
             return
@@ -211,6 +221,7 @@ class EventSource(LifecycleComponent):
         metrics: Optional[MetricsRegistry] = None,
         dedup: bool = True,
         policy: Optional[FaultTolerancePolicy] = None,
+        tracer=None,
     ) -> None:
         super().__init__(f"event-source[{source_id}]")
         self.source_id = source_id
@@ -222,11 +233,20 @@ class EventSource(LifecycleComponent):
         self.dedup = Deduplicator() if dedup else None
         self._pump: Optional[asyncio.Task] = None
         receiver.metrics = self.metrics
+        # THE trace mint edge: every ingest transport (in-proc broker,
+        # real MQTT, HTTP, WS, CoAP, socket) funnels payloads through a
+        # receiver into this source, so minting here covers them all
+        self.tracer = tracer
+        from sitewhere_tpu.runtime.tracing import StageTimer
+
+        self.stage_timer = StageTimer(tracer, self.metrics, tenant, "decode")
+        if tracer is not None and tracer.enabled_for(tenant):
+            receiver.stamp_recv_ts = True
         # decode is the first at-least-once stage: publishes ride a retry
         # budget; undecodable payloads dead-letter to failed-decode
         self.retry = RetryingConsumer(
             bus, tenant, "decode", f"event-source[{source_id}]",
-            policy=policy, metrics=self.metrics,
+            policy=policy, metrics=self.metrics, tracer=tracer,
         )
         self.add_child(receiver)
 
@@ -297,6 +317,7 @@ class EventSource(LifecycleComponent):
 
             item = await q.get()
             now = now_ms()
+            first_context = item[1]  # decode-span baggage + queue wait
             while True:
                 payload, context = item
                 n_payloads += 1
@@ -371,7 +392,31 @@ class EventSource(LifecycleComponent):
                         out_batches.append(
                             MeasurementBatch.from_requests(self.tenant, good)
                         )
+            t_done = time.time() * 1000.0
+            src_topic = str(first_context.get("topic", self.source_id))
+            recv_t = first_context.get("_recv_t")
+            queue_wait = max(0.0, float(now) - recv_t) if recv_t else 0.0
+            traced = self.tracer is not None and self.tracer.enabled_for(
+                self.tenant
+            )
             for mb in out_batches:
+                if traced:
+                    # mint at the edge; the context rides the batch through
+                    # every stage (and over the netbus wire, pickled)
+                    dev = (
+                        str(mb.device_tokens[0])
+                        if mb.device_tokens is not None and mb.n
+                        else ""
+                    )
+                    mb.trace_ctx = self.tracer.mint(
+                        self.tenant, device=dev, source_topic=src_topic
+                    )
+                # span recorded BEFORE the publish so the downstream
+                # stage's span parents under this one deterministically
+                self.stage_timer.observe(
+                    mb, float(now), t_done, n_events=mb.n,
+                    queue_wait_ms=queue_wait,
+                )
                 mb.mark("decoded")
                 await self.retry.publish(decoded_topic, mb)
                 decoded_ctr.inc(mb.n)
@@ -391,6 +436,14 @@ class EventSource(LifecycleComponent):
                 measurements.append(req)
             else:
                 req["_source"] = self.source_id
+                if "_trace" not in req and self.tracer is not None:
+                    ctx = self.tracer.mint(
+                        self.tenant,
+                        device=str(req.get("device_token", "")),
+                        source_topic=self.source_id,
+                    )
+                    if ctx is not None:  # None = tracing disabled: no key
+                        req["_trace"] = ctx
                 await self.retry.publish(decoded_topic, req)
                 decoded_ctr.inc()
 

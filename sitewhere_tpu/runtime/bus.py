@@ -637,6 +637,22 @@ class EventBus:
     def seek(self, topic: str, group: str, offset: int) -> None:
         self.topic(topic).seek(group, offset)
 
+    def lags(self) -> Dict[str, Dict[str, Any]]:
+        """Per-topic queue depth + per-group consumer lag — the scrape
+        source for the ``bus_topic_depth`` / ``bus_consumer_lag`` gauges
+        (reference parity: Kafka consumer-lag metrics, SURVEY.md §5)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, t in self._topics.items():
+            if isinstance(t, PartitionedTopic):
+                depth = sum(p._live_len() for p in t.parts)
+            else:
+                depth = t._live_len()
+            out[name] = {
+                "depth": depth,
+                "groups": {g: t.lag(g) for g in t.group_offsets},
+            }
+        return out
+
     def snapshot_offsets(self) -> Dict[str, Dict[str, int]]:
         """Offsets for persistence → crash-resume (SURVEY.md §5 checkpoint)."""
         return {
@@ -861,6 +877,7 @@ class RetryingConsumer:
         policy: Optional[FaultTolerancePolicy] = None,
         metrics: Optional[MetricsRegistry] = None,
         rng: Optional[random.Random] = None,
+        tracer=None,
     ) -> None:
         self.bus = bus
         self.tenant = tenant
@@ -869,6 +886,9 @@ class RetryingConsumer:
         self.policy = policy or FaultTolerancePolicy()
         self.metrics = metrics or MetricsRegistry()
         self.rng = rng or random.Random()
+        # tracing hook (runtime.tracing.Tracer | None): retries and
+        # dead-letters force-retain the touched trace (tail sampling)
+        self.tracer = tracer
         self.dlq_topic = bus.naming.dead_letter(tenant, stage)
 
     # -- internals --------------------------------------------------------
@@ -909,6 +929,10 @@ class RetryingConsumer:
                 last = exc
                 self.metrics.counter("retry.attempts").inc()
                 self.metrics.counter(f"retry.attempts.{self.stage}").inc()
+                if self.tracer is not None and attempt == 1:
+                    # a retried item's trace is tail-retained even if the
+                    # retry eventually succeeds (that's the p99 story)
+                    self.tracer.mark_hit(item, "retry")
                 if attempt < self._max_attempts:
                     await asyncio.sleep(self._backoff(attempt))
         await self.dead_letter(item, source_topic, self._max_attempts, last)
@@ -930,6 +954,22 @@ class RetryingConsumer:
             "ts": int(time.time() * 1000),
             "payload": item,
         }
+        # DLQ ↔ trace cross-reference: stamp the trace id so `deadletter`
+        # inspection links back to the full trace, and force-retain the
+        # trace (tail sampling keeps every DLQ-touched trace). A breaker
+        # park records its own reason so SLO reports can tell them apart.
+        from sitewhere_tpu.core.trace import trace_ctx_of
+
+        ctx = trace_ctx_of(item)
+        if ctx is not None:
+            entry["trace_id"] = ctx.trace_id
+            if self.tracer is not None:
+                reason = (
+                    "breaker"
+                    if error is not None and "breaker" in str(error)
+                    else "dlq"
+                )
+                self.tracer.mark_hit(ctx, reason)
         # non-blocking on purpose: the DLQ is the lossless fallback and
         # must never be backpressured (or fault-injected) shut; it is
         # bounded by topic retention like any other topic. It must also

@@ -71,6 +71,24 @@ class FaultTolerancePolicy:
 
 
 @dataclass(frozen=True)
+class TracingConfig:
+    """End-to-end event tracing knobs (runtime.tracing / docs/OBSERVABILITY.md).
+
+    Tail-based sampling: with tracing enabled, EVERY event's spans are
+    recorded while its trace is in flight; the keep/drop decision runs at
+    the tail (terminal stage). Traces that breached ``slo_ms``, errored,
+    or hit retry/DLQ/breaker machinery are always kept; clean traces keep
+    with probability ``sample_rate``. ``enabled = False`` is the hot-path
+    guard: no context is minted at ingest, so no stage allocates spans.
+    """
+
+    enabled: bool = True
+    sample_rate: float = 0.05   # clean-trace keep probability (tail)
+    slo_ms: float = 250.0       # end-to-end latency SLO; breaches retained
+    max_traces: int = 512       # retained-ring floor contributed by this tenant
+
+
+@dataclass(frozen=True)
 class TrainingConfig:
     """Live on-device training cadence (rebuild-only: per-tenant models
     diverge by training on their RESIDENT window state — zero bytes move
@@ -92,6 +110,7 @@ class TenantEngineConfig:
     fault_tolerance: FaultTolerancePolicy = field(
         default_factory=FaultTolerancePolicy
     )
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     max_streams: int = 4096         # window-state capacity (series slots)
     decoder: str = "json"
     # host↔device wire dtype for scoring values/scores ("f32" | "bf16" |
@@ -241,6 +260,7 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
     mb = d.pop("microbatch", None) or {}
     tr = d.pop("training", None) or {}
     ft = d.pop("fault_tolerance", None) or {}
+    tc = d.pop("tracing", None) or {}
     if "buckets" in mb:
         mb["buckets"] = tuple(mb["buckets"])
     # drop unknown keys at EVERY level: a manifest written by a newer build
@@ -248,6 +268,7 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
     mb_known = MicroBatchConfig.__dataclass_fields__
     tr_known = TrainingConfig.__dataclass_fields__
     ft_known = FaultTolerancePolicy.__dataclass_fields__
+    tc_known = TracingConfig.__dataclass_fields__
     known = TenantEngineConfig.__dataclass_fields__
     return TenantEngineConfig(
         microbatch=MicroBatchConfig(
@@ -259,11 +280,15 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
         fault_tolerance=FaultTolerancePolicy(
             **{k: v for k, v in ft.items() if k in ft_known}
         ),
+        tracing=TracingConfig(
+            **{k: v for k, v in tc.items() if k in tc_known}
+        ),
         **{
             k: v
             for k, v in d.items()
             if k in known
-            and k not in ("microbatch", "training", "fault_tolerance")
+            and k not in ("microbatch", "training", "fault_tolerance",
+                          "tracing")
         },
     )
 

@@ -5,6 +5,17 @@ registries: consumer lag, event counts — SURVEY.md §5 [U]; reference mount
 empty, see provenance banner). The north-star metrics (events/sec scored,
 p99 inference latency, tenants/chip — BASELINE.json:2) are first-class here;
 a Prometheus-format scrape endpoint is exposed by ``api.rest``.
+
+Two metric styles share one registry:
+
+- **legacy unlabeled**: ``registry.counter("event_sources.decoded")`` —
+  dotted names, exposed under their sanitized name unchanged (existing
+  dashboards/tests keep working);
+- **labeled families**: ``registry.counter("pipeline_stage_events",
+  tenant="t1", stage="inbound")`` — proper Prometheus labels. Labeled
+  counters are exposed with the ``_total`` suffix, label values are
+  escaped, and every family gets ``# HELP``/``# TYPE`` lines
+  (``tools/check_metrics.py`` lints the exposition).
 """
 
 from __future__ import annotations
@@ -24,12 +35,19 @@ BREAKER_STATE_VALUES: Dict[str, float] = {
     "half_open": 2.0,
 }
 
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
 
 class Counter:
-    __slots__ = ("name", "_v", "_lock")
+    __slots__ = ("name", "labels", "_v", "_lock")
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None) -> None:
         self.name = name
+        self.labels = dict(labels) if labels else None
         self._v = 0.0
         self._lock = threading.Lock()
 
@@ -43,14 +61,23 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "labels", "value", "_lock")
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None) -> None:
         self.name = name
+        self.labels = dict(labels) if labels else None
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        # synchronized: a read-modify-write user (inc) racing set() from a
+        # scrape/collector thread must not lose updates
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
 
 
 def _latency_edges() -> List[float]:
@@ -83,13 +110,22 @@ class Histogram:
     bucket instead of returning its upper edge, so p50/p99 don't
     quantize to a fixed grid (round-4 verdict: edge-reporting repeated
     bit-identical p99s across configs at ±12% error).
+
+    Reads (``quantile``/``summary``) copy the bucket state UNDER the
+    lock: a scrape racing ``record`` from another thread must never see
+    torn counts (a count bumped but ``_n`` not yet, which could push an
+    interpolated quantile past ``_max``).
     """
 
     EDGES = _latency_edges()
 
-    def __init__(self, name: str, unit: str = "s") -> None:
+    def __init__(
+        self, name: str, unit: str = "s",
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
         self.name = name
         self.unit = unit
+        self.labels = dict(labels) if labels else None
         self._counts = [0] * (len(self.EDGES) + 1)
         self._sum = 0.0
         self._n = 0
@@ -121,38 +157,54 @@ class Histogram:
             self._n = 0
             self._max = 0.0
 
+    def _state(self) -> Tuple[List[int], float, int, float]:
+        """Consistent copy of (counts, sum, n, max) for lock-free math."""
+        with self._lock:
+            return list(self._counts), self._sum, self._n, self._max
+
     @property
     def count(self) -> int:
         return self._n
 
     @property
     def mean(self) -> float:
-        return self._sum / self._n if self._n else 0.0
+        with self._lock:
+            return self._sum / self._n if self._n else 0.0
 
-    def quantile(self, q: float) -> float:
-        if not self._n:
+    @staticmethod
+    def _quantile_from(
+        counts: List[int], n: int, mx: float, q: float
+    ) -> float:
+        if not n:
             return 0.0
-        target = q * self._n
+        target = q * n
         acc = 0
-        for i, c in enumerate(self._counts):
+        for i, c in enumerate(counts):
             if acc + c >= target and c:
-                lo = self.EDGES[i - 1] if i > 0 else 0.0
-                hi = self.EDGES[i] if i < len(self.EDGES) else self._max
-                hi = min(hi, self._max) if self._max else hi
+                lo = Histogram.EDGES[i - 1] if i > 0 else 0.0
+                hi = Histogram.EDGES[i] if i < len(Histogram.EDGES) else mx
+                hi = min(hi, mx) if mx else hi
                 # linear interpolation within the crossing bucket
                 frac = (target - acc) / c
-                return min(lo + frac * max(hi - lo, 0.0), self._max or hi)
+                return min(lo + frac * max(hi - lo, 0.0), mx or hi)
             acc += c
-        return self._max
+        return mx
+
+    def quantile(self, q: float) -> float:
+        counts, _s, n, mx = self._state()
+        return self._quantile_from(counts, n, mx, q)
 
     def summary(self) -> Dict[str, float]:
+        # ONE consistent cut for all derived values — three separate
+        # quantile() calls could straddle concurrent records
+        counts, s, n, mx = self._state()
         return {
-            "count": float(self._n),
-            "mean": self.mean,
-            "p50": self.quantile(0.50),
-            "p95": self.quantile(0.95),
-            "p99": self.quantile(0.99),
-            "max": self._max,
+            "count": float(n),
+            "mean": (s / n) if n else 0.0,
+            "p50": self._quantile_from(counts, n, mx, 0.50),
+            "p95": self._quantile_from(counts, n, mx, 0.95),
+            "p99": self._quantile_from(counts, n, mx, 0.99),
+            "max": mx,
         }
 
 
@@ -162,12 +214,16 @@ class MeterRate:
     def __init__(self, name: str, window_s: float = 10.0) -> None:
         self.name = name
         self.window_s = window_s
+        self.labels: Optional[Dict[str, str]] = None
         self._events: List[Tuple[float, float]] = []  # (ts, n)
+        self._first_mark: Optional[float] = None
         self._lock = threading.Lock()
 
     def mark(self, n: float = 1.0) -> None:
         now = time.time()
         with self._lock:
+            if self._first_mark is None:
+                self._first_mark = now
             self._events.append((now, n))
             cutoff = now - self.window_s
             i = bisect.bisect_left(self._events, (cutoff, -1.0))
@@ -179,7 +235,15 @@ class MeterRate:
         with self._lock:
             cutoff = now - self.window_s
             total = sum(n for ts, n in self._events if ts >= cutoff)
-        return total / self.window_s
+            first = self._first_mark
+        if first is None:
+            return 0.0
+        # right after startup the window hasn't filled: dividing by the
+        # full window under-reports (1000 events in the first second of a
+        # 10 s window is 1000/s, not 100/s). Floor the elapsed divisor so
+        # a rate() immediately after the first mark stays finite.
+        elapsed = min(self.window_s, max(now - first, 1e-3))
+        return total / elapsed
 
 
 class MetricsRegistry:
@@ -190,53 +254,203 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}
         self._histos: Dict[str, Histogram] = {}
         self._meters: Dict[str, MeterRate] = {}
+        # labeled families: name → {sorted-label-tuple → metric}
+        self._labeled: Dict[str, Dict[LabelKey, object]] = {}
+        self._kinds: Dict[str, str] = {}  # labeled family → prometheus kind
+        self._help: Dict[str, str] = {}
+        self._reg_lock = threading.Lock()
 
-    def counter(self, name: str) -> Counter:
-        return self._counters.setdefault(name, Counter(name))
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach a ``# HELP`` string to a metric family."""
+        self._help[name] = help_text
 
-    def gauge(self, name: str) -> Gauge:
-        return self._gauges.setdefault(name, Gauge(name))
+    def _labeled_child(self, name: str, labels: Dict[str, str], kind: str,
+                       factory) -> object:
+        fam = self._labeled.get(name)
+        if fam is None:
+            with self._reg_lock:
+                fam = self._labeled.setdefault(name, {})
+                self._kinds[name] = kind
+        key = _label_key(labels)
+        m = fam.get(key)
+        if m is None:
+            with self._reg_lock:
+                m = fam.get(key)
+                if m is None:
+                    m = fam[key] = factory()
+        return m
 
-    def histogram(self, name: str, unit: str = "s") -> Histogram:
-        return self._histos.setdefault(name, Histogram(name, unit))
+    def counter(self, name: str, **labels: str) -> Counter:
+        if labels:
+            return self._labeled_child(
+                name, labels, "counter", lambda: Counter(name, labels)
+            )
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        if labels:
+            return self._labeled_child(
+                name, labels, "gauge", lambda: Gauge(name, labels)
+            )
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str, unit: str = "s", **labels: str) -> Histogram:
+        if labels:
+            return self._labeled_child(
+                name, labels, "summary",
+                lambda: Histogram(name, unit, labels),
+            )
+        h = self._histos.get(name)
+        if h is None:
+            h = self._histos.setdefault(name, Histogram(name, unit))
+        return h
+
+    def drop_labeled(self, **labels: str) -> int:
+        """Remove every labeled child whose labels include ALL the given
+        pairs (tenant teardown: a removed tenant's children must not be
+        exported forever — label cardinality is bounded by LIVE tenants).
+        Returns the number of children removed."""
+        want = {k: str(v) for k, v in labels.items()}
+        removed = 0
+        with self._reg_lock:
+            for _name, fam in list(self._labeled.items()):
+                for key in [
+                    k for k in fam
+                    if all(dict(k).get(n) == v for n, v in want.items())
+                ]:
+                    fam.pop(key, None)
+                    removed += 1
+        return removed
 
     def meter(self, name: str, window_s: float = 10.0) -> MeterRate:
-        return self._meters.setdefault(name, MeterRate(name, window_s))
+        m = self._meters.get(name)
+        if m is None:
+            m = self._meters.setdefault(name, MeterRate(name, window_s))
+        return m
 
     def snapshot(self) -> Dict[str, object]:
         out: Dict[str, object] = {}
-        for n, c in self._counters.items():
+        for n, c in list(self._counters.items()):
             out[n] = c.value
-        for n, g in self._gauges.items():
+        for n, g in list(self._gauges.items()):
             out[n] = g.value
-        for n, h in self._histos.items():
+        for n, h in list(self._histos.items()):
             out[n] = h.summary()
-        for n, m in self._meters.items():
+        for n, m in list(self._meters.items()):
             out[n] = m.rate()
+        for name, fam in list(self._labeled.items()):
+            for _key, metric in list(fam.items()):
+                k = f"{name}{{{_labels_text(metric.labels)}}}"
+                if isinstance(metric, Histogram):
+                    out[k] = metric.summary()
+                else:
+                    out[k] = metric.value
         return out
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition format for the scrape endpoint."""
+        """Prometheus exposition format for the scrape endpoint.
+
+        Legacy unlabeled metrics keep their historical names (aliases for
+        existing dashboards); labeled families follow the conventions —
+        ``_total``-suffixed counters, escaped label values, one
+        ``# HELP``/``# TYPE`` pair per family.
+        """
         lines: List[str] = []
-        for n, c in self._counters.items():
-            lines.append(f"# TYPE {_sanitize(n)} counter")
-            lines.append(f"{_sanitize(n)} {c.value}")
-        for n, g in self._gauges.items():
-            lines.append(f"# TYPE {_sanitize(n)} gauge")
-            lines.append(f"{_sanitize(n)} {g.value}")
-        for n, h in self._histos.items():
+        headed: set = set()
+
+        def head(base: str, kind: str, src_name: str) -> None:
+            if base in headed:
+                return
+            headed.add(base)
+            help_text = self._help.get(src_name, f"{src_name} ({kind})")
+            lines.append(f"# HELP {base} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {base} {kind}")
+
+        # -- legacy unlabeled (names unchanged — alias surface) ----------
+        for n, c in list(self._counters.items()):
             base = _sanitize(n)
+            head(base, "counter", n)
+            lines.append(f"{base} {c.value}")
+        for n, g in list(self._gauges.items()):
+            base = _sanitize(n)
+            head(base, "gauge", n)
+            lines.append(f"{base} {g.value}")
+        for n, h in list(self._histos.items()):
+            base = _sanitize(n)
+            head(base, "summary", n)
             s = h.summary()
-            lines.append(f"# TYPE {base} summary")
             for q, label in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
                 lines.append(f'{base}{{quantile="{label}"}} {s[q]}')
-            lines.append(f"{base}_sum {h.mean * h.count}")
-            lines.append(f"{base}_count {h.count}")
-        for n, m in self._meters.items():
-            lines.append(f"# TYPE {_sanitize(n)}_rate gauge")
-            lines.append(f"{_sanitize(n)}_rate {m.rate()}")
+            lines.append(f"{base}_sum {s['mean'] * s['count']}")
+            lines.append(f"{base}_count {int(s['count'])}")
+        for n, m in list(self._meters.items()):
+            base = f"{_sanitize(n)}_rate"
+            head(base, "gauge", n)
+            lines.append(f"{base} {m.rate()}")
+
+        # -- labeled families (new-style, conformant) --------------------
+        # list() copies: a scrape must not race a first-time metric
+        # creation on another thread into a dict-changed-size error
+        for name, fam in list(self._labeled.items()):
+            kind = self._kinds.get(name, "gauge")
+            base = _sanitize(name)
+            if kind == "counter" and not base.endswith("_total"):
+                base += "_total"
+            head(base, kind, name)
+            for _key, metric in list(fam.items()):
+                lbl = _labels_text(metric.labels)
+                if isinstance(metric, Histogram):
+                    s = metric.summary()
+                    for q, ql in (("p50", "0.5"), ("p95", "0.95"),
+                                  ("p99", "0.99")):
+                        lines.append(
+                            f'{base}{{{lbl},quantile="{ql}"}} {s[q]}'
+                        )
+                    lines.append(f"{base}_sum{{{lbl}}} {s['mean'] * s['count']}")
+                    lines.append(f"{base}_count{{{lbl}}} {int(s['count'])}")
+                else:
+                    lines.append(f"{base}{{{lbl}}} {metric.value}")
         return "\n".join(lines) + "\n"
 
 
+_ILLEGAL_CHARS = None
+
+
 def _sanitize(name: str) -> str:
-    return name.replace(".", "_").replace("-", "_").replace("/", "_")
+    """Map any string to a legal Prometheus metric name: every character
+    outside [a-zA-Z0-9_:] becomes '_' (breaker names carry '[tenant]'
+    brackets, stage names carry '.' and '-')."""
+    global _ILLEGAL_CHARS
+    if _ILLEGAL_CHARS is None:
+        import re
+
+        _ILLEGAL_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+    out = _ILLEGAL_CHARS.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _escape_label_value(v: str) -> str:
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _labels_text(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    return ",".join(
+        f'{_sanitize(k)}="{_escape_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )

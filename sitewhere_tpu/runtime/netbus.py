@@ -209,6 +209,8 @@ class BusBrokerServer(LifecycleComponent):
             return bus.restore_state(*args)
         if op == "peek":
             return bus.peek(*args)
+        if op == "lags":
+            return bus.lags()
         if op == "inject_faults":
             drop_p, dup_p, delay_s, topic, *rest = args
             fail_p = rest[0] if rest else 0.0
@@ -435,6 +437,14 @@ class RemoteEventBus:
 
     async def peek(self, topic: str, max_items: int = 100) -> dict:
         return await self._call("peek", topic, max_items)
+
+    async def lags(self) -> Dict[str, dict]:
+        """Per-topic depth + consumer lag from the broker (the remote
+        half of the ``bus_consumer_lag`` gauge collection). Payload trace
+        contexts (``core.trace.TraceContext``) cross this wire inside
+        their payload frames — the restricted unpickler admits core
+        classes, so traces survive a netbus hop with no extra protocol."""
+        return await self._call("lags")
 
     def inject_faults(self, topic: str, plan: FaultPlan) -> None:
         # the plan's rng doesn't pickle usefully; send the knobs

@@ -1,0 +1,383 @@
+"""End-to-end tracing + labeled metrics (PR 2, ISSUE 2 acceptance).
+
+Covers: (a) ≥100 events driven through a running instance produce a
+trace whose spans cover all five pipeline stages plus inference with
+monotonic timestamps and queue-wait/service splits; (b) /metrics exposes
+per-tenant per-stage latency histograms with conformant Prometheus
+labels (tools/check_metrics.py lint runs against the live scrape);
+(c) tail-based sampling: at sample_rate 0.0 a DLQ-hit trace is still
+retained (with its trace_id stamped into the DLQ entry) while a clean
+trace is dropped; and with tracing disabled the hot path carries no
+trace contexts at all (guarded, not stripped)."""
+
+import asyncio
+import importlib.util
+import json
+from contextlib import asynccontextmanager
+from pathlib import Path
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from sitewhere_tpu.api.rest import make_app
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.runtime.config import (
+    InstanceConfig,
+    MeshConfig,
+    TracingConfig,
+    tenant_config_from_template,
+)
+
+_spec = importlib.util.spec_from_file_location(
+    "check_metrics",
+    Path(__file__).resolve().parent.parent / "tools" / "check_metrics.py",
+)
+check_metrics = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_metrics)
+
+STAGES = ("decode", "inbound", "inference", "persistence", "rules", "outbound")
+
+
+@asynccontextmanager
+async def traced_instance(tenant: str, tracing: TracingConfig):
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="obs",
+        mesh=MeshConfig(tenant_axis=4, data_axis=2, slots_per_shard=2),
+    ))
+    await inst.start()
+    try:
+        await inst.add_tenant(tenant_config_from_template(
+            tenant, "iot-temperature", tracing=tracing,
+        ))
+        rt = inst.tenants[tenant]
+        rt.device_management.bootstrap_fleet(5)
+        yield inst, rt
+    finally:
+        await inst.terminate()
+
+
+async def ingest(inst, tenant: str, n: int, base: float = 20.0) -> None:
+    for i in range(n):
+        await inst.broker.publish(
+            f"sitewhere/{tenant}/input/dev-0000{i % 5}",
+            json.dumps({
+                "type": "measurement",
+                "device_token": f"dev-0000{i % 5}",
+                "name": "temperature",
+                "value": base + (i % 7),
+            }).encode(),
+        )
+
+
+async def wait_persisted(rt, n: int, timeout_s: float = 20.0) -> None:
+    for _ in range(int(timeout_s / 0.05)):
+        if len(rt.event_store) >= n:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"only {len(rt.event_store)}/{n} events persisted in {timeout_s}s"
+    )
+
+
+@asynccontextmanager
+async def rest_client(inst):
+    client = TestClient(TestServer(make_app(inst)))
+    await client.start_server()
+    try:
+        inst.users.create_user("admin", "password", ["ROLE_ADMIN"])
+        resp = await client.post(
+            "/api/authapi/jwt",
+            json={"username": "admin", "password": "password"},
+        )
+        token = (await resp.json())["token"]
+        client._session.headers["Authorization"] = f"Bearer {token}"
+        yield client
+    finally:
+        await client.close()
+
+
+async def test_trace_end_to_end_and_labeled_metrics():
+    """Acceptance (a)+(b): ≥100 events → one complete trace over all five
+    stages + inference; /metrics carries conformant per-tenant per-stage
+    histograms (check_metrics lint on the live scrape)."""
+    cfg = TracingConfig(enabled=True, sample_rate=1.0, slo_ms=60_000)
+    async with traced_instance("t1", cfg) as (inst, rt):
+        await ingest(inst, "t1", 120)
+        await wait_persisted(rt, 120)
+        await asyncio.sleep(0.3)  # let outbound/rules spans land
+        async with rest_client(inst) as client:
+            resp = await client.get(
+                "/api/traces?tenant=t1&flush=1", headers={
+                    "X-SiteWhere-Tenant": "t1",
+                },
+            )
+            body = await resp.json()
+            assert resp.status == 200
+            assert body["results"], "no traces retained at sample_rate=1.0"
+            # find a trace that covers the whole pipeline
+            full = [
+                t for t in body["results"]
+                if set(STAGES) <= set(t["stages"])
+            ]
+            assert full, f"no full-pipeline trace in {body['results']}"
+            summary = full[0]
+            assert summary["tenant"] == "t1"  # baggage
+            resp = await client.get(f"/api/traces/{summary['trace_id']}")
+            trace = await resp.json()
+            assert resp.status == 200
+            spans = {s["stage"]: s for s in trace["spans"]}
+            assert set(STAGES) <= set(spans)
+            # monotonic: each stage starts no earlier than the previous
+            # stage's start, and every span has a queue-wait/service split
+            order = [spans[st]["start_ms"] for st in STAGES]
+            assert order == sorted(order), f"non-monotonic stages: {order}"
+            for st in STAGES:
+                s = spans[st]
+                assert s["end_ms"] >= s["start_ms"]
+                assert s["queue_wait_ms"] >= 0.0
+                assert s["service_ms"] >= 0.0
+                assert s["tenant"] == "t1"
+            assert spans["decode"]["n_events"] >= 1
+            # deterministic hierarchy: rules and outbound both consume
+            # persisted-events (a fork) — they must record as SIBLINGS
+            # under the persistence span, regardless of scheduling order
+            assert spans["rules"]["parent_id"] == spans["persistence"]["span_id"]
+            assert spans["outbound"]["parent_id"] == spans["persistence"]["span_id"]
+            # Chrome trace-event export rides the same endpoint
+            assert trace["traceEvents"]
+            assert all(ev["ph"] == "X" for ev in trace["traceEvents"])
+            # (b) labeled per-tenant per-stage histograms on /metrics,
+            # and the whole scrape passes the exposition lint
+            resp = await client.get("/metrics")
+            text = await resp.text()
+            for st in STAGES:
+                assert (
+                    f'pipeline_stage_seconds{{stage="{st}",tenant="t1",'
+                    f'quantile="0.99"}}'
+                ) in text, f"missing labeled histogram for stage {st}"
+            assert 'pipeline_stage_events_total{' in text
+            assert "bus_consumer_lag{" in text and "bus_topic_depth{" in text
+            errors = check_metrics.lint_exposition(text)
+            assert not errors, f"exposition lint findings: {errors}"
+            # per-tenant SLO report
+            resp = await client.get("/api/tenants/t1/slo")
+            slo = await resp.json()
+            assert resp.status == 200
+            assert slo["slo_ms"] == 60_000
+            assert set(STAGES) <= set(slo["stages"])
+            assert slo["traces_retained"] >= 1
+
+
+async def test_tail_sampling_retains_dlq_drops_clean():
+    """Acceptance (c) part 1: sample_rate=0.0 — a clean trace is dropped
+    at the tail while a DLQ-hit trace is force-retained, and the DLQ
+    entry carries the trace_id linking back to the full trace."""
+    cfg = TracingConfig(enabled=True, sample_rate=0.0, slo_ms=60_000)
+    async with traced_instance("t2", cfg) as (inst, rt):
+        # phase 1: clean traffic → every trace decides to drop
+        await ingest(inst, "t2", 30)
+        await wait_persisted(rt, 30)
+        await asyncio.sleep(0.3)
+        inst.tracer.gc(force=True)
+        assert inst.tracer.store.list(tenant="t2", limit=10) == [], (
+            "clean traces must be dropped at sample_rate=0.0"
+        )
+        dropped = inst.metrics.counter("traces_dropped", tenant="t2").value
+        assert dropped >= 1
+        # phase 2: make persistence fail → retry budget exhausts → DLQ
+        def boom(_batch):
+            raise RuntimeError("store down (injected)")
+
+        rt.persistence.store.add_measurement_batch = boom
+        rt.persistence.store.add_event = boom
+        await ingest(inst, "t2", 10, base=90.0)
+        dlq_topic = inst.bus.naming.dead_letter("t2", "persistence")
+        entries = []
+        for _ in range(300):
+            entries = inst.bus.peek(dlq_topic, 10)["entries"]
+            if entries:
+                break
+            await asyncio.sleep(0.05)
+        assert entries, "injected persistence failure never dead-lettered"
+        _off, entry = entries[-1]
+        assert entry["trace_id"], "DLQ entry missing trace_id stamp"
+        inst.tracer.gc(force=True)
+        tr = inst.tracer.store.peek(entry["trace_id"])
+        assert tr is not None, "DLQ-hit trace was not tail-retained"
+        assert tr.decision, "trace still undecided after forced gc"
+        assert "dlq" in tr.forced
+        # and the REST DLQ inspection surfaces the trace_id
+        async with rest_client(inst) as client:
+            resp = await client.get(
+                "/api/tenants/t2/deadletter",
+                headers={"X-SiteWhere-Tenant": "t2"},
+            )
+            body = await resp.json()
+            listed = body["stages"]["persistence"]["entries"]
+            assert any(e.get("trace_id") == entry["trace_id"] for e in listed)
+
+
+async def test_tracing_disabled_hot_path_carries_no_contexts():
+    """Acceptance (c) part 2: tracing disabled in TenantEngineConfig —
+    payloads carry no TraceContext anywhere (guarded mint, not stripped
+    code), receivers skip receive-stamping, and the store stays empty."""
+    cfg = TracingConfig(enabled=False, sample_rate=1.0)
+    async with traced_instance("t3", cfg) as (inst, rt):
+        assert rt.source.receiver.stamp_recv_ts is False
+        await ingest(inst, "t3", 40)
+        await wait_persisted(rt, 40)
+        await asyncio.sleep(0.2)
+        # the persisted stream's batches carry no context
+        view = inst.bus.peek(
+            inst.bus.naming.persisted_events("t3"), 50
+        )
+        assert view["entries"], "no persisted batches to inspect"
+        for _off, item in view["entries"]:
+            assert getattr(item, "trace_ctx", None) is None
+        inst.tracer.gc(force=True)
+        assert inst.tracer.store.list(tenant="t3", limit=5) == []
+        assert inst.tracer.store.active_count() == 0
+        # labeled stage metrics still flow (metrics ≠ tracing)
+        text = inst.metrics.prometheus_text()
+        assert 'pipeline_stage_seconds{stage="persistence",tenant="t3"' in text
+
+
+def test_check_metrics_lint_catches_malformations():
+    """The exposition lint fails on the malformations it exists for."""
+    lint = check_metrics.lint_exposition
+    ok = (
+        "# HELP x_total events\n# TYPE x_total counter\n"
+        'x_total{tenant="a b",q="c\\"d"} 5.0\n'
+    )
+    assert lint(ok) == []
+    # sample without TYPE
+    assert lint("orphan 1.0\n")
+    # labeled counter without _total
+    bad = (
+        "# HELP x events\n# TYPE x counter\n"
+        'x{tenant="a"} 5.0\n'
+    )
+    assert any("_total" in e for e in lint(bad))
+    # raw newline / unterminated label value
+    assert lint('# HELP y v\n# TYPE y gauge\ny{l="a} 1.0\n')
+    # bad value
+    assert lint("# HELP z v\n# TYPE z gauge\nz nope\n")
+    # duplicate TYPE
+    assert any(
+        "duplicate" in e
+        for e in lint(
+            "# HELP w v\n# TYPE w gauge\n# TYPE w gauge\nw 1.0\n"
+        )
+    )
+    # illegal metric name never leaves _sanitize
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge("breaker.outbound[t].log[t].state").set(1)
+    reg.counter("weird/name-with.stuff", tenant="x{}\"").inc()
+    assert lint(reg.prometheus_text()) == []
+
+
+def test_meter_rate_startup_window():
+    """MeterRate must divide by the filled portion of the window right
+    after startup, not the full window (satellite fix)."""
+    import time as _t
+
+    from sitewhere_tpu.runtime.metrics import MeterRate
+
+    m = MeterRate("r", window_s=10.0)
+    m.mark(100)
+    _t.sleep(0.5)
+    r = m.rate()
+    # 100 events over ~0.5s ≈ 200/s; the old bug reported 100/10 = 10/s
+    assert 120.0 < r < 1000.0, f"startup rate under-reported: {r}"
+    # an idle meter reports 0, not a division error
+    assert MeterRate("empty").rate() == 0.0
+
+
+def test_histogram_scrape_thread_safety():
+    """A scrape (summary/quantile) racing record from another thread must
+    never see torn counts (satellite fix: copy under the lock)."""
+    import threading
+
+    from sitewhere_tpu.runtime.metrics import Histogram
+
+    h = Histogram("lat")
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            h.record(0.001 + (i % 100) * 1e-5)
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                s = h.summary()
+                # invariants of a consistent cut
+                assert 0.0 <= s["p50"] <= s["max"] + 1e-9
+                assert s["count"] >= 0
+                h.quantile(0.99)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=reader) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    import time as _t
+
+    _t.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, f"scrape raced record: {errors[0]!r}"
+
+
+def test_drop_labeled_bounds_cardinality():
+    """Removing a tenant must remove its labeled children — label
+    cardinality tracks live tenants, not historical churn."""
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("pipeline_stage_events", tenant="gone", stage="inbound").inc()
+    reg.histogram("pipeline_stage_seconds", tenant="gone", stage="rules").record(0.01)
+    reg.gauge("receiver_queue_depth", tenant="gone").set(3)
+    reg.counter("pipeline_stage_events", tenant="kept", stage="inbound").inc()
+    removed = reg.drop_labeled(tenant="gone")
+    assert removed == 3
+    text = reg.prometheus_text()
+    assert 'tenant="gone"' not in text
+    assert 'tenant="kept"' in text
+
+
+async def test_remove_tenant_drops_labeled_children():
+    cfg = TracingConfig(enabled=True, sample_rate=0.0)
+    async with traced_instance("churn", cfg) as (inst, rt):
+        await ingest(inst, "churn", 10)
+        await wait_persisted(rt, 10)
+        assert 'tenant="churn"' in inst.metrics.prometheus_text()
+        await inst.remove_tenant("churn")
+        inst.collect_bus_gauges()
+        assert 'tenant="churn"' not in inst.metrics.prometheus_text()
+
+
+def test_gauge_set_synchronized():
+    import threading
+
+    from sitewhere_tpu.runtime.metrics import Gauge
+
+    g = Gauge("g")
+
+    def bump():
+        for _ in range(10_000):
+            g.inc(1.0)
+
+    ts = [threading.Thread(target=bump) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert g.value == 40_000.0

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Prometheus exposition lint for the /metrics surface.
+
+Scrapes ``prometheus_text()`` from a booted instance (or reads a file /
+stdin) and fails on malformed exposition lines:
+
+- sample lines must parse: ``name{label="value",...} <float>`` with a
+  legal metric name, balanced/escaped label syntax, and a finite-or-
+  NaN/Inf float value;
+- every sample's family must be preceded by ``# HELP`` and ``# TYPE``
+  lines (one pair per family, HELP before TYPE);
+- new-style (labeled) counters must carry the ``_total`` suffix;
+- duplicate TYPE declarations and unknown metric types are errors.
+
+Used two ways: ``python tools/check_metrics.py`` boots a small instance,
+drives events through the pipeline, and lints the scrape (exit 1 on
+findings); the tier-1 suite imports ``lint_exposition`` and runs it
+against a live instance (tests/test_observability.py).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, List, Tuple
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+VALUE_RE = re.compile(r"^[+-]?(\d+\.?\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|Inf|NaN)$")
+KNOWN_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+# summary/histogram child-sample suffixes that belong to a base family
+CHILD_SUFFIXES = ("_sum", "_count", "_bucket")
+
+
+def _parse_labels(block: str) -> Tuple[Dict[str, str], str]:
+    """Parse the inside of a {...} label block. Returns (labels, error)."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(block)
+    while i < n:
+        m = re.match(r"[a-zA-Z_][a-zA-Z0-9_]*", block[i:])
+        if not m:
+            return labels, f"bad label name at ...{block[i:i+20]!r}"
+        name = m.group(0)
+        i += len(name)
+        if i >= n or block[i] != "=":
+            return labels, f"missing '=' after label {name!r}"
+        i += 1
+        if i >= n or block[i] != '"':
+            return labels, f"unquoted value for label {name!r}"
+        i += 1
+        val = []
+        while i < n and block[i] != '"':
+            if block[i] == "\\":
+                if i + 1 >= n or block[i + 1] not in ('\\', '"', "n"):
+                    return labels, f"bad escape in label {name!r}"
+                val.append(block[i:i + 2])
+                i += 2
+            elif block[i] == "\n":
+                return labels, f"raw newline in label {name!r}"
+            else:
+                val.append(block[i])
+                i += 1
+        if i >= n:
+            return labels, f"unterminated value for label {name!r}"
+        i += 1  # closing quote
+        labels[name] = "".join(val)
+        if i < n:
+            if block[i] != ",":
+                return labels, f"expected ',' after label {name!r}"
+            i += 1
+    return labels, ""
+
+
+def _family_of(name: str) -> str:
+    for suf in CHILD_SUFFIXES:
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def lint_exposition(text: str, require_labeled_total: bool = True) -> List[str]:
+    """Lint one exposition payload; returns a list of findings (empty =
+    conformant)."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: set = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                errors.append(f"line {lineno}: malformed HELP: {line!r}")
+                continue
+            helps.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                errors.append(f"line {lineno}: malformed TYPE: {line!r}")
+                continue
+            _, _, fam, kind = parts
+            if kind not in KNOWN_TYPES:
+                errors.append(
+                    f"line {lineno}: unknown metric type {kind!r} for {fam}"
+                )
+            if fam in types:
+                errors.append(f"line {lineno}: duplicate TYPE for {fam}")
+            types[fam] = kind
+            if fam not in helps:
+                errors.append(f"line {lineno}: TYPE before HELP for {fam}")
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        # sample line: name[{labels}] value [timestamp]
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)(\s+\S+)?$", line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, _, label_block, value = m.group(1), m.group(2), m.group(3), m.group(4)
+        if not VALUE_RE.match(value):
+            errors.append(f"line {lineno}: bad value {value!r} for {name}")
+        labels: Dict[str, str] = {}
+        if label_block is not None:
+            labels, err = _parse_labels(label_block)
+            if err:
+                errors.append(f"line {lineno}: {err} in {name}")
+        fam = _family_of(name)
+        kind = types.get(fam) or types.get(name)
+        if kind is None:
+            errors.append(f"line {lineno}: sample {name} has no TYPE")
+            continue
+        real_labels = {k: v for k, v in labels.items() if k != "quantile"}
+        if (
+            require_labeled_total
+            and kind == "counter"
+            and real_labels
+            and not name.endswith("_total")
+        ):
+            errors.append(
+                f"line {lineno}: labeled counter {name} lacks _total suffix"
+            )
+    return errors
+
+
+async def _scrape_live() -> str:
+    """Boot a small instance, push events through the full pipeline, and
+    return its Prometheus text (the zero-network self-check path)."""
+    import asyncio
+    import json
+
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        tenant_config_from_template,
+    )
+
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="metricslint",
+        mesh=MeshConfig(tenant_axis=1, data_axis=1, slots_per_shard=2),
+    ))
+    await inst.start()
+    try:
+        await inst.add_tenant(tenant_config_from_template(
+            "lint", "iot-temperature"
+        ))
+        rt = inst.tenants["lint"]
+        rt.device_management.bootstrap_fleet(3)
+        for i in range(30):
+            await inst.broker.publish(
+                f"sitewhere/lint/input/dev-0000{i % 3}",
+                json.dumps({
+                    "type": "measurement",
+                    "device_token": f"dev-0000{i % 3}",
+                    "name": "temperature",
+                    "value": 20.0 + i,
+                }).encode(),
+            )
+        for _ in range(200):
+            if len(rt.event_store) >= 30:
+                break
+            await asyncio.sleep(0.05)
+        inst.collect_bus_gauges()
+        return inst.metrics.prometheus_text()
+    finally:
+        await inst.terminate()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import asyncio
+    import os
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", nargs="?", default="",
+                    help="exposition file to lint ('-' = stdin); default: "
+                         "boot an instance and lint its live scrape")
+    args = ap.parse_args(argv)
+    if args.path == "-":
+        text = sys.stdin.read()
+    elif args.path:
+        with open(args.path) as fh:
+            text = fh.read()
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # runnable from anywhere: the repo root is tools/..
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        text = asyncio.run(_scrape_live())
+    errors = lint_exposition(text)
+    for e in errors:
+        print(f"check_metrics: {e}", file=sys.stderr)
+    n_samples = sum(
+        1 for l in text.splitlines() if l.strip() and not l.startswith("#")
+    )
+    print(f"check_metrics: {n_samples} samples, {len(errors)} finding(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
