@@ -192,6 +192,7 @@ class RestApi:
             "/api/tenants/{token}/deadletter/requeue", self.deadletter_requeue
         )
         r.add_get("/api/tenants/{token}/slo", self.tenant_slo)
+        r.add_get("/api/tenants/{token}/overload", self.tenant_overload)
 
         r.add_get("/api/traces", self.list_traces)
         r.add_get("/api/traces/{id}", self.get_trace)
@@ -402,6 +403,16 @@ class RestApi:
         if token not in self.instance.tenants:
             return web.json_response({"error": "unknown tenant"}, status=404)
         return web.json_response(self.instance.tenant_slo_report(token))
+
+    async def tenant_overload(self, request) -> web.Response:
+        """Per-tenant overload-control state: credit, degradation ladder
+        level + active features, fair-queue standing, per-stage
+        expired/late/shed accounting (docs/ROBUSTNESS.md)."""
+        token = request.match_info["token"]
+        rep = self.instance.tenant_overload_report(token)
+        if rep is None:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        return web.json_response(rep)
 
     async def topology(self, request) -> web.Response:
         return web.json_response(self.instance.topology())
@@ -944,6 +955,11 @@ class RestApi:
         stage = entry.get("stage", "")
         if payload is None:
             return 0
+        # requeue is a RE-admission: an entry that sat parked for minutes
+        # must not be expired-dropped the instant it re-enters
+        from sitewhere_tpu.runtime.overload import clear_deadline
+
+        clear_deadline(payload)
         if stage.startswith("outbound."):
             # targeted redelivery: replay into the ONE connector that
             # failed — republishing to persisted-events would fan the

@@ -79,6 +79,11 @@ class MeasurementBatch:
     # batch — the columnar unit of tracing (per-row spans would put a
     # Python loop back on the hot path)
     trace_ctx: Optional[object] = None
+    # admission deadline (absolute epoch ms | None), stamped at the
+    # ingest edge from the tenant's OverloadPolicy; stages consult the
+    # remaining budget before doing work (runtime.overload.DeadlineGate)
+    # — one deadline per batch, like the trace context
+    deadline_ms: Optional[float] = None
     # cached group indices: (uniq object[], inverse int32[]) for the token /
     # name columns. np.unique over object arrays is a string argsort — the
     # single biggest per-batch host cost when every stage re-derives it —
@@ -298,6 +303,7 @@ class MeasurementBatch:
             scores=cut(self.scores),
             trace=dict(self.trace),
             trace_ctx=self.trace_ctx,
+            deadline_ms=self.deadline_ms,
         )
 
     def to_events(self) -> List[DeviceMeasurement]:
@@ -403,6 +409,12 @@ class MeasurementBatch:
             trace_ctx=next(
                 (b.trace_ctx for b in bs if b.trace_ctx is not None), None
             ),
+            # the combined batch honors the TIGHTEST constituent deadline
+            # (late rows must not inherit a fresher batch's slack)
+            deadline_ms=min(
+                (b.deadline_ms for b in bs if b.deadline_ms is not None),
+                default=None,
+            ),
             **{c: _cat_opt(c, "", object) for c in MeasurementBatch.OBJ_COLS},
         )
 
@@ -438,6 +450,7 @@ class MeasurementBatch:
             scores=_pad_opt(self.scores, np.nan, np.float32),
             trace=dict(self.trace),
             trace_ctx=self.trace_ctx,
+            deadline_ms=self.deadline_ms,
             **{
                 c: _pad_opt(getattr(self, c), "", object)
                 for c in self.OBJ_COLS

@@ -75,6 +75,10 @@ class DeviceEvent:
     # in-proc / over the wire beside the per-stage ``trace`` marks so the
     # tracing layer can correlate this event into its full trace
     trace_ctx: Optional[Any] = field(default=None, repr=False)
+    # admission deadline (absolute epoch ms | None) from the tenant's
+    # OverloadPolicy — consulted by runtime.overload.DeadlineGate at
+    # each stage; non-measurement events never expire regardless
+    deadline_ms: Optional[float] = field(default=None, repr=False)
 
     EVENT_TYPE: EventType = field(default=EventType.MEASUREMENT, repr=False)
 

@@ -140,6 +140,13 @@ class SiteWhereInstance(LifecycleComponent):
         # pluggable bus backend: default in-proc; pass e.g. a connected
         # netbus.RemoteEventBus to run every service over a socket broker
         self.bus = bus or EventBus(TopicNaming(cfg.instance_id), cfg.bus_retention)
+        if bus is not None and isinstance(
+            getattr(bus, "metrics", None), MetricsRegistry
+        ):
+            # a remote bus client defaults to a private registry nothing
+            # scrapes — rebind it so its reconnect/clamp counters ride
+            # the instance /metrics endpoint
+            bus.metrics = self.metrics
         self.broker = SimBroker()  # in-proc MQTT; external broker swaps in
         self.mesh = mesh or MeshManager(
             tenant=cfg.mesh.tenant_axis if cfg.mesh.tenant_axis > 1 else 0,
@@ -155,12 +162,20 @@ class SiteWhereInstance(LifecycleComponent):
         # tenant; per-tenant knobs (enabled/sample_rate/slo_ms) register
         # from TenantEngineConfig.tracing at tenant build time
         self.tracer = Tracer(self.metrics)
+        # overload control: ONE controller shared by every stage of every
+        # tenant (admission deadlines, credit feedback from consumer lag,
+        # degradation ladder) — per-tenant knobs come from
+        # TenantEngineConfig.overload at tenant build time
+        from sitewhere_tpu.runtime.overload import OverloadController
+
+        self.overload = OverloadController(self.metrics, tracer=self.tracer)
         self.inference = TpuInferenceService(
             self.bus, self.mesh, self.metrics,
             slots_per_shard=cfg.mesh.slots_per_shard,
             max_inflight=cfg.inference_max_inflight,
             checkpoints=self.checkpoints,
             tracer=self.tracer,
+            overload=self.overload,
         )
         # profile hooks: annotate scoring dispatches inside the jax
         # profiler trace when the instance is capturing one
@@ -190,6 +205,7 @@ class SiteWhereInstance(LifecycleComponent):
             self.add_child(self.mqtt_broker)
         self._updates_task: Optional[asyncio.Task] = None
         self._autosave_task: Optional[asyncio.Task] = None
+        self._overload_task: Optional[asyncio.Task] = None
         self._shared_targets: Optional[list] = None  # see _on_shared_input
         self._profiling = False  # jax.profiler trace active (profile_dir)
         self._debug_nans_set = False  # we flipped the global NaN flag
@@ -340,13 +356,15 @@ class SiteWhereInstance(LifecycleComponent):
         dm = dm or DeviceManagement(tenant)
         store = store or EventStore(tenant)
         ft = cfg.fault_tolerance
-        # register the tenant's tracing policy BEFORE building stages (the
-        # event source checks it to decide receive-timestamping)
+        # register the tenant's tracing + overload policies BEFORE
+        # building stages (the event source reads both at build time)
         self.tracer.configure_tenant(tenant, cfg.tracing)
+        self.overload.configure_tenant(cfg)
         receiver = QueueReceiver(f"recv[{tenant}]")
         source = EventSource(
             f"mqtt[{tenant}]", tenant, self.bus, receiver, cfg.decoder,
             self.metrics, policy=ft, tracer=self.tracer,
+            overload=self.overload,
         )
 
         async def on_broker_msg(topic: str, payload: bytes) -> None:
@@ -358,7 +376,8 @@ class SiteWhereInstance(LifecycleComponent):
 
         rules = RuleEngine(tenant, self.bus, [
             anomaly_score_rule(f"{tenant}-anomaly", min_score=3.0, cooldown_ms=5000),
-        ], self.metrics, policy=ft, tracer=self.tracer)
+        ], self.metrics, policy=ft, tracer=self.tracer,
+            overload=self.overload)
         connectors = [
             LogConnector(f"log[{tenant}]"),
             MqttTopicConnector(
@@ -374,7 +393,7 @@ class SiteWhereInstance(LifecycleComponent):
             connectors.append(search)
         outbound = OutboundDispatcher(
             tenant, self.bus, connectors, self.metrics, policy=ft,
-            tracer=self.tracer,
+            tracer=self.tracer, overload=self.overload,
         )
         mqtt_source = None
         if cfg.mqtt_ingest:
@@ -419,6 +438,7 @@ class SiteWhereInstance(LifecycleComponent):
                     )),
                 ),
                 cfg.decoder, self.metrics, policy=ft, tracer=self.tracer,
+                overload=self.overload,
             )
         media = StreamingMedia(tenant)
         media_pipe = None
@@ -441,11 +461,11 @@ class SiteWhereInstance(LifecycleComponent):
             source=source,
             inbound=InboundProcessor(
                 tenant, self.bus, dm, self.metrics, policy=ft,
-                tracer=self.tracer,
+                tracer=self.tracer, overload=self.overload,
             ),
             persistence=EventPersistence(
                 tenant, self.bus, store, self.metrics, policy=ft,
-                tracer=self.tracer,
+                tracer=self.tracer, overload=self.overload,
             ),
             rules=rules,
             outbound=outbound,
@@ -481,6 +501,7 @@ class SiteWhereInstance(LifecycleComponent):
         rt = self.tenants.pop(tenant, None)
         self._shared_targets = None
         self.tracer.remove_tenant(tenant)
+        self.overload.remove_tenant(tenant)
         if rt is None:
             return
         # stop broker ingress FIRST: the closure would otherwise keep
@@ -580,6 +601,27 @@ class SiteWhereInstance(LifecycleComponent):
             self._autosave_task = asyncio.create_task(
                 self._autosave_loop(), name=f"{self.name}-autosave"
             )
+        # overload control tick: consumer lag → per-tenant credit +
+        # degradation ladder (the in-proc bus answers lags() synchronously;
+        # a RemoteEventBus deployment runs the same loop over the wire)
+        self._overload_task = asyncio.create_task(
+            self._overload_loop(), name=f"{self.name}-overload"
+        )
+
+    OVERLOAD_TICK_S = 0.1
+
+    async def _overload_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.OVERLOAD_TICK_S)
+            try:
+                if isinstance(self.bus, EventBus):
+                    lags = self.bus.lags()
+                else:
+                    lags = await self.bus.lags()
+                self.overload.refresh(lags)
+            except Exception as exc:  # noqa: BLE001 - a control-loop
+                # fault must not kill overload protection; next tick retries
+                self._record_error("overload-tick", exc)
 
     async def _autosave_loop(self) -> None:
         """Periodic live checkpoint: bounds the loss window of a HARD kill
@@ -602,6 +644,8 @@ class SiteWhereInstance(LifecycleComponent):
         self._updates_task = None
         await cancel_and_wait(self._autosave_task)
         self._autosave_task = None
+        await cancel_and_wait(self._overload_task)
+        self._overload_task = None
         await super().stop()
         # checkpoint-on-stop: a clean shutdown always leaves a current
         # snapshot (engines already saved their params in the cascade)
@@ -616,6 +660,8 @@ class SiteWhereInstance(LifecycleComponent):
         self._updates_task = None
         await cancel_and_wait(getattr(self, "_autosave_task", None))
         self._autosave_task = None
+        await cancel_and_wait(getattr(self, "_overload_task", None))
+        self._overload_task = None
         if self._profiling:
             import jax
 
@@ -738,14 +784,36 @@ class SiteWhereInstance(LifecycleComponent):
         m.describe(
             "receiver_queue_depth", "pending raw payloads per tenant receiver"
         )
+        m.describe(
+            "media_queue_depth", "pending frames per tenant media pipeline"
+        )
         if isinstance(self.bus, EventBus):
             # remote buses answer lags() over the wire — the async
             # /metrics handler awaits it and feeds apply_lag_gauges
             self.apply_lag_gauges(self.bus.lags())
+        m.describe(
+            "receiver_queue_class_depth",
+            "pending raw payloads per tenant receiver, per priority "
+            "class (sums to receiver_queue_depth)",
+        )
         for token, rt in self.tenants.items():
-            m.gauge("receiver_queue_depth", tenant=token).set(
-                rt.source.receiver.queue.qsize()
-            )
+            q = rt.source.receiver.queue
+            m.gauge("receiver_queue_depth", tenant=token).set(q.qsize())
+            depths = getattr(q, "class_depths", None)
+            if depths is not None:
+                # a SEPARATE family: mixing {tenant} and {tenant,priority}
+                # children under one name would double-count any
+                # sum(receiver_queue_depth) aggregation
+                for pr_name, d in zip(("alert", "command", "measurement"),
+                                      depths()):
+                    m.gauge(
+                        "receiver_queue_class_depth", tenant=token,
+                        priority=pr_name,
+                    ).set(d)
+            if rt.media_pipeline is not None:
+                m.gauge("media_queue_depth", tenant=token).set(
+                    rt.media_pipeline._queue.qsize()
+                )
 
     def apply_lag_gauges(self, lags: Dict[str, dict]) -> None:
         """Feed one ``bus.lags()`` result (in-proc or RemoteEventBus) into
@@ -797,6 +865,41 @@ class SiteWhereInstance(LifecycleComponent):
                 t.decision for t in traces
             ),
         }
+
+    def tenant_overload_report(self, tenant: str) -> Optional[dict]:
+        """Per-tenant overload state: policy, credit, degradation level,
+        fair-queue standing, per-stage expired/late/shed accounting —
+        the GET /api/tenants/{t}/overload payload."""
+        rep = self.overload.report(tenant)
+        if rep is None:
+            return None
+        rep["fair_queue"] = self.inference.fair.describe().get(tenant)
+
+        def _by_stage(family: str, label: str = "stage") -> Dict[str, float]:
+            out: Dict[str, float] = {}
+            for key, c in list(
+                self.metrics._labeled.get(family, {}).items()
+            ):
+                labels = dict(key)
+                if labels.get("tenant") == tenant:
+                    out[labels.get(label, "?")] = c.value
+            return out
+
+        rep["expired_by_stage"] = _by_stage("pipeline_expired_total")
+        rep["late_by_stage"] = _by_stage("pipeline_deadline_late_total")
+        rep["shed_by_priority"] = _by_stage("pipeline_shed_total", "priority")
+        rt = self.tenants.get(tenant)
+        if rt is not None:
+            q = rt.source.receiver.queue
+            rep["receiver"] = {
+                "depth": q.qsize(),
+                "class_depths": dict(zip(
+                    ("alert", "command", "measurement"), q.class_depths()
+                )),
+                "shed_total": rt.source.receiver.shed_total,
+            }
+        rep["expired_topic"] = self.bus.naming.expired_events(tenant)
+        return rep
 
     # -- introspection ---------------------------------------------------
     def topology(self) -> dict:

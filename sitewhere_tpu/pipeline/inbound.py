@@ -45,6 +45,7 @@ class InboundProcessor(LifecycleComponent):
         poll_batch: int = 1024,
         policy: Optional[FaultTolerancePolicy] = None,
         tracer=None,
+        overload=None,
     ) -> None:
         super().__init__(f"inbound-processing[{tenant}]")
         self.tenant = tenant
@@ -53,9 +54,16 @@ class InboundProcessor(LifecycleComponent):
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
         self.tracer = tracer
+        from sitewhere_tpu.runtime.overload import DeadlineGate
         from sitewhere_tpu.runtime.tracing import StageTimer
 
         self.stage_timer = StageTimer(tracer, self.metrics, tenant, "inbound")
+        # overload control: expired work drops to the tenant's expired
+        # topic here, before device lookups and the TPU leg spend on it
+        self.deadline_gate = DeadlineGate(
+            bus, tenant, "inbound", self.metrics, tracer=tracer,
+            controller=overload,
+        )
         self.retry = RetryingConsumer(
             bus, tenant, "inbound", self.group, policy=policy,
             metrics=self.metrics, tracer=tracer,
@@ -84,6 +92,8 @@ class InboundProcessor(LifecycleComponent):
         )
 
     async def _handle(self, req) -> None:
+        if self.deadline_gate.check(req):
+            return  # expired: routed to the expired topic, budget saved
         if isinstance(req, MeasurementBatch):
             await self.process_batch(req)
         else:
@@ -203,6 +213,7 @@ class InboundProcessor(LifecycleComponent):
         enriched = dict(req)
         enriched.pop("_source", None)
         trace_ctx = enriched.pop("_trace", None)
+        deadline = enriched.pop("_deadline", None)
         enriched["tenant"] = self.tenant
         enriched["assignment_token"] = assignment.token
         enriched["area_token"] = assignment.area_token
@@ -215,6 +226,8 @@ class InboundProcessor(LifecycleComponent):
             rejected.inc()
             return None
         event.trace_ctx = trace_ctx
+        if deadline is not None:
+            event.deadline_ms = float(deadline)
         self.stage_timer.observe(event, t0, _time.time() * 1000.0)
         event.mark("inbound")
         await self.bus.publish(

@@ -206,6 +206,9 @@ class TpuInferenceEngine(TenantEngine):
             svc.mm.n_data_shards, scorer.max_streams // svc.mm.n_data_shards
         )
         svc.bus.subscribe(svc.bus.naming.inbound_events(self.tenant), svc.group)
+        # fair-queue registration: this tenant's intake is rationed by
+        # its OverloadPolicy weight from the first poll
+        svc.fair.configure(self.tenant, self.config.overload.weight)
         params = None
         if svc.checkpoints is not None:
             # resume this tenant's trained weights (possibly onto a
@@ -267,6 +270,8 @@ class TpuInferenceEngine(TenantEngine):
                         drained.inc(n)
             svc.router.remove(self.tenant)
             self.placement = None
+        svc.fair.remove(self.tenant)
+        svc._gates.pop(self.tenant, None)
 
 
 class TpuInferenceService(MultitenantService):
@@ -282,11 +287,22 @@ class TpuInferenceService(MultitenantService):
         max_inflight: int = 8,
         checkpoints=None,
         tracer=None,
+        overload=None,
+        fair_quantum: int = 4096,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
         self.metrics = metrics or MetricsRegistry()
         self.checkpoints = checkpoints  # CheckpointManager | None
+        # overload control: per-tenant deficit-round-robin intake (bus →
+        # lanes is the shared chokepoint every tenant contends on), a
+        # per-tenant deadline gate so expired work never reaches a
+        # ShardedScorer flush, and degradation-mode sampling
+        self.overload = overload
+        from sitewhere_tpu.runtime.overload import DeficitRoundRobin
+
+        self.fair = DeficitRoundRobin(quantum=fair_quantum)
+        self._gates: Dict[str, object] = {}
         # tracing + scoring profile hooks: per-tenant inference spans, a
         # compile-count per (family, bucket) shape (the first flush at a
         # shape IS the XLA compile — a mid-traffic recompile is the p99
@@ -440,9 +456,18 @@ class TpuInferenceService(MultitenantService):
             self._deliver_pool = None
 
     # -- ingestion → lanes (columnar) ------------------------------------
-    async def _enqueue_batch(self, engine: TpuInferenceEngine, batch: MeasurementBatch) -> None:
+    async def _enqueue_batch(
+        self,
+        engine: TpuInferenceEngine,
+        batch: MeasurementBatch,
+        sample_rate: float = 1.0,
+    ) -> None:
         """Route a MeasurementBatch's rows into scoring lanes. Rows that
-        can't get a stream slot resolve immediately as unscored."""
+        can't get a stream slot resolve immediately as unscored.
+        ``sample_rate < 1`` is the ``sample_inference`` degradation mode:
+        only a strided sample of rows is scored, the rest resolve
+        unscored right away (they still persist — degraded, never lost)
+        so the TPU budget shrinks without breaking accounting."""
         family = engine.config.model
         lanes = self._lanes[family]
         slot = self.router.global_slot(engine.placement)
@@ -463,6 +488,16 @@ class TpuInferenceService(MultitenantService):
         if skipped:
             self.metrics.counter("tpu_inference.skipped_capacity").inc(skipped)
             entry[1] -= skipped
+        if sample_rate < 1.0:
+            step = max(1, int(round(1.0 / max(sample_rate, 1e-3))))
+            sampled_out = np.ones((n,), bool)
+            sampled_out[::step] = False
+            sampled_out &= dshards != -1  # don't double-count skipped rows
+            k = int(sampled_out.sum())
+            if k:
+                dshards = np.where(sampled_out, -1, dshards)
+                entry[1] -= k
+                self.metrics.counter("tpu_inference.sampled_out").inc(k)
         if entry[1] <= 0:
             # nothing left awaiting scores (all rows skipped, or an empty
             # batch) — publish now or the registry entry leaks forever
@@ -507,6 +542,20 @@ class TpuInferenceService(MultitenantService):
         for s in done:
             await self._publish_batch(s, nowait=publish_nowait)
         return done
+
+    def _gate(self, tenant: str):
+        """Per-tenant inference deadline gate (lazy): expired batches
+        route to the expired topic BEFORE any lane/flush work — this is
+        the 'no expired event reaches a ShardedScorer flush' guarantee."""
+        g = self._gates.get(tenant)
+        if g is None:
+            from sitewhere_tpu.runtime.overload import DeadlineGate
+
+            g = self._gates[tenant] = DeadlineGate(
+                self.bus, tenant, "inference", self.metrics,
+                tracer=self.tracer, controller=self.overload,
+            )
+        return g
 
     def _stage_timer(self, tenant: str):
         t = self._stage_timers.get(tenant)
@@ -942,18 +991,53 @@ class TpuInferenceService(MultitenantService):
     # -- main loop -------------------------------------------------------
     async def _scoring_loop(self) -> None:
         iters = self.metrics.counter("tpu_inference.loop_iters")
+        throttled = self.metrics.counter("tpu_inference.fair_throttled")
         while True:
             iters.inc()
             moved = 0
             fam_cfgs: Dict[str, Dict[int, TenantEngineConfig]] = {}
+            # weighted fair queuing: every pass replenishes each tenant's
+            # deficit (quantum × weight); a tenant that overdrew sits out
+            # until its deficit refills, so sustained intake converges to
+            # the weight ratio and a hostile tenant's backlog stays in
+            # ITS bus topic (where lag → credit → receiver shed)
+            self.fair.replenish()
             for tenant, engine in list(self.engines.items()):
                 if engine.state is not LifecycleState.STARTED:
                     continue
                 assert isinstance(engine, TpuInferenceEngine)
+                if engine.placement is not None:
+                    # register for flush even when throttled below: lanes
+                    # already holding this tenant's rows must still drain
+                    fam_cfgs.setdefault(engine.config.model, {})[
+                        self.router.global_slot(engine.placement)
+                    ] = engine.config
+                budget = self.fair.budget(tenant)
+                if budget <= 0:
+                    throttled.inc()
+                    continue
+                # per-tenant lane watermark: a slow/contended scorer must
+                # backpressure intake into the BUS (where depth is a
+                # gauge, lag drives the credit signal, and retention
+                # bounds memory) instead of buffering unboundedly in
+                # lanes. 2× max_batch keeps the next flush fed.
+                lanes_now = self._lanes.get(engine.config.model, {})
+                slot_now = self.router.global_slot(engine.placement)
+                pending_rows = sum(
+                    l.count for (s, _d), l in lanes_now.items()
+                    if s == slot_now
+                )
+                if pending_rows >= 2 * engine.config.microbatch.max_batch:
+                    self.metrics.counter(
+                        "tpu_inference.lane_backpressure"
+                    ).inc()
+                    continue
+                # a tenant in deficit debt polls ONE item at a time so
+                # the overshoot past its budget is bounded by one batch
                 items = await self.bus.consume(
                     self.bus.naming.inbound_events(tenant),
                     self.group,
-                    self.poll_batch,
+                    self.poll_batch if budget >= self.fair.quantum else 1,
                     timeout_s=0,
                 )
                 # the engine can stop DURING the consume await (stop
@@ -964,16 +1048,26 @@ class TpuInferenceService(MultitenantService):
                         self.bus.naming.scored_events(tenant), items
                     )
                     continue
-                fam_cfgs.setdefault(engine.config.model, {})[
-                    self.router.global_slot(engine.placement)
-                ] = engine.config
                 if not items:
                     continue
                 batches = [i for i in items if isinstance(i, MeasurementBatch)]
                 objects = [i for i in items if not isinstance(i, MeasurementBatch)]
+                self.fair.charge(
+                    tenant, sum(b.n for b in batches) + len(objects)
+                )
+                gate = self._gate(tenant)
+                sample_rate = 1.0
+                if self.overload is not None and self.overload.degraded(
+                    tenant, "sample_inference"
+                ):
+                    pol = self.overload.policy_for(tenant)
+                    sample_rate = pol.inference_sample_rate if pol else 1.0
                 for b in batches:
-                    await self._enqueue_batch(engine, b)
+                    if gate.check(b):
+                        continue  # expired: never reaches a scorer flush
+                    await self._enqueue_batch(engine, b, sample_rate)
                     moved += b.n
+                objects = [o for o in objects if not gate.check(o)]
                 if objects:
                     passthrough = await self._enqueue_events(engine, objects)
                     topic = self.bus.naming.scored_events(tenant)

@@ -93,7 +93,22 @@ class MediaClassificationPipeline(LifecycleComponent):
             frame = await asyncio.get_running_loop().run_in_executor(
                 None, self.media.decode_frame, data, size, "u8"
             )
-        await self._queue.put((stream_id, seq, frame, time.monotonic()))
+        item = (stream_id, seq, frame, time.monotonic())
+        try:
+            self._queue.put_nowait(item)
+        except asyncio.QueueFull:
+            # live video: newest frame wins — shed the oldest queued
+            # frame (counted) instead of backpressuring the camera feed
+            # into the REST/transport layer
+            try:
+                self._queue.get_nowait()
+            except asyncio.QueueEmpty:  # pragma: no cover - racing consumer
+                pass
+            self.metrics.counter("media_frames_shed_total").inc()
+            try:
+                self._queue.put_nowait(item)
+            except asyncio.QueueFull:  # pragma: no cover - racing producer
+                self.metrics.counter("media_frames_shed_total").inc()
 
     @staticmethod
     def _decode_raw(data: bytes, size: int) -> np.ndarray:

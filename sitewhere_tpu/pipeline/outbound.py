@@ -500,6 +500,7 @@ class OutboundDispatcher(LifecycleComponent):
         poll_batch: int = 4096,
         policy: Optional[FaultTolerancePolicy] = None,
         tracer=None,
+        overload=None,
     ) -> None:
         super().__init__(f"outbound-connectors[{tenant}]")
         self.tenant = tenant
@@ -508,7 +509,19 @@ class OutboundDispatcher(LifecycleComponent):
         self.poll_batch = poll_batch
         self.policy = policy
         self.tracer = tracer
+        # overload control: expired measurement batches skip connector
+        # fan-out (count-only — they are already persisted), and the
+        # 'pause_fanout' degradation rung pauses measurement fan-out
+        # entirely while engaged. The terminal span still records either
+        # way so tail sampling can seal the trace.
+        self.overload = overload
+        from sitewhere_tpu.runtime.overload import DeadlineGate
         from sitewhere_tpu.runtime.tracing import StageTimer
+
+        self.deadline_gate = DeadlineGate(
+            bus, tenant, "outbound", self.metrics, tracer=tracer,
+            controller=overload, route_payload=False,
+        )
 
         # outbound is the TERMINAL stage: its span seals the trace and
         # triggers the tail-based sampling decision (runtime.tracing)
@@ -565,10 +578,29 @@ class OutboundDispatcher(LifecycleComponent):
 
         src = self.bus.naming.persisted_events(self.tenant)
         delivered = self.metrics.counter("outbound.delivered")
+        skipped = self.metrics.counter("outbound.skipped_degraded")
         while True:
             items = await self.bus.consume(src, self.group, self.poll_batch)
             for item in items:
                 t0 = _time.time() * 1000.0
+                shed_fanout = False
+                if isinstance(item, MeasurementBatch):
+                    shed_fanout = self.deadline_gate.check(item) or (
+                        self.overload is not None
+                        and self.overload.degraded(
+                            self.tenant, "pause_fanout"
+                        )
+                    )
+                if shed_fanout:
+                    # fan-out shed (expired or degraded): no connector
+                    # work, but the TERMINAL span must still seal the
+                    # trace or tail sampling would idle-time-out it
+                    skipped.inc(item.n)
+                    self.stage_timer.observe(
+                        item, t0, _time.time() * 1000.0, n_events=item.n,
+                        delivered=0, shed="overload",
+                    )
+                    continue
                 if isinstance(item, MeasurementBatch):
                     results = await asyncio.gather(
                         *(c.process_batch(item) for c in self.connectors)

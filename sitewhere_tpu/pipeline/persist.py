@@ -31,6 +31,7 @@ class EventPersistence(LifecycleComponent):
         poll_batch: int = 4096,
         policy: Optional[FaultTolerancePolicy] = None,
         tracer=None,
+        overload=None,
     ) -> None:
         super().__init__(f"event-persistence[{tenant}]")
         self.tenant = tenant
@@ -38,10 +39,22 @@ class EventPersistence(LifecycleComponent):
         self.store = store
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        from sitewhere_tpu.runtime.overload import DeadlineGate
         from sitewhere_tpu.runtime.tracing import StageTimer
 
         self.stage_timer = StageTimer(
             tracer, self.metrics, tenant, "persistence"
+        )
+        # the store is the system of record: by default the gate only
+        # OBSERVES lateness here (pipeline_deadline_late_total) — an
+        # admitted event that made it this far persists regardless
+        # (at-least-once beats deadline at the store boundary) unless
+        # the tenant opted into strict mode
+        pol = overload.policy_for(tenant) if overload is not None else None
+        self.deadline_gate = DeadlineGate(
+            bus, tenant, "persistence", self.metrics, tracer=tracer,
+            controller=overload,
+            drop=bool(pol.drop_expired_at_persist) if pol else False,
         )
         self.retry = RetryingConsumer(
             bus, tenant, "persistence", self.group,
@@ -74,6 +87,8 @@ class EventPersistence(LifecycleComponent):
     async def _handle(self, item) -> None:
         import time as _time
 
+        if self.deadline_gate.check(item):
+            return  # strict mode only; default gate never drops here
         t0 = _time.time() * 1000.0
         if isinstance(item, MeasurementBatch):
             # columnar fast path: ONE append + ONE re-publish per batch

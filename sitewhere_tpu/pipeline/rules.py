@@ -458,6 +458,7 @@ class RuleEngine(LifecycleComponent):
         poll_batch: int = 4096,
         policy: Optional[FaultTolerancePolicy] = None,
         tracer=None,
+        overload=None,
     ) -> None:
         super().__init__(f"rule-processing[{tenant}]")
         self.tenant = tenant
@@ -465,9 +466,19 @@ class RuleEngine(LifecycleComponent):
         self.rules: List[Rule] = list(rules or [])
         self.metrics = metrics or MetricsRegistry()
         self.poll_batch = poll_batch
+        from sitewhere_tpu.runtime.overload import DeadlineGate
         from sitewhere_tpu.runtime.tracing import StageTimer
 
         self.stage_timer = StageTimer(tracer, self.metrics, tenant, "rules")
+        # overload control: expired measurement batches skip rule work
+        # (they are already persisted — only derived fan-out is saved),
+        # and the 'persist_only' degradation rung pauses evaluation of
+        # measurement batches entirely while engaged
+        self.overload = overload
+        self.deadline_gate = DeadlineGate(
+            bus, tenant, "rules", self.metrics, tracer=tracer,
+            controller=overload, route_payload=False,
+        )
         self.retry = RetryingConsumer(
             bus, tenant, "rules", self.group, policy=policy,
             metrics=self.metrics, tracer=tracer,
@@ -506,6 +517,18 @@ class RuleEngine(LifecycleComponent):
 
     async def _handle(self, item) -> None:
         t0 = time.time() * 1000.0
+        if self.deadline_gate.check(item):
+            return  # already persisted; only the derived fan-out is shed
+        if (
+            isinstance(item, MeasurementBatch)
+            and self.overload is not None
+            and self.overload.degraded(self.tenant, "persist_only")
+        ):
+            # persist-only degradation: rule evaluation over measurement
+            # batches pauses while the rung is engaged (alerts and other
+            # object events still evaluate — they are the valuable ones)
+            self.metrics.counter("rules.skipped_degraded").inc(item.n)
+            return
         if isinstance(item, MeasurementBatch):
             derived = await self.process_batch(item)
             n = item.n

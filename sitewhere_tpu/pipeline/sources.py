@@ -33,53 +33,62 @@ from sitewhere_tpu.runtime.bus import EventBus, RetryingConsumer
 from sitewhere_tpu.runtime.config import FaultTolerancePolicy
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.overload import (
+    PRIORITY_NAMES,
+    PriorityClassQueue,
+    classify_priority,
+)
 
 
 class InboundReceiver(LifecycleComponent):
-    """Base receiver: produces (payload: bytes, context: dict) pairs."""
+    """Base receiver: produces (payload: bytes, context: dict) pairs.
+
+    Admission control (runtime.overload): the queue is priority-classed
+    (alerts > commands > measurements, classified from cheap context
+    hints). Under burst the lowest class sheds first at its fill
+    watermark — a measurement flood can never evict an alert — and the
+    measurement watermark shrinks with the tenant's credit signal when
+    downstream stages lag (cooperative intake throttle)."""
 
     def __init__(self, name: str) -> None:
         super().__init__(name)
-        self.queue: asyncio.Queue = asyncio.Queue(maxsize=65536)
+        self.queue = PriorityClassQueue(maxsize=65536)
+        self.queue.on_shed = self._on_shed
         self.shed_total = 0
         # EventSource attaches the instance registry so sheds surface as
         # ``receiver_shed_total`` on the normal /metrics scrape
         self.metrics: Optional[MetricsRegistry] = None
+        # EventSource installs a richer hook (tenant-labeled counters +
+        # tail-trace visibility) on top of the local accounting
+        self.shed_hook = None
         # set by EventSource when the tenant has tracing enabled: payloads
         # get a receive stamp so the decode span's queue-wait (time spent
         # in this receiver queue) is measurable. Guarded — an untraced
         # tenant's submit path stays allocation-identical to before.
         self.stamp_recv_ts = False
 
+    def _on_shed(self, priority: int, n: int) -> None:
+        self.shed_total += n
+        if self.metrics is not None:
+            self.metrics.counter("receiver_shed_total").inc(n)
+        if self.shed_hook is not None:
+            self.shed_hook(priority, n)
+
     async def submit(self, payload: bytes, **context: Any) -> None:
         if self.stamp_recv_ts:
             context["_recv_t"] = time.time() * 1000.0
-        await self.queue.put((payload, context))
+        await self.queue.put(
+            (payload, context), classify_priority(context)
+        )
 
     def submit_nowait(self, payload: bytes, **context: Any) -> None:
-        """Non-blocking submit for network receiver loops. A full queue
-        sheds the OLDEST queued payload (newest data wins under burst —
-        counted, never raised into the receiver loop)."""
+        """Non-blocking submit for network receiver loops. A full class
+        watermark sheds the OLDEST queued payload of the lowest present
+        class (newest data wins under burst — counted, never raised
+        into the receiver loop)."""
         if self.stamp_recv_ts:
             context["_recv_t"] = time.time() * 1000.0
-        try:
-            self.queue.put_nowait((payload, context))
-            return
-        except asyncio.QueueFull:
-            pass
-        try:
-            self.queue.get_nowait()  # shed oldest
-        except asyncio.QueueEmpty:  # pragma: no cover - racing consumer
-            pass
-        self.shed_total += 1
-        if self.metrics is not None:
-            self.metrics.counter("receiver_shed_total").inc()
-        try:
-            self.queue.put_nowait((payload, context))
-        except asyncio.QueueFull:  # pragma: no cover - racing producer
-            self.shed_total += 1
-            if self.metrics is not None:
-                self.metrics.counter("receiver_shed_total").inc()
+        self.queue.put_nowait((payload, context), classify_priority(context))
 
 
 class QueueReceiver(InboundReceiver):
@@ -222,6 +231,7 @@ class EventSource(LifecycleComponent):
         dedup: bool = True,
         policy: Optional[FaultTolerancePolicy] = None,
         tracer=None,
+        overload=None,
     ) -> None:
         super().__init__(f"event-source[{source_id}]")
         self.source_id = source_id
@@ -233,6 +243,32 @@ class EventSource(LifecycleComponent):
         self.dedup = Deduplicator() if dedup else None
         self._pump: Optional[asyncio.Task] = None
         receiver.metrics = self.metrics
+        # overload control (runtime.overload.OverloadController | None):
+        # admission watermarks + credit feedback on the receiver queue,
+        # and the deadline budget stamped onto every accepted payload
+        self.overload = overload
+        self.metrics.describe(
+            "pipeline_shed_total",
+            "payloads shed at receiver admission, per tenant and "
+            "priority class",
+        )
+        receiver.shed_hook = self._shed_hook
+        if overload is not None:
+            pol = overload.policy_for(tenant)
+            if pol is not None:
+                receiver.queue.fill = [
+                    pol.shed_alerts_fill,
+                    pol.shed_commands_fill,
+                    pol.shed_measurements_fill,
+                ]
+                receiver.queue.credit_fn = lambda: overload.credit(tenant)
+            if overload.deadline_ms(tenant) is not None:
+                # the deadline budget is anchored at ADMISSION (receiver
+                # enqueue), not decode — without the receive stamp a
+                # decode-bound pump would grant queue-aged payloads the
+                # full budget and the bounded-latency guarantee would
+                # have a blind spot upstream of the bus lag signal
+                receiver.stamp_recv_ts = True
         # THE trace mint edge: every ingest transport (in-proc broker,
         # real MQTT, HTTP, WS, CoAP, socket) funnels payloads through a
         # receiver into this source, so minting here covers them all
@@ -249,6 +285,35 @@ class EventSource(LifecycleComponent):
             policy=policy, metrics=self.metrics, tracer=tracer,
         )
         self.add_child(receiver)
+
+    _last_shed_trace = 0.0
+
+    def _shed_hook(self, priority: int, n: int) -> None:
+        """Receiver sheds become observable: tenant+class-labeled
+        counters always, plus a retained 'shed' trace (tail sampling)
+        at most once per second per source — receiver shedding used to
+        be invisible to tracing entirely."""
+        self.metrics.counter(
+            "pipeline_shed_total",
+            tenant=self.tenant, priority=PRIORITY_NAMES[priority],
+        ).inc(n)
+        if self.overload is not None:
+            self.overload.note_shed(self.tenant, n)
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled_for(self.tenant):
+            return
+        now = time.time()
+        if now - self._last_shed_trace < 1.0:
+            return
+        self._last_shed_trace = now
+        ctx = tracer.mint(self.tenant, source_topic=f"shed:{self.source_id}")
+        if ctx is not None:
+            tracer.mark_hit(ctx, "shed")
+            tracer.record_span(
+                ctx, "receiver", now * 1000.0, now * 1000.0,
+                n_events=n, terminal=True,
+                priority=PRIORITY_NAMES[priority],
+            )
 
     async def on_start(self) -> None:
         self._pump = asyncio.create_task(
@@ -399,7 +464,20 @@ class EventSource(LifecycleComponent):
             traced = self.tracer is not None and self.tracer.enabled_for(
                 self.tenant
             )
+            # admission deadline: accepted work gets `admission + budget`
+            # from the tenant's OverloadPolicy — anchored at the receiver
+            # enqueue stamp when present so receiver-queue wait spends
+            # budget too; every downstream stage consults the remainder
+            # (runtime.overload.DeadlineGate)
+            budget = (
+                self.overload.deadline_ms(self.tenant)
+                if self.overload is not None
+                else None
+            )
+            deadline_base = float(recv_t) if recv_t else float(now)
             for mb in out_batches:
+                if budget is not None:
+                    mb.deadline_ms = deadline_base + budget
                 if traced:
                     # mint at the edge; the context rides the batch through
                     # every stage (and over the netbus wire, pickled)
@@ -436,6 +514,12 @@ class EventSource(LifecycleComponent):
                 measurements.append(req)
             else:
                 req["_source"] = self.source_id
+                if self.overload is not None:
+                    budget = self.overload.deadline_ms(self.tenant)
+                    if budget is not None:
+                        # non-measurement events never expire (DeadlineGate
+                        # skips them) but carry the stamp for observability
+                        req["_deadline"] = float(now) + budget
                 if "_trace" not in req and self.tracer is not None:
                     ctx = self.tracer.mint(
                         self.tenant,

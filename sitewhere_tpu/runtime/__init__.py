@@ -18,12 +18,21 @@ from sitewhere_tpu.runtime.config import (
     MicroserviceConfig,
     TenantEngineConfig,
 )
+from sitewhere_tpu.runtime.config import OverloadPolicy
 from sitewhere_tpu.runtime.metrics import Counter, Gauge, Histogram, MetricsRegistry
+from sitewhere_tpu.runtime.overload import (
+    DeadlineGate,
+    DeficitRoundRobin,
+    OverloadController,
+    PriorityClassQueue,
+)
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
 __all__ = [
     "CircuitBreaker",
     "Counter",
+    "DeadlineGate",
+    "DeficitRoundRobin",
     "EventBus",
     "FaultTolerancePolicy",
     "RetryingConsumer",
@@ -36,6 +45,9 @@ __all__ = [
     "MetricsRegistry",
     "MicroserviceConfig",
     "MultitenantService",
+    "OverloadController",
+    "OverloadPolicy",
+    "PriorityClassQueue",
     "TenantEngine",
     "TenantEngineConfig",
     "Topic",

@@ -82,6 +82,12 @@ class TopicNaming:
     def dead_letter_prefix(self, tenant: str) -> str:
         return self.tenant_topic(tenant, "dead-letter.")
 
+    def expired_events(self, tenant: str) -> str:
+        """DLQ-style accounting topic for deadline-expired work (overload
+        control): entries carry the dropped payload + stage + lateness so
+        store ∪ DLQ ∪ expired accounting stays exact under load shedding."""
+        return self.tenant_topic(tenant, "expired-events")
+
 
 class TransientPublishError(RuntimeError):
     """An injected (or backend) publish failure that a well-behaved

@@ -71,6 +71,56 @@ class FaultTolerancePolicy:
 
 
 @dataclass(frozen=True)
+class OverloadPolicy:
+    """Overload control & graceful degradation knobs (runtime.overload /
+    docs/ROBUSTNESS.md "Overload & degradation").
+
+    Admission: every accepted payload is stamped with a deadline of
+    ``deadline_ms`` (0 = 2 × ``TracingConfig.slo_ms``); receiver queues
+    shed by priority class (alerts > commands > measurements) at the
+    per-class fill watermarks below instead of blind shed-oldest.
+
+    Fairness: the tpu-inference consumption loop rations intake by
+    deficit round-robin over ``weight`` — a hostile tenant's backlog
+    stays in its own bus topic, which drives its credit signal down and
+    throttles its receivers cooperatively (``credit_lag_lo/hi``).
+
+    Degradation: ``ladder`` lists sheddable features in engage order
+    (``sample_inference``: score only ``inference_sample_rate`` of
+    measurements; ``persist_only``: pause rule evaluation;
+    ``pause_fanout``: pause outbound connector fan-out for measurement
+    batches). Rungs engage after ``engage_hold_s`` of sustained
+    pressure (pipeline lag ≥ ``engage_lag`` or ≥
+    ``engage_expired_per_s`` deadline misses/s) and disengage one rung
+    per ``hysteresis_s`` of sustained calm (lag ≤ ``disengage_lag``,
+    zero recent misses).
+    """
+
+    enabled: bool = True
+    deadline_ms: float = 0.0        # admission deadline budget; 0 = 2×slo
+    weight: float = 1.0             # fair-queue (DRR) weight
+    # receiver-queue fill watermarks per priority class (fractions)
+    shed_alerts_fill: float = 0.98
+    shed_commands_fill: float = 0.90
+    shed_measurements_fill: float = 0.75
+    # credit signal: 1.0 at lag ≤ lo, linearly down to 0.0 at lag ≥ hi
+    credit_lag_lo: int = 512
+    credit_lag_hi: int = 8192
+    # degradation ladder + thresholds/hysteresis
+    ladder: tuple = ("sample_inference", "persist_only", "pause_fanout")
+    inference_sample_rate: float = 0.25
+    engage_lag: int = 4096
+    engage_expired_per_s: int = 50
+    disengage_lag: int = 256
+    engage_hold_s: float = 0.5
+    hysteresis_s: float = 2.0
+    # persistence is the system of record: by default it observes
+    # lateness (pipeline_deadline_late_total) but never drops — opt in
+    # to strict deadline enforcement at the store boundary here
+    drop_expired_at_persist: bool = False
+
+
+@dataclass(frozen=True)
 class TracingConfig:
     """End-to-end event tracing knobs (runtime.tracing / docs/OBSERVABILITY.md).
 
@@ -111,6 +161,7 @@ class TenantEngineConfig:
         default_factory=FaultTolerancePolicy
     )
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    overload: OverloadPolicy = field(default_factory=OverloadPolicy)
     max_streams: int = 4096         # window-state capacity (series slots)
     decoder: str = "json"
     # host↔device wire dtype for scoring values/scores ("f32" | "bf16" |
@@ -261,14 +312,18 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
     tr = d.pop("training", None) or {}
     ft = d.pop("fault_tolerance", None) or {}
     tc = d.pop("tracing", None) or {}
+    ov = d.pop("overload", None) or {}
     if "buckets" in mb:
         mb["buckets"] = tuple(mb["buckets"])
+    if "ladder" in ov:
+        ov["ladder"] = tuple(ov["ladder"])
     # drop unknown keys at EVERY level: a manifest written by a newer build
     # (extra knobs) must degrade gracefully, not abort the whole restore
     mb_known = MicroBatchConfig.__dataclass_fields__
     tr_known = TrainingConfig.__dataclass_fields__
     ft_known = FaultTolerancePolicy.__dataclass_fields__
     tc_known = TracingConfig.__dataclass_fields__
+    ov_known = OverloadPolicy.__dataclass_fields__
     known = TenantEngineConfig.__dataclass_fields__
     return TenantEngineConfig(
         microbatch=MicroBatchConfig(
@@ -283,12 +338,15 @@ def tenant_config_from_dict(d: Dict[str, Any]) -> TenantEngineConfig:
         tracing=TracingConfig(
             **{k: v for k, v in tc.items() if k in tc_known}
         ),
+        overload=OverloadPolicy(
+            **{k: v for k, v in ov.items() if k in ov_known}
+        ),
         **{
             k: v
             for k, v in d.items()
             if k in known
             and k not in ("microbatch", "training", "fault_tolerance",
-                          "tracing")
+                          "tracing", "overload")
         },
     )
 

@@ -29,13 +29,27 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import pickle
+import random
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 from sitewhere_tpu.runtime import safepickle
 from sitewhere_tpu.runtime.bus import EventBus, FaultPlan, TopicNaming
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+logger = logging.getLogger("sitewhere.netbus")
+
+# server-side cap on one blocking consume poll (seconds): a vanished
+# client must not pin a poll forever. Clients preserve longer timeouts
+# by re-issuing capped polls (RemoteEventBus.consume); a caller going
+# through ``BusBrokerServer`` directly has its longer timeout TRUNCATED
+# to this — logged + counted (netbus_consume_timeout_clamped_total)
+# instead of silently, since a single poll returning early looks
+# exactly like an empty topic to the caller.
+CONSUME_TIMEOUT_CAP_S = 30.0
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -87,11 +101,14 @@ class BusBrokerServer(LifecycleComponent):
         host: str = "127.0.0.1",
         port: int = 0,
         bus: Optional[EventBus] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         super().__init__("bus-broker")
         # pluggable backing bus: pass a dlog.DurableEventBus for a broker
         # whose logs + cursors survive kill -9 (round-4 verdict item 4)
         self.bus = bus if bus is not None else EventBus(naming, retention)
+        self.metrics = metrics or MetricsRegistry()
+        self._clamp_logged: set = set()
         self.host = host
         self.port = port
         self.bound_port: Optional[int] = None
@@ -174,16 +191,35 @@ class BusBrokerServer(LifecycleComponent):
         if op == "publish_nowait":
             return bus.publish_nowait(*args)
         if op == "consume":
-            # cap server-side waits so a vanished client can't pin a poll
-            # forever; the client re-issues long polls. A dropped
-            # (tombstoned) topic returns None so the client can stop
-            # re-issuing instead of hot-looping on instant empty replies
+            # cap server-side waits at CONSUME_TIMEOUT_CAP_S so a
+            # vanished client can't pin a poll forever; RemoteEventBus
+            # preserves longer timeouts by re-issuing capped polls. A
+            # direct caller's longer timeout is TRUNCATED here — logged
+            # once per (topic, group) + counted, never silent: a clamped
+            # poll returning [] is indistinguishable from an empty topic
+            # on the caller's side. A dropped (tombstoned) topic returns
+            # None so the client can stop re-issuing instead of
+            # hot-looping on instant empty replies.
             topic, group, max_items, timeout_s, *rest = args
             partition = rest[0] if rest else None
             if bus.topic(topic).dropped:
                 return None
-            if timeout_s is None or timeout_s > 30.0:
-                timeout_s = 30.0
+            if timeout_s is not None and timeout_s > CONSUME_TIMEOUT_CAP_S:
+                self.metrics.counter(
+                    "netbus_consume_timeout_clamped_total"
+                ).inc()
+                key = (topic, group)
+                if key not in self._clamp_logged:
+                    self._clamp_logged.add(key)
+                    logger.warning(
+                        "consume timeout %.1fs clamped to %.1fs for "
+                        "topic=%s group=%s (re-issue polls client-side "
+                        "for longer waits)",
+                        timeout_s, CONSUME_TIMEOUT_CAP_S, topic, group,
+                    )
+                timeout_s = CONSUME_TIMEOUT_CAP_S
+            elif timeout_s is None:
+                timeout_s = CONSUME_TIMEOUT_CAP_S
             return await bus.consume(
                 topic, group, max_items, timeout_s, partition
             )
@@ -237,10 +273,13 @@ class RemoteEventBus:
         naming: Optional[TopicNaming] = None,
         retention: int = 65536,
         reconnect_window_s: float = 20.0,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         self.naming = naming or TopicNaming()
         self.retention = retention
         self.host, self.port = host, port
+        self.metrics = metrics or MetricsRegistry()
+        self._rng = random.Random()
         # how long awaited calls retry against a down broker before the
         # error propagates (0 = fail fast). A durable broker restarted on
         # the same port within the window is transparent to the pipeline:
@@ -274,6 +313,23 @@ class RemoteEventBus:
         for topic, group, at in self._subs:
             self._writer.write(_dump((None, "subscribe", (topic, group, at))))
 
+    # reconnect backoff: first retry after RECONNECT_BASE_S, doubling to
+    # RECONNECT_MAX_S, each delay jittered ±RECONNECT_JITTER — a fleet of
+    # clients must not hammer a dead (or just-restarted) broker in
+    # lockstep for the whole reconnect_window_s
+    RECONNECT_BASE_S = 0.05
+    RECONNECT_MAX_S = 2.0
+    RECONNECT_JITTER = 0.25
+
+    def _backoff(self, attempt: int) -> float:
+        d = min(
+            self.RECONNECT_BASE_S * (2 ** max(attempt - 1, 0)),
+            self.RECONNECT_MAX_S,
+        )
+        return max(
+            0.0, d * (1.0 + self.RECONNECT_JITTER * (2 * self._rng.random() - 1))
+        )
+
     async def _ensure_connected(self) -> None:
         if self._closed:
             raise ConnectionError("bus client closed")
@@ -285,17 +341,30 @@ class RemoteEventBus:
                 return
             loop = asyncio.get_running_loop()
             deadline = loop.time() + self.reconnect_window_s
+            attempt = 0
             while True:
+                attempt += 1
                 try:
                     await self._connect_once()
+                    self.metrics.counter(
+                        "netbus_reconnects_total", outcome="ok"
+                    ).inc()
                     return
                 except OSError:
+                    self.metrics.counter(
+                        "netbus_reconnects_total", outcome="error"
+                    ).inc()
                     if loop.time() >= deadline:
+                        self.metrics.counter(
+                            "netbus_reconnects_total", outcome="exhausted"
+                        ).inc()
                         raise ConnectionError(
                             f"bus broker unreachable at "
                             f"{self.host}:{self.port}"
                         )
-                    await asyncio.sleep(0.25)
+                    # jittered exponential backoff: no hot spinning
+                    # against a dead broker inside the window
+                    await asyncio.sleep(self._backoff(attempt))
 
     def _mark_disconnected(self) -> None:
         if self._writer is not None:
@@ -343,7 +412,9 @@ class RemoteEventBus:
     async def _call(self, op: str, *args) -> Any:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + max(self.reconnect_window_s, 0.0)
+        attempt = 0
         while True:
+            attempt += 1
             await self._ensure_connected()
             req_id = next(self._ids)
             # write-path frame cap: an oversized publish fails THIS call
@@ -364,7 +435,7 @@ class RemoteEventBus:
                 self._futures.pop(req_id, None)
                 if self._closed or loop.time() >= deadline:
                     raise
-                await asyncio.sleep(0.25)
+                await asyncio.sleep(self._backoff(attempt))
 
     def _send_nowait(self, op: str, *args) -> None:
         """Fire-and-forget for the sync API points; StreamWriter.write is
@@ -394,7 +465,9 @@ class RemoteEventBus:
         timeout_s: Optional[float] = None,
         partition: Optional[int] = None,
     ) -> List[Any]:
-        # the broker caps one server-side poll at 30s; preserve the
+        # the broker clamps one server-side poll at CONSUME_TIMEOUT_CAP_S
+        # (30 s — longer per-poll timeouts are truncated broker-side,
+        # counted in netbus_consume_timeout_clamped_total); preserve the
         # in-proc semantics for ANY timeout by re-issuing capped polls
         # against a client-side deadline (None = wait forever)
         loop = asyncio.get_running_loop()
@@ -412,7 +485,7 @@ class RemoteEventBus:
                 return []  # topic dropped (tenant teardown) — stop polling
             if items:
                 return items
-            if remaining is not None and remaining <= 30.0:
+            if remaining is not None and remaining <= CONSUME_TIMEOUT_CAP_S:
                 return items  # the broker honored the full remaining wait
 
     def subscribe(self, topic: str, group: str, at: str = "earliest") -> None:

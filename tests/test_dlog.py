@@ -110,6 +110,62 @@ async def test_durable_bus_truncates_torn_frame(tmp_path):
     bus2.close()
 
 
+async def test_torn_frame_recovery_at_every_byte_boundary(tmp_path):
+    """Kill-mid-append, exhaustively: truncate the final frame at EVERY
+    byte boundary (from 'only the length header's first byte landed' to
+    'one byte short of complete') and assert recovery (a) keeps exactly
+    the intact prefix, (b) never lets a journaled consumer cursor run
+    ahead of the recovered data, and (c) appends cleanly afterwards."""
+    import shutil
+
+    src = tmp_path / "src"
+    bus = DurableEventBus(src, retention=100)
+    bus.subscribe("x", "g")
+    for i in range(8):
+        await bus.publish("x", {"i": i, "pad": "p" * 11})
+    # consume 5 then poll again so the cursor for the first batch is
+    # journaled (commit-on-next-poll) — the cursor now points at 5
+    assert len(await bus.consume("x", "g", 5, timeout_s=0)) == 5
+    assert len(await bus.consume("x", "g", 1, timeout_s=0)) == 1
+    bus.close()
+
+    seg = sorted((src / "topics").rglob("seg-*.log"))[-1]
+    data = seg.read_bytes()
+    # locate the final frame's start by walking intact frames
+    import struct as _struct
+
+    pos, last_start = 0, 0
+    while pos + 4 <= len(data):
+        (n,) = _struct.unpack(">I", data[pos:pos + 4])
+        if pos + 4 + n > len(data):
+            break
+        last_start = pos
+        pos += 4 + n
+    assert pos == len(data), "fixture expects an intact final frame"
+
+    for cut in range(last_start + 1, len(data)):
+        trial = tmp_path / f"trial-{cut}"
+        shutil.copytree(src, trial)
+        tseg = sorted((trial / "topics").rglob("seg-*.log"))[-1]
+        with open(tseg, "wb") as f:
+            f.write(data[:cut])
+        bus2 = DurableEventBus(trial, retention=100)
+        t = bus2.topic("x")
+        # (a) exactly the intact prefix survived (frames 0..6)
+        assert t.latest_offset == 7, (cut, t.latest_offset)
+        # (b) the journaled cursor (6: five + one consumed) never runs
+        # ahead of recovered data
+        assert t.committed("g") <= t.latest_offset, cut
+        rest = await bus2.consume("x", "g", 100, timeout_s=0)
+        assert [r["i"] for r in rest] == [6], (cut, rest)
+        # (c) the writer resumes appending cleanly at the right offset
+        await bus2.publish("x", {"i": 99})
+        got = await bus2.consume("x", "g", 100, timeout_s=0)
+        assert [r["i"] for r in got] == [99], cut
+        bus2.close()
+        shutil.rmtree(trial)
+
+
 async def test_durable_drop_topics_is_durable(tmp_path):
     bus = DurableEventBus(tmp_path)
     bus.subscribe("dead.a", "g")

@@ -191,8 +191,12 @@ async def test_publish_fail_p_certain_falls_back_to_nowait(bus):
 # -- receiver burst shedding ----------------------------------------------
 
 async def test_submit_nowait_sheds_oldest_and_counts():
+    from sitewhere_tpu.runtime.overload import PriorityClassQueue
+
     r = QueueReceiver("recv")
-    r.queue = asyncio.Queue(maxsize=4)
+    r.queue = PriorityClassQueue(maxsize=4)
+    r.queue.on_shed = r._on_shed
+    r.queue.fill = [1.0, 1.0, 1.0]  # no watermark headroom: legacy cap
     metrics = MetricsRegistry()
     r.metrics = metrics
     for i in range(10):

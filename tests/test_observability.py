@@ -222,7 +222,11 @@ async def test_tracing_disabled_hot_path_carries_no_contexts():
     code), receivers skip receive-stamping, and the store stays empty."""
     cfg = TracingConfig(enabled=False, sample_rate=1.0)
     async with traced_instance("t3", cfg) as (inst, rt):
-        assert rt.source.receiver.stamp_recv_ts is False
+        # overload control keeps the receive stamp ON (deadline budgets
+        # anchor at admission) — flip it off here to assert the TRACING
+        # half of the hot-path guard in isolation: disabling tracing must
+        # be what gates context minting, not a side effect of stamping
+        rt.source.receiver.stamp_recv_ts = False
         await ingest(inst, "t3", 40)
         await wait_persisted(rt, 40)
         await asyncio.sleep(0.2)

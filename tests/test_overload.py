@@ -1,0 +1,429 @@
+"""Overload control units: priority-classed admission, deadline
+stamping/propagation/gating, deficit-round-robin fairness, the
+degradation-ladder state machine with hysteresis, the bounded-queue
+observability lint, and the netbus reconnect/clamp satellites."""
+
+import asyncio
+import importlib.util
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.batch import MeasurementBatch
+from sitewhere_tpu.core.events import DeviceAlert, DeviceMeasurement
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.config import (
+    OverloadPolicy,
+    TenantEngineConfig,
+    tenant_config_from_dict,
+    tenant_config_to_dict,
+)
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.overload import (
+    PRIORITY_ALERT,
+    PRIORITY_COMMAND,
+    PRIORITY_MEASUREMENT,
+    DeadlineGate,
+    DeficitRoundRobin,
+    OverloadController,
+    PriorityClassQueue,
+    classify_priority,
+    clear_deadline,
+    deadline_of,
+    stamp_deadline,
+)
+
+_spec = importlib.util.spec_from_file_location(
+    "check_queues",
+    Path(__file__).resolve().parent.parent / "tools" / "check_queues.py",
+)
+check_queues = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_queues)
+
+
+def _batch(tenant="t", n=4, deadline=None):
+    b = MeasurementBatch.from_columns(
+        tenant, ["d"] * n, ["m"] * n, list(range(n)), [0] * n
+    )
+    b.deadline_ms = deadline
+    return b
+
+
+# -- priority classification / admission ----------------------------------
+
+def test_classify_priority_hints_and_topics():
+    assert classify_priority({"priority": "alert"}) == PRIORITY_ALERT
+    assert classify_priority({"priority": 1}) == PRIORITY_COMMAND
+    assert classify_priority({"topic": "sw/t/command/dev"}) == PRIORITY_COMMAND
+    assert classify_priority({"topic": "sw/t/alert"}) == PRIORITY_ALERT
+    assert classify_priority({"topic": "sw/t/input/dev"}) == PRIORITY_MEASUREMENT
+    assert classify_priority({}) == PRIORITY_MEASUREMENT
+
+
+async def test_priority_queue_sheds_measurements_first_never_alerts():
+    q = PriorityClassQueue(maxsize=10)
+    sheds = []
+    q.on_shed = lambda pr, n: sheds.append((pr, n))
+    for i in range(3):
+        q.put_nowait(("a", i), PRIORITY_ALERT)
+    # measurement watermark = 0.75*10 = 7: admits until total qsize 7
+    admitted = sum(
+        q.put_nowait(("m", i), PRIORITY_MEASUREMENT) for i in range(10)
+    )
+    assert q.qsize() == 7
+    assert admitted == 10  # sheds evict the OLDEST measurement, not the new
+    assert all(pr == PRIORITY_MEASUREMENT for pr, _ in sheds)
+    # alerts keep admitting right past the measurement watermark
+    assert q.put_nowait(("a", 99), PRIORITY_ALERT)
+    # dequeue: all alerts first, then measurements, FIFO within class
+    got = [q.get_nowait() for _ in range(q.qsize())]
+    assert [v for v in got[:4]] == [("a", 0), ("a", 1), ("a", 2), ("a", 99)]
+    assert all(v[0] == "m" for v in got[4:])
+
+
+async def test_priority_queue_alert_evicts_measurement_when_full():
+    q = PriorityClassQueue(maxsize=4)
+    q.fill = [1.0, 1.0, 1.0]  # no watermark headroom: force the evict path
+    for i in range(4):
+        assert q.put_nowait(("m", i), PRIORITY_MEASUREMENT)
+    assert q.put_nowait(("a", 0), PRIORITY_ALERT)  # evicts oldest measurement
+    got = [q.get_nowait() for _ in range(4)]
+    assert got[0] == ("a", 0)
+    assert ("m", 0) not in got
+    # a measurement arriving into a queue full of alerts sheds ITSELF
+    q2 = PriorityClassQueue(maxsize=2)
+    q2.fill = [1.0, 1.0, 1.0]
+    q2.put_nowait(("a", 0), PRIORITY_ALERT)
+    q2.put_nowait(("a", 1), PRIORITY_ALERT)
+    assert not q2.put_nowait(("m", 0), PRIORITY_MEASUREMENT)
+    assert q2.shed_total == 1
+
+
+async def test_priority_queue_credit_shrinks_measurement_cap():
+    q = PriorityClassQueue(maxsize=100)
+    credit = [1.0]
+    q.credit_fn = lambda: credit[0]
+    for i in range(60):
+        assert q.put_nowait(i, PRIORITY_MEASUREMENT)
+    assert q.qsize() == 60
+    credit[0] = 0.1  # cap falls to 0.75*100*0.1 = 7: arrivals shed-oldest
+    q.put_nowait("new", PRIORITY_MEASUREMENT)
+    assert q.qsize() == 60  # one in, one shed
+    assert q.shed_total == 1
+    # awaited put sheds too (no block) once credit is degraded
+    assert await q.put("new2", PRIORITY_MEASUREMENT) is True
+    assert q.shed_total == 2
+
+
+async def test_priority_queue_awaited_put_blocks_when_healthy():
+    q = PriorityClassQueue(maxsize=2)
+    q.fill = [1.0, 1.0, 1.0]
+    await q.put(1)
+    await q.put(2)
+    blocked = asyncio.create_task(q.put(3))
+    await asyncio.sleep(0.01)
+    assert not blocked.done(), "healthy queue must backpressure, not shed"
+    q.get_nowait()
+    await asyncio.wait_for(blocked, 1.0)
+    assert q.shed_total == 0
+
+
+# -- deadline stamping / gating --------------------------------------------
+
+def test_deadline_stamp_roundtrip_all_shapes():
+    b = _batch()
+    stamp_deadline(b, 123.0)
+    assert deadline_of(b) == 123.0
+    e = DeviceMeasurement()
+    stamp_deadline(e, 5.0)
+    assert deadline_of(e) == 5.0
+    d = {"type": "measurement"}
+    stamp_deadline(d, 7.0)
+    assert deadline_of(d) == 7.0
+    clear_deadline(d)
+    clear_deadline(b)
+    assert deadline_of(d) is None and deadline_of(b) is None
+    # dead-letter entries clear through to the wrapped payload
+    entry = {"payload": e}
+    clear_deadline(entry)
+    assert deadline_of(e) is None
+
+
+def test_deadline_select_concat_pad_propagation():
+    b = _batch(n=6, deadline=99.0)
+    assert b.select(np.asarray([0, 2])).deadline_ms == 99.0
+    assert b.pad_to(8).deadline_ms == 99.0
+    b2 = _batch(n=2, deadline=50.0)
+    assert MeasurementBatch.concat([b, b2]).deadline_ms == 50.0  # tightest
+
+
+async def test_deadline_gate_drops_expired_batches_exactly_once():
+    bus = EventBus(TopicNaming("g"))
+    m = MetricsRegistry()
+    clock = [100.0]  # seconds
+    gate = DeadlineGate(bus, "t1", "inference", m, clock=lambda: clock[0])
+    fresh = _batch("t1", 4, deadline=100_500.0)  # 100.5s in ms
+    assert not gate.check(fresh)
+    clock[0] = 101.0
+    expired = _batch("t1", 4, deadline=100_500.0)
+    assert gate.check(expired)
+    view = bus.peek(bus.naming.expired_events("t1"))
+    assert view["depth"] == 1
+    _off, entry = view["entries"][0]
+    assert entry["stage"] == "inference" and entry["rows"] == 4
+    assert entry["payload"] is expired
+    assert m.counter(
+        "pipeline_expired_total", tenant="t1", stage="inference"
+    ).value == 4
+
+
+async def test_deadline_gate_never_expires_alerts_and_honors_pressure():
+    bus = EventBus(TopicNaming("g"))
+    m = MetricsRegistry()
+    gate = DeadlineGate(bus, "t1", "rules", m, clock=lambda: 10.0)
+    alert = DeviceAlert(tenant="t1")
+    alert.deadline_ms = 1.0  # way past
+    assert not gate.check(alert), "alerts never expire"
+    # with a controller attached and NO pressure, expiry only observes
+    ctrl = OverloadController(m, clock=lambda: 0.0)
+    ctrl.configure_tenant(TenantEngineConfig(tenant="t1"))
+    gated = DeadlineGate(
+        bus, "t1", "inbound", m, controller=ctrl, clock=lambda: 10.0
+    )
+    late = _batch("t1", 3, deadline=1.0)
+    assert not gated.check(late), "no pressure → observe, don't drop"
+    assert m.counter(
+        "pipeline_deadline_late_total", tenant="t1", stage="inbound"
+    ).value == 3
+    # degrade the tenant: the same gate now sheds
+    ctrl._tenants["t1"].credit = 0.5
+    assert gated.check(late)
+
+
+# -- fair queuing ----------------------------------------------------------
+
+def test_drr_converges_to_weight_ratio():
+    drr = DeficitRoundRobin(quantum=100)
+    drr.configure("good", 1.0)
+    drr.configure("hostile", 1.0)
+    served = {"good": 0, "hostile": 0}
+    backlog = {"good": 120, "hostile": 10_000}  # hostile 10x oversubscribed
+    for _ in range(50):
+        drr.replenish()
+        for t in ("good", "hostile"):
+            if backlog[t] <= 0 or drr.budget(t) <= 0:
+                continue
+            take = min(backlog[t], 120)  # one poll's worth
+            drr.charge(t, take)
+            served[t] += take
+            backlog[t] -= take
+    assert served["good"] == 120, "well-behaved tenant fully served"
+    # hostile is capped near its weight share (quantum/round + burst)
+    assert served["hostile"] <= 100 * 50 + 2 * 100
+    drr.remove("hostile")
+    assert drr.budget("hostile") == float("inf")
+
+
+# -- degradation ladder ----------------------------------------------------
+
+def _ctrl(clock, **pol):
+    m = MetricsRegistry()
+    c = OverloadController(m, clock=lambda: clock[0])
+    c.configure_tenant(TenantEngineConfig(
+        tenant="t1",
+        overload=OverloadPolicy(
+            engage_lag=100, disengage_lag=10,
+            engage_hold_s=0.5, hysteresis_s=1.0,
+            credit_lag_lo=50, credit_lag_hi=200, **pol,
+        ),
+    ))
+    return c, m
+
+
+def _lags(lag):
+    return {"sw.tenant.t1.inbound-events": {"depth": lag, "groups": {"g": lag}}}
+
+
+def test_ladder_engages_with_hold_and_disengages_with_hysteresis():
+    clock = [0.0]
+    c, m = _ctrl(clock)
+    c.refresh(_lags(500))        # above engage_lag: hold clock starts
+    assert c.level("t1") == 0
+    clock[0] = 0.6
+    c.refresh(_lags(500))        # held 0.6s ≥ 0.5s → rung 1
+    assert c.level("t1") == 1
+    assert c.degraded("t1", "sample_inference")
+    assert not c.degraded("t1", "persist_only")
+    clock[0] = 1.2
+    c.refresh(_lags(500))        # each rung needs its own hold
+    assert c.level("t1") == 2
+    assert c.degraded("t1", "persist_only")
+    # calm: disengage one rung per hysteresis period
+    clock[0] = 2.0
+    c.refresh(_lags(0))
+    assert c.level("t1") == 2
+    clock[0] = 3.1
+    c.refresh(_lags(0))
+    assert c.level("t1") == 1
+    clock[0] = 4.2
+    c.refresh(_lags(0))
+    assert c.level("t1") == 0
+    assert c.credit("t1") == 1.0
+    rep = c.report("t1")
+    assert rep["degradation_level"] == 0 and rep["active_features"] == []
+
+
+def test_credit_tracks_lag_linearly_and_feeds_under_pressure():
+    clock = [0.0]
+    c, _m = _ctrl(clock)
+    c.refresh(_lags(50))
+    assert c.credit("t1") == 1.0 and not c.under_pressure("t1")
+    c.refresh(_lags(125))
+    assert abs(c.credit("t1") - 0.5) < 1e-6
+    assert c.under_pressure("t1")
+    c.refresh(_lags(10_000))
+    assert c.credit("t1") == 0.0
+    # dead-letter/expired topics are excluded from the pressure signal
+    c.refresh({
+        "sw.tenant.t1.dead-letter.rules": {"depth": 9, "groups": {"g": 9999}},
+        "sw.tenant.t1.expired-events": {"depth": 9, "groups": {"g": 9999}},
+    })
+    assert c.credit("t1") == 1.0
+
+
+def test_between_thresholds_holds_level_and_resets_clocks():
+    clock = [0.0]
+    c, _m = _ctrl(clock)
+    c.refresh(_lags(500))
+    clock[0] = 0.6
+    c.refresh(_lags(500))
+    assert c.level("t1") == 1
+    # mid-band lag: neither engages further nor disengages, ever
+    for t in (1.0, 5.0, 60.0):
+        clock[0] = t
+        c.refresh(_lags(50))
+    assert c.level("t1") == 1
+
+
+def test_overload_policy_config_roundtrip():
+    cfg = TenantEngineConfig(
+        tenant="x",
+        overload=OverloadPolicy(weight=4.0, ladder=("persist_only",)),
+    )
+    d = tenant_config_to_dict(cfg)
+    back = tenant_config_from_dict(d)
+    assert back.overload == cfg.overload
+    assert back.overload.ladder == ("persist_only",)
+
+
+# -- tools lints -----------------------------------------------------------
+
+def test_check_queues_lint_is_clean():
+    assert check_queues.lint_queues() == []
+
+
+def test_check_queues_lint_catches_unregistered(tmp_path, monkeypatch):
+    bad = tmp_path / "sneaky.py"
+    bad.write_text("import asyncio\nq = asyncio.Queue(maxsize=4)\n")
+    monkeypatch.setattr(
+        check_queues, "_source_files",
+        lambda: sorted(check_queues.SRC_ROOT.rglob("*.py")) + [bad],
+    )
+    monkeypatch.setattr(check_queues, "SRC_ROOT", tmp_path)
+    findings = check_queues.lint_queues()
+    assert any("unregistered bounded queue" in f for f in findings)
+
+
+# -- netbus satellites -----------------------------------------------------
+
+async def test_broker_clamps_long_consume_timeout_with_metric():
+    from sitewhere_tpu.runtime.netbus import BusBrokerServer
+
+    broker = BusBrokerServer()
+    broker.bus.subscribe("t.x", "g")
+    await broker.bus.publish("t.x", 1)
+    got = await broker._dispatch("consume", ("t.x", "g", 10, 120.0))
+    assert got == [1]
+    assert broker.metrics.counter(
+        "netbus_consume_timeout_clamped_total"
+    ).value == 1
+    # ≤ cap passes unclamped (no double count)
+    await broker.bus.publish("t.x", 2)
+    await broker._dispatch("consume", ("t.x", "g", 10, 1.0))
+    assert broker.metrics.counter(
+        "netbus_consume_timeout_clamped_total"
+    ).value == 1
+
+
+async def test_remote_bus_reconnect_backoff_and_counter():
+    from sitewhere_tpu.runtime.netbus import RemoteEventBus
+
+    bus = RemoteEventBus("127.0.0.1", 1, reconnect_window_s=0.4)
+    bus._rng.seed(0)
+    # backoff grows exponentially (jitter bounded ±25%)
+    delays = [bus._backoff(a) for a in range(1, 6)]
+    for i, d in enumerate(delays, 1):
+        base = min(0.05 * 2 ** (i - 1), 2.0)
+        assert 0.7 * base <= d <= 1.3 * base
+    bus._conn_lock = asyncio.Lock()
+    with pytest.raises(ConnectionError):
+        await bus._ensure_connected()
+    snap = {
+        tuple(sorted(dict(k).items())): c.value
+        for k, c in bus.metrics._labeled.get(
+            "netbus_reconnects_total", {}
+        ).items()
+    }
+    errors = snap.get((("outcome", "error"),), 0)
+    assert errors >= 2, "should have retried (with backoff) inside the window"
+    assert snap.get((("outcome", "exhausted"),), 0) == 1
+    await bus.close()
+
+
+# -- REST surface ----------------------------------------------------------
+
+async def test_overload_rest_endpoint_reports_state():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from sitewhere_tpu.api.rest import make_app
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        tenant_config_from_template,
+    )
+    from sitewhere_tpu.services.user_management import AUTH_ADMIN
+
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="ovlrest",
+        mesh=MeshConfig(slots_per_shard=2),
+    ))
+    await inst.start()
+    client = None
+    try:
+        await inst.add_tenant(tenant_config_from_template("t1", "default"))
+        inst.users.create_user("admin", "pw", [AUTH_ADMIN])
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        resp = await client.post(
+            "/api/authapi/jwt", json={"username": "admin", "password": "pw"}
+        )
+        token = (await resp.json())["token"]
+        client._session.headers["Authorization"] = f"Bearer {token}"
+        resp = await client.get("/api/tenants/t1/overload")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["tenant"] == "t1" and body["enabled"] is True
+        assert body["credit"] == 1.0 and body["degradation_level"] == 0
+        assert body["ladder"] == [
+            "sample_inference", "persist_only", "pause_fanout"
+        ]
+        assert body["receiver"]["depth"] == 0
+        assert body["deadline_budget_ms"] == 500.0  # 2 x default slo_ms
+        resp = await client.get("/api/tenants/nope/overload")
+        assert resp.status == 404
+    finally:
+        if client is not None:
+            await client.close()
+        await inst.terminate()

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Bounded-queue observability lint.
+
+Overload control is only trustworthy if every bounded queue in the
+codebase is observable: a queue that can fill must expose a **depth
+gauge** (how full is it right now) and a **shed/expired counter** (what
+has it dropped) — otherwise shed work is invisible and "no silent loss"
+cannot be audited (docs/ROBUSTNESS.md "Overload & degradation").
+
+The lint scans ``sitewhere_tpu/`` for bounded-queue construction sites
+(``asyncio.Queue(maxsize=...)`` and ``runtime.overload``'s
+``PriorityClassQueue``) and checks each against the REGISTRY below:
+
+- every site must be registered with the metric names of its depth
+  gauge and shed/expired counter (an unregistered bounded queue is a
+  finding — register it AND wire its metrics);
+- each declared metric name must actually be referenced somewhere in
+  ``sitewhere_tpu/`` (a registry entry pointing at a metric nobody
+  emits is a finding);
+- a registry entry whose source site disappeared is a finding (stale
+  registry rots the lint).
+
+Unbounded queues (no ``maxsize``) are exempt: they surface through the
+bus lag gauges or cannot shed by construction.
+
+Used two ways, exactly like ``check_metrics.py``: standalone
+(``python tools/check_queues.py`` → exit 1 on findings) and imported by
+the tier-1 suite (``lint_queues()``).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+
+# (relative file, construction regex) → declared observability.
+# depth_gauge / shed_counter are metric family names as passed to
+# MetricsRegistry (labeled families without the exposition suffix).
+REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
+    ("pipeline/sources.py", r"PriorityClassQueue\(maxsize="): {
+        "queue": "receiver ingest queue (priority-classed admission)",
+        "depth_gauge": "receiver_queue_depth",
+        "shed_counter": "receiver_shed_total",
+    },
+    ("pipeline/media.py", r"asyncio\.Queue\(maxsize="): {
+        "queue": "media frame queue (newest-frame-wins shedding)",
+        "depth_gauge": "media_queue_depth",
+        "shed_counter": "media_frames_shed_total",
+    },
+}
+
+BOUNDED_RE = re.compile(
+    r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*=)"
+)
+
+
+def _source_files() -> List[Path]:
+    return sorted(SRC_ROOT.rglob("*.py"))
+
+
+def _metric_referenced(name: str, texts: Dict[str, str]) -> bool:
+    needle = f'"{name}"'
+    return any(needle in t or f"'{name}'" in t for t in texts.values())
+
+
+def lint_queues() -> List[str]:
+    """Scan the codebase; returns findings (empty = every bounded queue
+    is registered and observable)."""
+    findings: List[str] = []
+    texts = {
+        str(p.relative_to(SRC_ROOT)): p.read_text()
+        for p in _source_files()
+    }
+    # 1) every bounded-queue site must be registered
+    registered_files = {f for (f, _pat) in REGISTRY}
+    for rel, text in texts.items():
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if not BOUNDED_RE.search(line):
+                continue
+            if rel not in registered_files:
+                findings.append(
+                    f"{rel}:{lineno}: unregistered bounded queue "
+                    f"({line.strip()[:60]!r}) — add a tools/check_queues.py "
+                    f"REGISTRY entry with its depth gauge + shed counter"
+                )
+    # 2) registry entries must match a live site and live metrics
+    for (rel, pattern), decl in REGISTRY.items():
+        text = texts.get(rel)
+        if text is None or not re.search(pattern, text):
+            findings.append(
+                f"registry entry for {rel} ({decl['queue']}) matches no "
+                f"construction site — stale registry"
+            )
+            continue
+        for kind in ("depth_gauge", "shed_counter"):
+            name = decl[kind]
+            if not _metric_referenced(name, texts):
+                findings.append(
+                    f"{rel}: declared {kind} '{name}' is never emitted "
+                    f"anywhere in sitewhere_tpu/"
+                )
+    return findings
+
+
+def main() -> int:
+    findings = lint_queues()
+    for f in findings:
+        print(f"check_queues: {f}", file=sys.stderr)
+    print(
+        f"check_queues: {len(REGISTRY)} registered queue(s), "
+        f"{len(findings)} finding(s)"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

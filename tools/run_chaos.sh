@@ -2,10 +2,20 @@
 # Run the chaos suite: fault-injection tests that prove at-least-once
 # delivery (retry budgets, dead-letter topics, circuit breakers) under
 # drop/delay/duplicate/fail publishes, scorer crashes, and flapping
-# outbound connectors. Includes the slow chaos runs tier-1 skips.
+# outbound connectors — plus the sustained-overload scenario
+# (tests/test_overload_chaos.py): 2x sustained ingest with one 10x
+# hostile tenant, asserting per-tenant SLO isolation, fair-queue
+# throttling of the hostile tenant only, exact store/DLQ/expired
+# accounting for admitted alerts, and degradation-mode recovery after
+# the burst. Includes the slow chaos soaks tier-1 skips.
 #
 # Usage: tools/run_chaos.sh [extra pytest args...]
+#   OVERLOAD_ONLY=1 tools/run_chaos.sh   # just the overload scenario
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ "${OVERLOAD_ONLY:-}" == "1" ]]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_overload_chaos.py \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
