@@ -18,6 +18,8 @@ the on-device window state (``ops.windows``). Object-shaped events
 
 from __future__ import annotations
 
+import struct
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -31,16 +33,30 @@ from sitewhere_tpu.core.events import DeviceMeasurement
 # (object-array broadcast add) is ~5x cheaper than np.char.add + astype —
 # id generation sits on the persistence path at full ingest rate
 _ID_SUFFIXES = np.zeros((0,), object)
+# growth guard: persistence materializes ids on executor threads, so two
+# threads can race the grow-and-publish. Growth happens under the lock
+# (monotonic — a later, smaller grow can never shrink the published pool)
+# and readers slice a LOCAL reference: re-reading the global after the
+# length check could observe a concurrent swap and hand back fewer than
+# n ids, silently breaking the column-length invariant downstream.
+_ID_LOCK = threading.Lock()
 
 
 def make_event_ids(prefix: str, n: int) -> np.ndarray:
-    """object[n] ids '{prefix}{row}' — the one vectorized id generator."""
+    """object[n] ids '{prefix}{row}' — the one vectorized id generator.
+
+    Thread-safe: safe to call from executor threads at full ingest rate."""
     global _ID_SUFFIXES
-    if len(_ID_SUFFIXES) < n:
-        _ID_SUFFIXES = np.arange(
-            max(n, 2 * len(_ID_SUFFIXES), 4096)
-        ).astype("U8").astype(object)
-    return prefix + _ID_SUFFIXES[:n]
+    pool = _ID_SUFFIXES
+    if len(pool) < n:
+        with _ID_LOCK:
+            pool = _ID_SUFFIXES
+            if len(pool) < n:
+                pool = np.arange(
+                    max(n, 2 * len(pool), 4096)
+                ).astype("U8").astype(object)
+                _ID_SUFFIXES = pool
+    return prefix + pool[:n]
 
 
 @dataclass(slots=True)
@@ -460,3 +476,290 @@ class MeasurementBatch:
     def take(self, n: int) -> "tuple[MeasurementBatch, MeasurementBatch]":
         """Split into (first n rows, rest) — used by the micro-batcher."""
         return self.select(np.s_[:n]), self.select(np.s_[n:])
+
+    def __reduce__(self):
+        # every pickle of a batch (netbus frames, dlog WAL appends,
+        # checkpoint snapshots, DLQ payloads) rides the raw-buffer wire
+        # codec below: numeric columns ship as dtype-tagged raw buffers
+        # instead of per-element pickle ops, object token columns ship as
+        # (unique vocab, int32 inverse) when their group index is cheap —
+        # which also hands the CONSUMER the cached index for free
+        if not WIRE_CODEC_ENABLED:
+            # kill switch: a PLAIN class-construction pickle that builds
+            # without _batch_from_wire being allowlisted — the escape
+            # hatch for feeding frames to consumers that predate the
+            # codec (see the version notes below)
+            return (
+                MeasurementBatch,
+                (self.tenant, self.stream_ids, self.values,
+                 self.event_ts, self.received_ts, self.valid),
+                (None, {
+                    "event_ids": self.event_ids,
+                    "device_tokens": self.device_tokens,
+                    "names": self.names,
+                    "assignment_tokens": self.assignment_tokens,
+                    "area_tokens": self.area_tokens,
+                    "scores": self.scores,
+                    "id_prefix": self.id_prefix,
+                    "trace": self.trace,
+                    "trace_ctx": self.trace_ctx,
+                    "deadline_ms": self.deadline_ms,
+                }),
+            )
+        return (_batch_from_wire, (encode_batch_wire(self),))
+
+
+# ----------------------------------------------------------------------
+# Raw-buffer wire codec (the MeasurementBatch serialization hot path)
+# ----------------------------------------------------------------------
+# Frame layout (version 1):
+#   b"SWB" | version u8 | meta_len u32 | meta | raw segments
+# ``meta`` is a restricted-pickle blob (runtime.safepickle) holding the
+# scalar fields, the object-column vocabularies, and the segment table
+# [(field, nbytes), ...]; the raw segments are the numeric columns'
+# ``tobytes()`` concatenated in table order. Decode copies the segment
+# region ONCE into a bytearray and hands out writable zero-copy
+# ``np.frombuffer`` views — no per-row work on either side.
+#
+# Version 0 is the odd-shape fallback: the same envelope around a
+# restricted-pickle blob of the raw field dict. Encoders drop to it when
+# a column is out of the wire contract (wrong dtype, or a batch
+# violating its own length invariant — which must ship decodably, never
+# as a torn v1 frame that drops the peer's connection); decoders accept
+# both versions.
+#
+# Version compatibility: codec-aware consumers decode frames from OLDER
+# producers (plain class pickles) and both envelope versions. The
+# reverse — feeding a codec frame to a consumer that predates
+# ``_batch_from_wire`` on the safepickle allowlist — does NOT work;
+# for that rollback/mixed-fleet window set ``WIRE_CODEC_ENABLED=False``
+# on the producer, which switches ``__reduce__`` to a plain
+# class-construction pickle any build can load.
+
+WIRE_CODEC_ENABLED = True
+_WIRE_MAGIC = b"SWB"
+_WIRE_META = struct.Struct(">I")
+
+# field → required dtype for the raw segments (anything else falls back
+# to version 0 — the decoder REFUSES unexpected dtypes/fields outright,
+# so a tampered frame cannot smuggle object buffers through the raw path)
+_WIRE_NUMERIC = {
+    "stream_ids": np.dtype(np.int32),
+    "values": np.dtype(np.float32),
+    "event_ts": np.dtype(np.float64),
+    "received_ts": np.dtype(np.float64),
+    "valid": np.dtype(bool),
+    "scores": np.dtype(np.float32),
+    "tok_inverse": np.dtype(np.int32),
+    "name_inverse": np.dtype(np.int32),
+}
+
+
+class WireCodecError(ValueError):
+    """A torn, truncated, or out-of-contract wire frame."""
+
+
+def _wire_safepickle():
+    from sitewhere_tpu.runtime import safepickle  # lazy: no import cycle
+
+    return safepickle
+
+
+def _encode_fallback(batch: "MeasurementBatch") -> bytes:
+    fields = {
+        "tenant": batch.tenant,
+        "stream_ids": batch.stream_ids,
+        "values": batch.values,
+        "event_ts": batch.event_ts,
+        "received_ts": batch.received_ts,
+        "valid": batch.valid,
+        "event_ids": batch.event_ids,
+        "device_tokens": batch.device_tokens,
+        "names": batch.names,
+        "assignment_tokens": batch.assignment_tokens,
+        "area_tokens": batch.area_tokens,
+        "scores": batch.scores,
+        "id_prefix": batch.id_prefix,
+        "trace": batch.trace,
+        "trace_ctx": batch.trace_ctx,
+        "deadline_ms": batch.deadline_ms,
+    }
+    import pickle as _pickle
+
+    return _WIRE_MAGIC + b"\x00" + _pickle.dumps(
+        fields, protocol=_pickle.HIGHEST_PROTOCOL
+    )
+
+
+def encode_batch_wire(batch: "MeasurementBatch") -> bytes:
+    """Serialize a batch as the columnar raw-buffer frame (version 1),
+    falling back to the safepickle envelope (version 0) for batches whose
+    columns don't match the wire contract."""
+    import pickle as _pickle
+
+    if not WIRE_CODEC_ENABLED:
+        return _encode_fallback(batch)
+    numeric = [
+        ("stream_ids", batch.stream_ids),
+        ("values", batch.values),
+        ("event_ts", batch.event_ts),
+        ("received_ts", batch.received_ts),
+        ("valid", batch.valid),
+    ]
+    if batch.scores is not None:
+        numeric.append(("scores", batch.scores))
+    n = batch.n
+    for f, a in numeric:
+        # shape check included: a batch violating its own column-length
+        # invariant must ship via the fallback envelope, NOT become an
+        # undecodable frame that drops the peer's whole connection
+        if not isinstance(a, np.ndarray) or a.dtype != _WIRE_NUMERIC[f] \
+                or a.shape != (n,):
+            return _encode_fallback(batch)
+    meta: Dict[str, object] = {
+        "tenant": batch.tenant,
+        "n": batch.n,
+        "id_prefix": batch.id_prefix,
+        "trace": batch.trace,
+        "trace_ctx": batch.trace_ctx,
+        "deadline_ms": batch.deadline_ms,
+    }
+    # token/name columns ride as (vocab, int32 inverse): computing the
+    # group index here (cached on the batch — token_index memoizes) is a
+    # one-time cost the producer's own later stages reuse, and the
+    # consumer inherits the index without ever paying the string sort
+    if batch.device_tokens is not None:
+        u, inv = batch.token_index()
+        if inv.shape != (n,):
+            return _encode_fallback(batch)
+        meta["tok_uniq"] = u.tolist()
+        numeric.append(("tok_inverse", inv))
+    if batch.names is not None:
+        u, inv = batch.names_index()
+        if inv.shape != (n,):
+            return _encode_fallback(batch)
+        meta["name_uniq"] = u.tolist()
+        numeric.append(("name_inverse", inv))
+    # low-volume object columns (usually None on the scoring path)
+    obj: Dict[str, list] = {}
+    for col in ("event_ids", "assignment_tokens", "area_tokens"):
+        a = getattr(batch, col)
+        if a is not None:
+            if len(a) != n:
+                return _encode_fallback(batch)
+            obj[col] = a.tolist()
+    if obj:
+        meta["obj"] = obj
+    meta["segs"] = [(f, int(a.nbytes)) for f, a in numeric]
+    blob = _pickle.dumps(meta, protocol=_pickle.HIGHEST_PROTOCOL)
+    parts = [_WIRE_MAGIC, b"\x01", _WIRE_META.pack(len(blob)), blob]
+    parts.extend(
+        a.tobytes() if not a.flags.c_contiguous else a.data.cast("B")
+        for _f, a in numeric
+    )
+    return b"".join(parts)
+
+
+def _batch_from_wire(data: bytes) -> "MeasurementBatch":
+    """Decode one wire frame. Registered on the safepickle allowlist so
+    frames decode through the SAME restricted path as everything else;
+    every malformed shape raises (never returns a short batch)."""
+    sp = _wire_safepickle()
+    if len(data) < 4 or data[:3] != _WIRE_MAGIC:
+        raise WireCodecError("not a MeasurementBatch wire frame (bad magic)")
+    version = data[3]
+    if version == 0:
+        fields = sp.loads(data[4:])
+        if not isinstance(fields, dict) or "tenant" not in fields:
+            raise WireCodecError("malformed fallback frame")
+        return MeasurementBatch(**fields)
+    if version != 1:
+        raise WireCodecError(
+            f"unknown wire codec version {version} (this build speaks "
+            "0-1; producer must fall back to the safepickle envelope)"
+        )
+    if len(data) < 4 + _WIRE_META.size:
+        raise WireCodecError("torn frame: truncated meta header")
+    (meta_len,) = _WIRE_META.unpack_from(data, 4)
+    seg0 = 4 + _WIRE_META.size + meta_len
+    if seg0 > len(data):
+        raise WireCodecError("torn frame: meta overruns payload")
+    meta = sp.loads(data[4 + _WIRE_META.size : seg0])
+    if not isinstance(meta, dict):
+        raise WireCodecError("malformed meta")
+    try:
+        n = int(meta["n"])
+        segs = list(meta["segs"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WireCodecError(f"malformed meta: {exc}") from None
+    total = 0
+    for f, nbytes in segs:
+        dt = _WIRE_NUMERIC.get(f)
+        if dt is None:
+            raise WireCodecError(f"unexpected raw segment '{f}'")
+        if int(nbytes) != n * dt.itemsize:
+            raise WireCodecError(
+                f"torn frame: segment '{f}' is {nbytes} bytes, "
+                f"expected {n * dt.itemsize}"
+            )
+        total += int(nbytes)
+    if seg0 + total != len(data):
+        raise WireCodecError(
+            f"torn frame: {len(data) - seg0} segment bytes, expected {total}"
+        )
+    # ONE copy of the segment region; every column is a writable
+    # zero-copy view into it (scores are scatter-written downstream)
+    buf = bytearray(data[seg0:])
+    cols: Dict[str, np.ndarray] = {}
+    off = 0
+    for f, nbytes in segs:
+        dt = _WIRE_NUMERIC[f]
+        cols[f] = np.frombuffer(buf, dt, count=n, offset=off)
+        off += int(nbytes)
+
+    def vocab_col(inv_field: str, uniq_key: str) -> Optional[np.ndarray]:
+        inv = cols.get(inv_field)
+        if inv is None:
+            return None
+        uniq = meta.get(uniq_key)
+        if not isinstance(uniq, list):
+            raise WireCodecError(f"missing vocab for '{inv_field}'")
+        u = np.asarray(uniq, object) if uniq else np.zeros((0,), object)
+        if n and (inv.min() < 0 or inv.max() >= len(u)):
+            raise WireCodecError(f"'{inv_field}' index out of vocab range")
+        return u
+
+    tok_u = vocab_col("tok_inverse", "tok_uniq")
+    name_u = vocab_col("name_inverse", "name_uniq")
+    obj = meta.get("obj") or {}
+
+    def obj_col(name: str) -> Optional[np.ndarray]:
+        lst = obj.get(name)
+        if lst is None:
+            return None
+        if not isinstance(lst, list) or len(lst) != n:
+            raise WireCodecError(f"object column '{name}' length mismatch")
+        return np.asarray(lst, object) if n else np.zeros((0,), object)
+
+    return MeasurementBatch(
+        tenant=str(meta.get("tenant", "default")),
+        stream_ids=cols["stream_ids"],
+        values=cols["values"],
+        event_ts=cols["event_ts"],
+        received_ts=cols["received_ts"],
+        valid=cols["valid"],
+        event_ids=obj_col("event_ids"),
+        device_tokens=None if tok_u is None else tok_u[cols["tok_inverse"]],
+        names=None if name_u is None else name_u[cols["name_inverse"]],
+        assignment_tokens=obj_col("assignment_tokens"),
+        area_tokens=obj_col("area_tokens"),
+        scores=cols.get("scores"),
+        id_prefix=meta.get("id_prefix"),
+        trace=dict(meta.get("trace") or {}),
+        trace_ctx=meta.get("trace_ctx"),
+        deadline_ms=meta.get("deadline_ms"),
+        # the wire's chunk structure IS the group index — the consumer
+        # never pays the object-string sort (PERF_NOTES.md round 5)
+        tok_index=None if tok_u is None else (tok_u, cols["tok_inverse"]),
+        name_index=None if name_u is None else (name_u, cols["name_inverse"]),
+    )

@@ -812,7 +812,7 @@ class SiteWhereInstance(LifecycleComponent):
                     ).set(d)
             if rt.media_pipeline is not None:
                 m.gauge("media_queue_depth", tenant=token).set(
-                    rt.media_pipeline._queue.qsize()
+                    rt.media_pipeline.pending_frames()
                 )
 
     def apply_lag_gauges(self, lags: Dict[str, dict]) -> None:

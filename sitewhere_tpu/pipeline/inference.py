@@ -5,24 +5,35 @@ inbound-processing and event-management on the bus, micro-batching
 DeviceMeasurement events into JAX/XLA pjit calls on a TPU pod"
 (BASELINE.json north_star; no reference counterpart — SURVEY.md §2.3).
 
-Dataflow per scoring cycle (columnar hot path):
+Dataflow per scoring cycle (the zero-copy columnar feed path —
+docs/PERFORMANCE.md has the full stage walkthrough):
 
   inbound-events[tenant_i] ─┐  MeasurementBatch (struct-of-arrays)
-  inbound-events[tenant_j] ─┼→ lanes[(slot, data_shard)]: numpy chunks
-          ...              ─┘        │ flush on deadline_ms OR full bucket
+  inbound-events[tenant_j] ─┼→ lane RINGS[(slot, data_shard)]: rows are
+          ...              ─┘  written into preallocated numpy segments
+                                AT ENQUEUE │ flush on deadline_ms OR full
                                      ▼
-              stacked arrays i32/f32[T, D·B] (bucketed static shapes)
+              reusable staging buffers u16/bf16[T, D·B] (slice copies,
+              two rotating sets per (family, bucket) — no fresh arrays)
                                      ▼
-              ShardedScorer.step  — ONE jit call scores every tenant
+              stage_inputs — ASYNC h2d onto the step's shardings;
+              overlaps the previous flush's device compute
+                                     ▼
+              ShardedScorer.step_counts — ONE jit call, every tenant
                                      ▼ (dispatch is async; materialization
                                         happens OFF the scoring loop)
               scores scatter back into each batch's ``scores`` column
                                      ▼
               completed batches → tpu-scored-events[tenant]
 
-Two latency-hiding moves matter here (SURVEY.md §7 hard parts):
+Three latency-hiding moves matter here (SURVEY.md §7 hard parts):
 - the host side never touches per-event Python objects — rows move as
-  numpy slices end to end;
+  numpy slices end to end, and a flush is slice+pad into reusable
+  staging, never ``np.asarray`` over freshly built lists
+  (tools/check_hotpath.py lints this invariant);
+- the staged device put is issued BEFORE dispatch and is asynchronous,
+  so flush N+1's host→device transfer rides under flush N's compute
+  (``tpu_inference.h2d_overlapped`` / ``h2d_staged`` expose the ratio);
 - score materialization (device→host) is pipelined: up to
   ``max_inflight`` flushes ride concurrently, so one device round-trip
   never stalls the collect loop. p99 still lands in the
@@ -137,56 +148,149 @@ class StreamRegistry:
         return len(self._map)
 
 
-class _Lane:
-    """Pending rows for one (slot, data_shard): parallel numpy chunks."""
+class _LaneRing:
+    """Pending rows for one (slot, data_shard): a preallocated numpy ring.
 
-    __slots__ = ("ids", "vals", "seqs", "rows", "count")
+    Rows are written into fixed-dtype ring segments at enqueue time
+    (``push`` — slice assignment, no per-row Python, no per-enqueue
+    allocation) and leave either straight into a flush's reusable staging
+    buffers (``pop_into``) or as fresh arrays on the cold paths (``pop``:
+    drain / park / breaker / failover). Capacity doubles when an intake
+    burst overshoots — the per-tenant lane watermark bounds steady-state
+    depth, so growth is rare and amortized.
+    """
 
-    def __init__(self) -> None:
-        self.ids: List[np.ndarray] = []    # int32 local stream ids
-        self.vals: List[np.ndarray] = []   # float32 values
-        self.seqs: List[np.ndarray] = []   # int64 batch sequence numbers
-        self.rows: List[np.ndarray] = []   # int32 row index inside the batch
+    COLS = ("ids", "vals", "seqs", "rows")
+    __slots__ = COLS + ("head", "count")
+
+    def __init__(self, capacity: int = 4096) -> None:
+        cap = max(64, int(capacity))
+        self.ids = np.empty((cap,), np.int32)   # local stream ids
+        self.vals = np.empty((cap,), np.float32)
+        self.seqs = np.empty((cap,), np.int64)  # batch sequence numbers
+        self.rows = np.empty((cap,), np.int32)  # row index inside the batch
+        self.head = 0
         self.count = 0
 
-    def append(self, ids, vals, seqs, rows) -> None:
-        self.ids.append(ids)
-        self.vals.append(vals)
-        self.seqs.append(seqs)
-        self.rows.append(rows)
-        self.count += len(ids)
+    @property
+    def capacity(self) -> int:
+        return len(self.ids)
+
+    def _grow(self, need: int) -> None:
+        cap = self.capacity
+        new_cap = cap
+        while new_cap < need:
+            new_cap *= 2
+        k = self.count
+        first = min(k, cap - self.head)
+        for name in self.COLS:
+            old = getattr(self, name)
+            new = np.empty((new_cap,), old.dtype)
+            new[:first] = old[self.head : self.head + first]
+            new[first:k] = old[: k - first]
+            setattr(self, name, new)
+        self.head = 0
+
+    def push(self, ids, vals, seq, rows) -> None:
+        """Append rows. ``seq`` may be a scalar (the per-enqueue common
+        case — broadcast into the ring, no per-batch full() array)."""
+        n = len(ids)
+        if self.count + n > self.capacity:
+            self._grow(self.count + n)
+        cap = self.capacity
+        tail = (self.head + self.count) % cap
+        first = min(n, cap - tail)
+        second = n - first
+        self.ids[tail : tail + first] = ids[:first]
+        self.vals[tail : tail + first] = vals[:first]
+        self.rows[tail : tail + first] = rows[:first]
+        if np.ndim(seq):
+            self.seqs[tail : tail + first] = seq[:first]
+        else:
+            self.seqs[tail : tail + first] = seq
+        if second:
+            self.ids[:second] = ids[first:]
+            self.vals[:second] = vals[first:]
+            self.rows[:second] = rows[first:]
+            self.seqs[:second] = seq[first:] if np.ndim(seq) else seq
+        self.count += n
+
+    def pop_into(
+        self, k: int, ids_row, vals_row, col0: int, seqs_out, rows_out, off: int
+    ) -> None:
+        """Move k rows FIFO off the front, straight into one slot's
+        staging views (``ids_row``/``vals_row`` at column ``col0`` — the
+        dtype cast to the scorer's wire happens inside the slice write)
+        and the flush's bookkeeping arrays at offset ``off``. At most two
+        slice copies per column; zero intermediate arrays."""
+        h, cap = self.head, self.capacity
+        first = min(k, cap - h)
+        second = k - first
+        ids_row[col0 : col0 + first] = self.ids[h : h + first]
+        vals_row[col0 : col0 + first] = self.vals[h : h + first]
+        seqs_out[off : off + first] = self.seqs[h : h + first]
+        rows_out[off : off + first] = self.rows[h : h + first]
+        if second:
+            ids_row[col0 + first : col0 + k] = self.ids[:second]
+            vals_row[col0 + first : col0 + k] = self.vals[:second]
+            seqs_out[off + first : off + k] = self.seqs[:second]
+            rows_out[off + first : off + k] = self.rows[:second]
+        self.head = (h + k) % cap
+        self.count -= k
 
     def pop(self, n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Take up to n rows off the front (FIFO across chunks)."""
-        take_i, take_v, take_s, take_r = [], [], [], []
-        got = 0
-        while got < n and self.ids:
-            head = self.ids[0]
-            need = n - got
-            if len(head) <= need:
-                take_i.append(self.ids.pop(0))
-                take_v.append(self.vals.pop(0))
-                take_s.append(self.seqs.pop(0))
-                take_r.append(self.rows.pop(0))
-                got += len(head)
-            else:
-                take_i.append(head[:need])
-                take_v.append(self.vals[0][:need])
-                take_s.append(self.seqs[0][:need])
-                take_r.append(self.rows[0][:need])
-                self.ids[0] = head[need:]
-                self.vals[0] = self.vals[0][need:]
-                self.seqs[0] = self.seqs[0][need:]
-                self.rows[0] = self.rows[0][need:]
-                got = n
-        self.count -= got
-        cat = np.concatenate
-        return (
-            cat(take_i) if take_i else np.zeros(0, np.int32),
-            cat(take_v) if take_v else np.zeros(0, np.float32),
-            cat(take_s) if take_s else np.zeros(0, np.int64),
-            cat(take_r) if take_r else np.zeros(0, np.int32),
-        )
+        """Take up to n rows off the front as fresh arrays (cold paths)."""
+        k = min(int(n), self.count)
+        h, cap = self.head, self.capacity
+        first = min(k, cap - h)
+        out = []
+        for name in self.COLS:
+            a = getattr(self, name)
+            dst = np.empty((k,), a.dtype)
+            dst[:first] = a[h : h + first]
+            if k > first:
+                dst[first:] = a[: k - first]
+            out.append(dst)
+        self.head = (h + k) % cap
+        self.count -= k
+        return tuple(out)
+
+
+class _StagingSet:
+    """One reusable flush staging set: ids/vals ``[T, D*B]`` in the
+    scorer's wire dtypes, lane counts ``[T, D]``, and a cached column
+    arange. A flush packs lanes into these buffers in place (no fresh
+    ``np.zeros`` per flush) and ``jax.device_put``s them; ``staged``
+    pins the device arrays from this set's LAST put — the async h2d copy
+    reads the host buffers, so reuse must wait on it (two sets rotating
+    per (family, bucket) normally hides that wait entirely)."""
+
+    __slots__ = ("ids", "vals", "counts", "arange", "staged")
+
+    def __init__(self, scorer, b_lane: int) -> None:
+        t, d = scorer.n_slots, scorer.mm.n_data_shards
+        self.ids = np.zeros((t, d * b_lane), scorer.ids_np_dtype)
+        self.vals = np.zeros((t, d * b_lane), scorer.vals_np_dtype)
+        self.counts = np.zeros((t, d), np.int32)
+        self.arange = np.arange(d * b_lane, dtype=np.int32)
+        self.staged = None
+
+    def ensure_reusable(self, metrics) -> None:
+        """Block until this set's previous device copy finished (counted;
+        with overlap working the transfer is long done by recycle time)."""
+        staged = self.staged
+        if staged is None:
+            return
+        self.staged = None
+        try:
+            if all(a.is_ready() for a in staged):
+                return
+            metrics.counter("tpu_inference.stage_reuse_waits").inc()
+            for a in staged:
+                a.block_until_ready()
+        except Exception:  # noqa: BLE001 - non-jax arrays (tests) or a
+            # dead device buffer (failover mid-rotation): treat as free
+            pass
 
 
 class TpuInferenceEngine(TenantEngine):
@@ -289,6 +393,7 @@ class TpuInferenceService(MultitenantService):
         tracer=None,
         overload=None,
         fair_quantum: int = 4096,
+        staging_slots: int = 2,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
@@ -320,7 +425,15 @@ class TpuInferenceService(MultitenantService):
         # per-family circuit breaker over scorer dispatch+materialization
         # (the first tenant's FaultTolerancePolicy pins it, like wire_dtype)
         self.breakers: Dict[str, CircuitBreaker] = {}
-        self._lanes: Dict[str, Dict[Tuple[int, int], _Lane]] = {}
+        self._lanes: Dict[str, Dict[Tuple[int, int], _LaneRing]] = {}
+        # reusable flush staging: (family, bucket) → [next_idx, sets];
+        # ``staging_slots`` sets rotate so flush N+1 packs host buffers
+        # while flush N's async h2d copy is still in flight
+        self.staging_slots = max(2, int(staging_slots))
+        self._staging: Dict[Tuple[str, int], list] = {}
+        # per-family last dispatch output — the overlap probe (next
+        # flush's staging "overlapped" ⇔ this is still computing)
+        self._last_scores: Dict[str, object] = {}
         self._first_pending_ts: Dict[str, float] = {}
         self._loop_super: Optional[SupervisedTask] = None
         # batch registry: seq → [batch, rows_awaiting_scores]
@@ -503,18 +616,24 @@ class TpuInferenceService(MultitenantService):
             # batch) — publish now or the registry entry leaks forever
             await self._publish_batch(seq)
             return
-        rows_all = np.arange(n, dtype=np.int32)
-        seqs_all = np.full((n,), seq, np.int64)
         for d in range(self.mm.n_data_shards):
             sel = np.nonzero(dshards == d)[0]
             if sel.size == 0:
                 continue
             lane = lanes.get((slot, d))
             if lane is None:
-                lane = lanes[(slot, d)] = _Lane()
-            lane.append(
-                locals_[sel], batch.values[sel], seqs_all[sel], rows_all[sel]
-            )
+                # sized to the lane watermark (2× max_batch split across
+                # data shards) so steady state never reallocates
+                lane = lanes[(slot, d)] = _LaneRing(
+                    max(
+                        4096,
+                        2 * engine.config.microbatch.max_batch
+                        // max(1, self.mm.n_data_shards),
+                    )
+                )
+            # sel doubles as the row indices inside the batch; seq
+            # broadcasts — rows land in the ring right here, at enqueue
+            lane.push(locals_[sel], batch.values[sel], seq, sel)
         if family not in self._first_pending_ts:
             self._first_pending_ts[family] = time.monotonic()
 
@@ -616,9 +735,26 @@ class TpuInferenceService(MultitenantService):
                 return min(b, max_batch)
         return max_batch
 
+    def _staging_set(self, family: str, scorer, b_lane: int) -> _StagingSet:
+        """Next rotating staging set for (family, bucket) — created once,
+        reused for the lifetime of the shape."""
+        key = (family, b_lane)
+        rot = self._staging.get(key)
+        if rot is None:
+            rot = self._staging[key] = [
+                0, [_StagingSet(scorer, b_lane) for _ in range(self.staging_slots)],
+            ]
+        idx, sets = rot
+        rot[0] = (idx + 1) % len(sets)
+        st = sets[idx]
+        st.ensure_reusable(self.metrics)
+        return st
+
     async def _flush_family(self, engine_cfgs: Dict[int, TenantEngineConfig], family: str) -> int:
-        """Build the stacked batch for one family, dispatch the jit step,
-        and hand score materialization to a pipelined delivery task."""
+        """Pack one family's lane rings into a reusable staging set,
+        stage the buffers to device (async h2d — overlaps any in-flight
+        flush's dispatch), dispatch the jit step, and hand score
+        materialization to a pipelined delivery task."""
         scorer = self.scorers[family]
         lanes = self._lanes[family]
         if family in self._parked:
@@ -667,31 +803,44 @@ class TpuInferenceService(MultitenantService):
         # bigger flush, not drain at the stale pre-wait size
         pending_max = max((l.count for l in lanes.values()), default=0)
         b_lane = self._pick_bucket(pending_max, tuple(mb.buckets), mb.max_batch)
-        t, d = scorer.n_slots, self.mm.n_data_shards
         # wire-thin stacked batch: compact id/value dtypes + one count per
         # (slot, data-shard) lane instead of a bool mask — rows fill each
         # lane from the front, so validity is derivable on device (see
-        # ShardedScorer.step_counts; h2d bytes are a first-class budget)
-        ids = np.zeros((t, d * b_lane), scorer.ids_np_dtype)
-        vals = np.zeros((t, d * b_lane), scorer.vals_np_dtype)
-        counts = np.zeros((t, d), np.int32)
-        tk_slots, tk_cols, tk_seqs, tk_rows = [], [], [], []
+        # ShardedScorer.step_counts; h2d bytes are a first-class budget).
+        # Assembly is slice copies lane-ring → REUSABLE staging buffers:
+        # no fresh flush arrays, no list accumulators, no np.asarray over
+        # Python lists (tools/check_hotpath.py enforces this stays true).
+        t_asm = time.perf_counter()
+        st = self._staging_set(family, scorer, b_lane)
+        ids, vals, counts = st.ids, st.vals, st.counts
+        counts[:] = 0
+        take_total = 0
+        for lane in lanes.values():
+            take_total += min(lane.count, b_lane)
+        slots_cat = np.empty((take_total,), np.int32)
+        cols_cat = np.empty((take_total,), np.int32)
+        seqs_cat = np.empty((take_total,), np.int64)
+        rows_cat = np.empty((take_total,), np.int32)
         moved = 0
+        used_slots: set = set()
         for (slot, dshard), lane in list(lanes.items()):
-            if lane.count == 0:
+            k = min(lane.count, b_lane)
+            if k == 0:
                 continue
-            li, lv, ls, lr = lane.pop(b_lane)
-            k = len(li)
             base = dshard * b_lane
-            ids[slot, base : base + k] = li
-            vals[slot, base : base + k] = lv
+            lane.pop_into(k, ids[slot], vals[slot], base, seqs_cat, rows_cat, moved)
+            slots_cat[moved : moved + k] = slot
+            cols_cat[moved : moved + k] = st.arange[base : base + k]
             counts[slot, dshard] = k
-            tk_slots.append(np.full((k,), slot, np.int32))
-            tk_cols.append(np.arange(base, base + k, dtype=np.int32))
-            tk_seqs.append(ls)
-            tk_rows.append(lr)
+            used_slots.add(slot)
             moved += k
-        if any(l.count for l in lanes.values()):
+        depth_left = 0
+        for lane in lanes.values():
+            depth_left += lane.count
+        self.metrics.gauge("tpu_inference_lane_rows", family=family).set(
+            depth_left
+        )
+        if depth_left:
             self._first_pending_ts[family] = time.monotonic()
         else:
             self._first_pending_ts.pop(family, None)
@@ -700,20 +849,51 @@ class TpuInferenceService(MultitenantService):
             if breaker is not None:
                 breaker.release_trial()  # allowed, but no call was made
             return 0
-
-        slots_cat = np.concatenate(tk_slots)
-        taken = (
-            slots_cat,
-            np.concatenate(tk_cols),
-            np.concatenate(tk_seqs),
-            np.concatenate(tk_rows),
+        self.metrics.histogram("tpu_inference.flush_assembly", unit="s").record(
+            time.perf_counter() - t_asm
         )
+
+        taken = (slots_cat, cols_cat, seqs_cat, rows_cat)
         shape_key = (family, b_lane)
         compiling = shape_key not in self._seen_shapes
         try:
+            # h2d prefetch: issue the ASYNC device copy before dispatch.
+            # "Overlapped" is measured honestly: the previous flush's
+            # dispatch output is not yet ready ⇔ this staging copy rides
+            # under genuinely in-flight device compute (a pending deliver
+            # task alone could just be awaiting its publish).
+            prev_scores = self._last_scores.get(family)
+            try:
+                overlapped = (
+                    prev_scores is not None and not prev_scores.is_ready()
+                )
+            except Exception:  # noqa: BLE001 - monkeypatched scorers
+                overlapped = bool(self._deliver_tasks)
+            t_stage = time.perf_counter()
+            stage = getattr(scorer, "stage_inputs", None)
+            if stage is not None:
+                staged = stage(ids, vals, counts)
+                st.staged = staged
+            else:  # monkeypatched/minimal scorers (tests)
+                staged = (ids, vals, counts)
+            self.metrics.histogram("tpu_inference.h2d_stage", unit="s").record(
+                time.perf_counter() - t_stage
+            )
+            self.metrics.counter("tpu_inference.h2d_staged").inc()
+            if overlapped:
+                self.metrics.counter("tpu_inference.h2d_overlapped").inc()
+            try:
+                self.metrics.counter("tpu_inference.staged_bytes").inc(
+                    scorer.stage_nbytes(staged)
+                )
+            except Exception:  # noqa: BLE001 - observability only
+                pass
             t_disp = time.perf_counter()
             with _profiler_annotation(self.profile_annotations, family):
-                scores_dev = scorer.step_counts(ids, vals, counts)  # async dispatch
+                scores_dev = scorer.step_counts(*staged)  # async dispatch
+            # overlap probe for the NEXT flush (holds ~1 flush of device
+            # score memory per family until then)
+            self._last_scores[family] = scores_dev
             dispatch_s = time.perf_counter() - t_disp
             self.metrics.histogram("tpu_inference.dispatch", unit="s").record(
                 dispatch_s
@@ -743,15 +923,13 @@ class TpuInferenceService(MultitenantService):
             # d2h diet: when ONE slot carries this flush's rows (the common
             # single-tenant-per-family case), slice that row on device and
             # materialize 1×lane instead of the full T×lane score plane.
-            # Restricted to len(used)==1 so the gather has ONE shape per
+            # Restricted to one used slot so the gather has ONE shape per
             # bucket — prewarm compiles it; arbitrary used-counts would
             # compile mid-loop and stall the pipeline
-            used = np.unique(slots_cat)
-            if len(used) == 1 and t > 1:
-                scores_dev = scores_dev[used]
-                taken = (
-                    np.zeros_like(slots_cat),
-                ) + taken[1:]
+            if len(used_slots) == 1 and scorer.n_slots > 1:
+                only = next(iter(used_slots))
+                scores_dev = scores_dev[np.full((1,), only, np.int32)]
+                slots_cat[:] = 0  # rows now index row 0 of the slice
         except Exception as exc:  # noqa: BLE001 - a failing scorer must
             # not strand popped rows or kill the loop; repeated failures
             # trigger shard failover
@@ -809,6 +987,7 @@ class TpuInferenceService(MultitenantService):
             )
             self.metrics.counter("tpu_inference.parked").inc()
             return
+        self._last_scores.pop(family, None)  # may reference dead buffers
         scorer = self.scorers.get(family)
         if scorer is not None:
             try:
@@ -879,11 +1058,8 @@ class TpuInferenceService(MultitenantService):
                 if dst is None:
                     lanes[(new_slot, d)] = lane
                 else:
-                    dst.ids += lane.ids
-                    dst.vals += lane.vals
-                    dst.seqs += lane.seqs
-                    dst.rows += lane.rows
-                    dst.count += lane.count
+                    li, lv, ls, lr = lane.pop(lane.count)
+                    dst.push(li, lv, ls, lr)
         self.metrics.counter("tpu_inference.failovers").inc()
         return True
 

@@ -8,10 +8,22 @@ leg, reusing the micro-batching playbook from ``pipeline.inference``
 (bucketed static shapes, collect deadline, pipelined materialization off
 the event loop).
 
+Zero-copy feed path (docs/PERFORMANCE.md): decoded frames land directly
+in a preallocated uint8 frame ring (``_FrameRing``) at submit time — no
+per-frame array allocation, no Python list of frames. Each micro-batch
+is ONE contiguous slice copy ring → a pooled staging buffer, and the
+classify leg receives that contiguous buffer whole, so the host→device
+transfer is a single contiguous put per flush. ``max_inflight`` staging
+buffers rotate through in-flight classifies, so batch N+1's transfer
+overlaps batch N's device compute — the same double-buffering scheme as
+the scoring flush path. This is what closes the frames/s gap between
+the model-only and end-to-end ViT numbers on transfer-bound links.
+
 Chunk kinds:
-- ``raw-rgb8``: H*W*3 uint8 bytes (raw camera feed) — np.frombuffer, no
-  per-pixel Python;
-- ``jpeg``/``png``: decoded via PIL on an executor thread (CPU-bound).
+- ``raw-rgb8``: H*W*3 uint8 bytes (raw camera feed) — one memcpy
+  straight into the ring slot, no per-pixel Python;
+- ``jpeg``/``png``: decoded via PIL on an executor thread (CPU-bound),
+  then copied into the ring slot on the loop thread.
 """
 
 from __future__ import annotations
@@ -36,6 +48,69 @@ def media_classifications_topic(bus: EventBus, tenant: str) -> str:
     return bus.naming.tenant_topic(tenant, "media-classifications")
 
 
+class _FrameRing:
+    """Preallocated decoded-frame ring for one media pipeline.
+
+    Frames are written into a fixed ``uint8[cap, H, W, 3]`` buffer at
+    submit time (``reserve``/``commit``); each micro-batch leaves as ONE
+    contiguous slice copy into a pooled staging buffer (``pop_into``) —
+    a single contiguous device put per flush, never ``np.stack`` over a
+    Python list of frames. Live-video semantics: newest frame wins — a
+    full ring sheds its OLDEST pending frame (``media_frames_shed_total``)
+    instead of backpressuring the camera feed into the transport layer.
+    Depth surfaces per tenant through the ``media_queue_depth`` gauge
+    (collected in ``instance.py``; tools/check_queues.py registry).
+    """
+
+    __slots__ = ("frames", "meta", "head", "count", "data_event", "metrics")
+
+    def __init__(self, capacity: int, size: int, metrics) -> None:
+        self.frames = np.empty((capacity, size, size, 3), np.uint8)
+        self.meta: List = [None] * capacity  # (stream_id, seq, t0)
+        self.head = 0
+        self.count = 0
+        self.data_event = asyncio.Event()
+        self.metrics = metrics
+
+    @property
+    def capacity(self) -> int:
+        return len(self.meta)
+
+    def qsize(self) -> int:
+        return self.count
+
+    def reserve(self) -> np.ndarray:
+        """The next write slot's frame view — fill it, then ``commit``.
+        A full ring sheds its oldest pending frame first (counted)."""
+        if self.count >= self.capacity:
+            self.head = (self.head + 1) % self.capacity
+            self.count -= 1
+            self.metrics.counter("media_frames_shed_total").inc()
+        return self.frames[(self.head + self.count) % self.capacity]
+
+    def commit(self, stream_id: str, seq: int, t0: float) -> None:
+        self.meta[(self.head + self.count) % self.capacity] = (
+            stream_id, seq, t0,
+        )
+        self.count += 1
+        self.data_event.set()
+
+    def pop_into(self, staging: np.ndarray, max_n: int) -> List[Tuple]:
+        """Move up to ``max_n`` frames off the front into ``staging`` with
+        one slice copy; returns their metas. Bounded by the contiguous
+        span at the head — a wrap remainder rides the next batch (keeps
+        every copy a single contiguous memcpy)."""
+        k = min(self.count, max_n, self.capacity - self.head)
+        if k <= 0:
+            return []
+        h = self.head
+        staging[:k] = self.frames[h : h + k]
+        metas = self.meta[h : h + k]
+        self.head = (h + k) % self.capacity
+        self.count -= k
+        return metas
+
+
 class MediaClassificationPipeline(LifecycleComponent):
     """Per-tenant micro-batched frame classifier over the media service."""
 
@@ -51,6 +126,11 @@ class MediaClassificationPipeline(LifecycleComponent):
         tiny: bool = False,          # tiny ViT for CI; B/16 in prod/bench
         max_inflight: int = 4,
         store_chunks: bool = True,
+        # 256 frames ≈ 38 MB at 224×224×3 — the write cursor cycles the
+        # whole ring over time, so capacity bounds RESIDENT memory per
+        # tenant, not just backlog; live video (newest-wins shedding)
+        # never usefully holds more than a few classify batches anyway
+        ring_capacity: int = 256,
     ) -> None:
         super().__init__(f"media-pipeline[{tenant}]")
         self.tenant = tenant
@@ -62,10 +142,34 @@ class MediaClassificationPipeline(LifecycleComponent):
         self.top_k = top_k
         self.tiny = tiny
         self.store_chunks = store_chunks
-        self._queue: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self.max_inflight = max_inflight
+        self._ring = _FrameRing(ring_capacity, self.image_size, self.metrics)
+        # pooled staging buffers: one per in-flight classify (+1 for the
+        # batch being packed) so a buffer is never rewritten while its
+        # classify still reads it; sized lazily to the CURRENT max_batch
+        # (benches retune max_batch after construction)
+        from collections import deque
+
+        self._staging_pool: deque = deque()
         self._task: Optional[asyncio.Task] = None
         self._inflight = asyncio.Semaphore(max_inflight)
         self._deliver_tasks: set = set()
+
+    def pending_frames(self) -> int:
+        """Decoded frames awaiting classification (media_queue_depth)."""
+        return self._ring.qsize()
+
+    def _checkout_staging(self) -> np.ndarray:
+        while self._staging_pool:
+            buf = self._staging_pool.popleft()
+            if buf.shape[0] >= self.max_batch:
+                return buf
+        size = self.image_size
+        return np.empty((self.max_batch, size, size, 3), np.uint8)
+
+    def _return_staging(self, buf: np.ndarray) -> None:
+        if len(self._staging_pool) <= self.max_inflight:
+            self._staging_pool.append(buf)
 
     # -- ingest -----------------------------------------------------------
     @property
@@ -82,33 +186,23 @@ class MediaClassificationPipeline(LifecycleComponent):
         kind: str = "raw-rgb8",
     ) -> None:
         """One camera chunk: persisted to the stream store (playback
-        parity) and queued for classification."""
+        parity) and decoded STRAIGHT INTO the frame ring — one memcpy,
+        zero per-frame array allocation (shed-oldest when full)."""
         if self.store_chunks:
             self.media.append_chunk(stream_id, seq, data)
         size = self.image_size
         if kind == "raw-rgb8":
+            # validate BEFORE reserving a ring slot (a short chunk is the
+            # caller's error and must not consume/shear ring state)
             frame = self._decode_raw(data, size)
         else:  # jpeg/png: PIL decode is CPU-bound — off the loop. u8 so
             # every frame shares the on-device normalization path
             frame = await asyncio.get_running_loop().run_in_executor(
                 None, self.media.decode_frame, data, size, "u8"
             )
-        item = (stream_id, seq, frame, time.monotonic())
-        try:
-            self._queue.put_nowait(item)
-        except asyncio.QueueFull:
-            # live video: newest frame wins — shed the oldest queued
-            # frame (counted) instead of backpressuring the camera feed
-            # into the REST/transport layer
-            try:
-                self._queue.get_nowait()
-            except asyncio.QueueEmpty:  # pragma: no cover - racing consumer
-                pass
-            self.metrics.counter("media_frames_shed_total").inc()
-            try:
-                self._queue.put_nowait(item)
-            except asyncio.QueueFull:  # pragma: no cover - racing producer
-                self.metrics.counter("media_frames_shed_total").inc()
+        # reserve+commit run on the loop thread (no await between them)
+        self._ring.reserve()[...] = frame
+        self._ring.commit(stream_id, seq, time.monotonic())
 
     @staticmethod
     def _decode_raw(data: bytes, size: int) -> np.ndarray:
@@ -169,50 +263,64 @@ class MediaClassificationPipeline(LifecycleComponent):
         topic = media_classifications_topic(self.bus, self.tenant)
         frames_ctr = self.metrics.counter("media.frames_classified")
         lat = self.metrics.histogram("media.latency", unit="s")
+        ring = self._ring
         while True:
-            first = await self._queue.get()
-            batch = [first]
+            # wait for the first frame (clear-then-recheck: a commit
+            # between the count check and the clear must not be missed)
+            while ring.count == 0:
+                ring.data_event.clear()
+                if ring.count:
+                    break
+                await ring.data_event.wait()
             deadline = time.monotonic() + self.deadline_ms / 1000.0
-            while len(batch) < self.max_batch:
+            while ring.count < self.max_batch:
                 timeout = deadline - time.monotonic()
                 if timeout <= 0:
                     break
+                ring.data_event.clear()
+                if ring.count >= self.max_batch:
+                    break
                 try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), timeout)
-                    )
+                    await asyncio.wait_for(ring.data_event.wait(), timeout)
                 except asyncio.TimeoutError:
                     break
             await self._inflight.acquire()
+            # the batch leaves the ring as ONE contiguous slice copy into
+            # a pooled staging buffer the classify task owns until done
+            staging = self._checkout_staging()
+            metas = ring.pop_into(staging, self.max_batch)
+            if not metas:
+                self._inflight.release()
+                self._return_staging(staging)
+                continue
             task = asyncio.create_task(
-                self._classify_and_publish(batch, topic, frames_ctr, lat)
+                self._classify_and_publish(staging, metas, topic, frames_ctr, lat)
             )
             self._deliver_tasks.add(task)
             task.add_done_callback(self._deliver_tasks.discard)
 
     async def _classify_and_publish(
-        self, batch: List[Tuple], topic: str, frames_ctr, lat
+        self, staging: np.ndarray, metas: List[Tuple], topic: str, frames_ctr, lat
     ) -> None:
         try:
-            frames = np.stack([b[2] for b in batch])
-            # pad to the smallest fitting bucket shape; padded rows are
-            # sliced off the results
-            n = len(batch)
+            # smallest fitting bucket shape; rows past n are whatever the
+            # staging buffer held before (valid pixel data, results
+            # sliced off) — no pad allocation, no concatenate
+            n = len(metas)
             bucket = next(b for b in self._buckets() if b >= n)
-            if n < bucket:
-                frames = np.concatenate([
-                    frames,
-                    np.zeros((bucket - n,) + frames.shape[1:], frames.dtype),
-                ])
             # jit dispatch + materialization off the loop (the classify
             # output is a jit result nothing donates — worker-thread
-            # materialization is safe, see checkpoint.host_copy_params)
+            # materialization is safe, see checkpoint.host_copy_params).
+            # staging[:bucket] is one contiguous buffer → one contiguous
+            # host→device put; concurrent classifies on pooled buffers
+            # overlap transfer with the previous batch's compute
             results = await asyncio.get_running_loop().run_in_executor(
-                None, self.media.classify_frames, frames, self.top_k, self.tiny
+                None, self.media.classify_frames, staging[:bucket],
+                self.top_k, self.tiny,
             )
             now_mono = time.monotonic()
             now = time.time() * 1000.0
-            for (stream_id, seq, _f, t0), top in zip(batch, results[:n]):
+            for (stream_id, seq, t0), top in zip(metas, results[:n]):
                 payload = {
                     "type": "media_classification",
                     "tenant": self.tenant,
@@ -234,3 +342,4 @@ class MediaClassificationPipeline(LifecycleComponent):
             self._record_error("classify", exc)
         finally:
             self._inflight.release()
+            self._return_staging(staging)

@@ -19,6 +19,14 @@ Redesign notes: single-process asyncio replaces brokers; payloads are
 arbitrary Python objects (columnar ``MeasurementBatch`` on the hot path — no
 serialization cost in-proc). A Kafka-backed implementation can slot in behind
 the same interface later.
+
+Serialization contract for remote/durable backends (netbus, dlog WAL,
+checkpoint snapshots): payloads serialize with plain pickle and MUST
+deserialize through ``runtime.safepickle``. Hot-path payload classes may
+define ``__reduce__`` to control their wire shape — ``MeasurementBatch``
+rides a raw-buffer columnar codec this way (``core.batch``), so every
+backend that pickles payloads gets the zero-copy feed format without
+bus-level special-casing.
 """
 
 from __future__ import annotations

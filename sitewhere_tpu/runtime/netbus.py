@@ -16,6 +16,11 @@ containers, numpy reconstruction, and ``sitewhere_tpu.*`` classes load —
 a compromised peer or tampered frame cannot smuggle an
 arbitrary-constructor gadget. Payloads are arbitrary framework objects
 (columnar ``MeasurementBatch`` on the hot path) exactly as in-proc.
+Batches inside the pickle stream ride the raw-buffer wire codec
+(``core.batch``): numeric columns as dtype-tagged raw buffers, token
+columns as (vocab, int32 inverse) — so the consumer decodes a batch with
+one buffer copy, inherits the group indexes for free, and never pays
+per-row pickle ops (docs/PERFORMANCE.md "Raw-buffer wire codec").
 
 Protocol: requests ``(req_id, op, args)``; responses ``(req_id, ok,
 value)``. ``req_id is None`` marks fire-and-forget (no response) — used
@@ -64,7 +69,15 @@ class FrameTooLargeError(ValueError):
     turns that into a per-call error naming the offending topic."""
 
 
-def _dump(obj: Any, topic: Optional[str] = None) -> bytes:
+def _dump(obj: Any, topic: Optional[str] = None) -> Tuple[bytes, bytes]:
+    """Serialize one frame as ``(length-header, payload)``.
+
+    ``MeasurementBatch`` payloads ride the raw-buffer wire codec
+    (``core.batch.MeasurementBatch.__reduce__``): numeric columns are
+    dtype-tagged raw buffers inside the pickle stream instead of
+    per-element pickle ops. The two parts go out via ``writelines`` so a
+    large payload is never re-copied into one contiguous
+    header+payload bytes object."""
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     if len(data) > MAX_FRAME:
         where = f" for topic '{topic}'" if topic else ""
@@ -73,7 +86,7 @@ def _dump(obj: Any, topic: Optional[str] = None) -> bytes:
             f"MAX_FRAME ({MAX_FRAME} bytes); the peer would drop the "
             f"connection"
         )
-    return _LEN.pack(len(data)) + data
+    return _LEN.pack(len(data)), data
 
 
 def _publish_topic(op: str, args: tuple) -> Optional[str]:
@@ -181,7 +194,7 @@ class BusBrokerServer(LifecycleComponent):
             frame = _dump((req_id, False, f"{type(exc).__name__}: {exc}"))
             self._record_error(op, exc)
         async with write_lock:
-            writer.write(frame)
+            writer.writelines(frame)
             await writer.drain()
 
     async def _dispatch(self, op: str, args: tuple) -> Any:
@@ -311,7 +324,9 @@ class RemoteEventBus:
         # re-register group cursors: a durable broker already has them on
         # disk (subscribe is then a no-op), a fresh one needs them back
         for topic, group, at in self._subs:
-            self._writer.write(_dump((None, "subscribe", (topic, group, at))))
+            self._writer.writelines(
+                _dump((None, "subscribe", (topic, group, at)))
+            )
 
     # reconnect backoff: first retry after RECONNECT_BASE_S, doubling to
     # RECONNECT_MAX_S, each delay jittered ±RECONNECT_JITTER — a fleet of
@@ -424,7 +439,7 @@ class RemoteEventBus:
             fut: asyncio.Future = loop.create_future()
             self._futures[req_id] = fut
             try:
-                self._writer.write(frame)
+                self._writer.writelines(frame)
                 await self._writer.drain()
                 return await fut
             except ConnectionError:
@@ -447,7 +462,7 @@ class RemoteEventBus:
         frame = _dump((None, op, args), _publish_topic(op, args))
         if self._writer is None:
             return
-        self._writer.write(frame)
+        self._writer.writelines(frame)
 
     # -- EventBus surface -------------------------------------------------
     async def publish(self, topic: str, payload: Any, key: Any = None) -> int:

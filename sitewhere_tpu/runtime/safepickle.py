@@ -58,6 +58,12 @@ _SAFE_EXACT = {
     ("collections", "OrderedDict"),
     ("collections", "deque"),
     ("_codecs", "encode"),  # numpy string-array reconstruction uses it
+    # the ONE admitted module-level function: MeasurementBatch's raw-buffer
+    # wire decoder (core/batch.py __reduce__). It parses dtype-tagged
+    # buffers with strict length/vocab validation and constructs only the
+    # data-layer batch class — no attacker-controlled callable ever
+    # reaches it, so REDUCE-invoking it stays within the data layer.
+    ("sitewhere_tpu.core.batch", "_batch_from_wire"),
 }
 
 _SAFE_MODULE_PREFIXES = (

@@ -1,0 +1,251 @@
+"""Raw-buffer wire codec tests (docs/PERFORMANCE.md).
+
+Roundtrip property coverage across every MeasurementBatch column,
+torn-frame rejection, hostile-frame rejection, and the cross-version
+fallback to the safepickle envelope.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import sitewhere_tpu.core.batch as batch_mod
+from sitewhere_tpu.core.batch import (
+    MeasurementBatch,
+    WireCodecError,
+    _batch_from_wire,
+    encode_batch_wire,
+    make_event_ids,
+)
+from sitewhere_tpu.core.trace import TraceContext
+from sitewhere_tpu.runtime import safepickle
+
+
+def _full_batch(n: int = 7, seed: int = 0) -> MeasurementBatch:
+    rng = np.random.RandomState(seed)
+    toks = np.asarray([f"dev-{i % 3}" for i in range(n)], object)
+    names = np.asarray([("temp", "hum")[i % 2] for i in range(n)], object)
+    b = MeasurementBatch(
+        tenant="t-codec",
+        stream_ids=rng.randint(0, 100, (n,)).astype(np.int32),
+        values=rng.randn(n).astype(np.float32),
+        event_ts=(1e12 + rng.rand(n) * 1e6).astype(np.float64),
+        received_ts=(1e12 + rng.rand(n) * 1e6).astype(np.float64),
+        valid=(rng.rand(n) > 0.2),
+        event_ids=np.asarray([f"ev{i}" for i in range(n)], object),
+        device_tokens=toks,
+        names=names,
+        assignment_tokens=np.asarray(["asg"] * n, object),
+        area_tokens=np.asarray(["area"] * n, object),
+        scores=np.where(
+            rng.rand(n) > 0.5, rng.randn(n), np.nan
+        ).astype(np.float32),
+        id_prefix="abcd-",
+        trace={"decoded": 1.0, "inbound": 2.0},
+        trace_ctx=TraceContext(tenant="t-codec", source_topic="mqtt"),
+        deadline_ms=1234.5,
+    )
+    return b
+
+
+def _assert_roundtrip(b: MeasurementBatch, b2: MeasurementBatch) -> None:
+    assert b2.tenant == b.tenant
+    np.testing.assert_array_equal(b2.stream_ids, b.stream_ids)
+    np.testing.assert_array_equal(b2.values, b.values)
+    np.testing.assert_array_equal(b2.event_ts, b.event_ts)
+    np.testing.assert_array_equal(b2.received_ts, b.received_ts)
+    np.testing.assert_array_equal(b2.valid, b.valid)
+    for col in ("event_ids", "device_tokens", "names",
+                "assignment_tokens", "area_tokens"):
+        a, c = getattr(b, col), getattr(b2, col)
+        assert (a is None) == (c is None), col
+        if a is not None:
+            np.testing.assert_array_equal(c, a)
+    if b.scores is None:
+        assert b2.scores is None
+    else:
+        np.testing.assert_array_equal(b2.scores, b.scores)
+    assert b2.id_prefix == b.id_prefix
+    assert b2.trace == b.trace
+    assert b2.deadline_ms == b.deadline_ms
+    if b.trace_ctx is not None:
+        assert b2.trace_ctx.trace_id == b.trace_ctx.trace_id
+
+
+def test_roundtrip_full_columns_through_safepickle():
+    b = _full_batch()
+    b2 = safepickle.loads(pickle.dumps(b))
+    assert isinstance(b2, MeasurementBatch)
+    _assert_roundtrip(b, b2)
+    # the consumer inherits the group indexes — no string sort on decode
+    assert b2.tok_index is not None and b2.name_index is not None
+    u, inv = b2.tok_index
+    np.testing.assert_array_equal(np.asarray(u, object)[inv], b.device_tokens)
+
+
+def test_roundtrip_property_random_batches():
+    rng = np.random.RandomState(42)
+    for trial in range(20):
+        n = int(rng.randint(0, 50))
+        b = _full_batch(n=max(n, 0), seed=trial)
+        # randomly drop optional columns
+        for col in ("event_ids", "assignment_tokens", "area_tokens",
+                    "scores", "device_tokens", "names"):
+            if rng.rand() < 0.4:
+                setattr(b, col, None)
+        if b.device_tokens is None:
+            b.tok_index = None
+        if b.names is None:
+            b.name_index = None
+        if rng.rand() < 0.3:
+            b.trace_ctx = None
+        if rng.rand() < 0.3:
+            b.deadline_ms = None
+        b2 = safepickle.loads(pickle.dumps(b))
+        _assert_roundtrip(b, b2)
+
+
+def test_roundtrip_empty_and_minimal():
+    e2 = safepickle.loads(pickle.dumps(MeasurementBatch.empty()))
+    assert e2.n == 0 and e2.device_tokens is None
+    m = MeasurementBatch.from_arrays("t", np.r_[0, 1], np.r_[1.0, 2.0])
+    _assert_roundtrip(m, safepickle.loads(pickle.dumps(m)))
+
+
+def test_decoded_scores_column_is_writable():
+    b = _full_batch()
+    b2 = _batch_from_wire(encode_batch_wire(b))
+    b2.scores[np.r_[0, 2]] = 9.0  # the score scatter path writes in place
+    assert b2.scores[0] == 9.0
+
+
+def test_bulk_wire_chunks_keep_free_group_index():
+    b = MeasurementBatch.from_column_chunks("t1", [
+        ("devA", "temp", np.r_[1.0, 2.0].astype(np.float32), np.r_[0.0, 0.0]),
+        ("devB", "temp", np.r_[3.0].astype(np.float32), np.r_[5.0]),
+    ])
+    b2 = _batch_from_wire(encode_batch_wire(b))
+    assert b2.tok_index is not None
+    np.testing.assert_array_equal(b2.pair_codes(), b.pair_codes())
+
+
+def test_torn_frames_rejected_at_every_cut():
+    w = encode_batch_wire(_full_batch())
+    assert w[:3] == b"SWB" and w[3] == 1
+    # every truncation point: a torn frame must raise — never decode,
+    # never return a short batch silently
+    for cut in range(len(w)):
+        try:
+            got = _batch_from_wire(w[:cut])
+        except ValueError:
+            continue  # WireCodecError subclasses ValueError
+        except safepickle.UnpicklingError:
+            continue  # cut landed inside the meta pickle blob
+        raise AssertionError(f"torn frame at cut {cut} decoded: {got!r}")
+
+
+class _TornCarrier:
+    """Pickles as a REDUCE that feeds torn bytes to the wire decoder —
+    what a tampered netbus/dlog stream would look like on the reader."""
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+
+    def __reduce__(self):
+        return (_batch_from_wire, (self.data,))
+
+
+def test_torn_frame_inside_outer_pickle_surfaces_as_unpickling_error():
+    """A corrupt embedded frame inside a netbus/dlog pickle stream must
+    surface as the ONE failure type frame readers catch."""
+    w = encode_batch_wire(_full_batch())
+    with pytest.raises(safepickle.UnpicklingError):
+        safepickle.loads(pickle.dumps(_TornCarrier(w[:-5])))
+    # sanity: the untampered stream still decodes
+    ok = safepickle.loads(pickle.dumps(_TornCarrier(w)))
+    assert isinstance(ok, MeasurementBatch)
+
+
+def test_unknown_future_version_rejected_with_fallback_hint():
+    w = bytearray(encode_batch_wire(_full_batch()))
+    w[3] = 7
+    with pytest.raises(WireCodecError, match="version 7"):
+        _batch_from_wire(bytes(w))
+
+
+def test_hostile_vocab_index_rejected():
+    b = MeasurementBatch.from_column_chunks("t1", [
+        ("devA", "temp", np.r_[1.0, 2.0].astype(np.float32), np.r_[0.0, 0.0]),
+    ])
+    w = bytearray(encode_batch_wire(b))
+    # flip the last int32 (name_inverse tail) out of vocab range
+    w[-4:] = np.asarray([99], np.int32).tobytes()
+    with pytest.raises(WireCodecError, match="out of vocab"):
+        _batch_from_wire(bytes(w))
+
+
+def test_fallback_v0_for_out_of_contract_dtypes():
+    b = MeasurementBatch.from_arrays("t", np.r_[0, 1], np.r_[1.0, 2.0])
+    b.values = b.values.astype(np.float64)  # out of wire contract
+    w = encode_batch_wire(b)
+    assert w[3] == 0  # safepickle envelope
+    b2 = _batch_from_wire(w)
+    assert b2.values.dtype == np.float64
+    np.testing.assert_array_equal(b2.values, b.values)
+
+
+def test_fallback_v0_when_codec_disabled(monkeypatch):
+    monkeypatch.setattr(batch_mod, "WIRE_CODEC_ENABLED", False)
+    b = _full_batch()
+    w = encode_batch_wire(b)
+    assert w[3] == 0
+    # the kill switch must produce a PLAIN class pickle a consumer
+    # predating the codec (no _batch_from_wire on its allowlist) can
+    # load — that's the rollback/mixed-fleet escape hatch
+    stream = pickle.dumps(b)
+    assert b"_batch_from_wire" not in stream
+    _assert_roundtrip(b, safepickle.loads(stream))
+
+
+def test_make_event_ids_grow_race_regression(monkeypatch):
+    """Concurrent growth from executor threads must never hand back fewer
+    than n ids (the pre-fix race: a slower thread could publish a SMALLER
+    pool after a bigger one, and readers re-reading the global mid-slice
+    got short columns)."""
+    import threading
+
+    monkeypatch.setattr(batch_mod, "_ID_SUFFIXES", np.zeros((0,), object))
+    sizes = [17, 4096, 9000, 123, 20000, 1, 12000, 300]
+    errors: list = []
+    barrier = threading.Barrier(len(sizes))
+
+    def worker(n: int) -> None:
+        barrier.wait()
+        for _ in range(50):
+            ids = make_event_ids("p-", n)
+            if len(ids) != n:
+                errors.append((n, len(ids)))
+                return
+            if n and (ids[0] != "p-0" or ids[n - 1] != f"p-{n - 1}"):
+                errors.append((n, ids[0], ids[n - 1]))
+                return
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in sizes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_invariant_violating_batch_ships_via_fallback_not_torn_frame():
+    """A batch whose columns disagree on length (producer bug) must ride
+    the v0 envelope and stay decodable — never become an undecodable v1
+    frame that drops the consumer's whole bus connection."""
+    b = MeasurementBatch.from_arrays("t", np.r_[0, 1, 2], np.r_[1.0, 2.0, 3.0])
+    b.event_ts = np.zeros((0,), np.float64)  # broken invariant
+    w = encode_batch_wire(b)
+    assert w[3] == 0
+    b2 = safepickle.loads(pickle.dumps(b))
+    assert b2.event_ts.shape == (0,) and b2.n == 3  # faithful, decodable

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Hot-path allocation lint (AST).
+
+The zero-copy feed contract (docs/PERFORMANCE.md) says the scoring and
+media feed paths move rows as numpy slices into preallocated buffers —
+never as Python lists that are re-converted to arrays per flush. Round 5
+measured why this matters: at 1M+ ev/s every per-flush ``np.asarray``
+over freshly built lists is allocation + a Python-level copy loop on the
+single host core. This lint keeps the invariant structural instead of
+tribal: it parses the hot-path functions named in ``HOT_PATHS`` below
+and flags
+
+- **list accumulators**: a name bound to a list literal that later takes
+  ``.append(...)`` inside a loop (the classic per-row collector);
+- **list→array conversions**: ``np.asarray`` / ``np.array`` /
+  ``np.stack`` / ``np.concatenate`` applied to such an accumulator or to
+  an inline list comprehension;
+- **per-row string ops**: any ``np.char.*`` usage anywhere in a
+  registered module (vectorized-looking, but a Python loop under the
+  hood — ``core.batch.make_event_ids`` shows the cheap alternative).
+
+A line may opt out with a trailing ``# hotpath: ok`` comment (for a
+cold-path branch living inside a hot function). A registry entry whose
+function disappeared is itself a finding — stale registries rot lints.
+
+Used two ways, exactly like ``check_queues.py``: standalone
+(``python tools/check_hotpath.py`` → exit 1 on findings) and imported by
+the tier-1 suite (``lint_hotpaths()``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+
+# module (relative to sitewhere_tpu/) → hot functions ("name" for
+# module-level, "Class.method" for methods). Point this at the functions
+# that run per flush / per enqueue at full ingest rate — NOT at cold
+# paths (drain, failover, teardown), which may keep convenient idioms.
+HOT_PATHS: Dict[str, List[str]] = {
+    "pipeline/inference.py": [
+        "TpuInferenceService._enqueue_batch",
+        "TpuInferenceService._flush_family",
+        "_LaneRing.push",
+        "_LaneRing.pop_into",
+    ],
+    "pipeline/media.py": [
+        "MediaClassificationPipeline.submit_chunk",
+        "MediaClassificationPipeline._classify_and_publish",
+        "_FrameRing.reserve",
+        "_FrameRing.pop_into",
+    ],
+    "core/batch.py": [
+        "make_event_ids",
+        "encode_batch_wire",
+    ],
+}
+
+_NP_CONVERTERS = {"asarray", "array", "stack", "concatenate", "fromiter"}
+
+
+def _is_np_attr(node: ast.AST, attrs: set) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr in attrs
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("np", "numpy", "_np")
+    )
+
+
+def _allowed(lines: List[str], lineno: int) -> bool:
+    if 1 <= lineno <= len(lines):
+        return "# hotpath: ok" in lines[lineno - 1]
+    return False
+
+
+class _FnScanner(ast.NodeVisitor):
+    """Scan ONE hot function body for the banned patterns."""
+
+    def __init__(self, rel: str, qual: str, lines: List[str]) -> None:
+        self.rel = rel
+        self.qual = qual
+        self.lines = lines
+        self.findings: List[str] = []
+        self.accumulators: set = set()
+        self._loop_depth = 0
+
+    def _finding(self, node: ast.AST, msg: str) -> None:
+        if not _allowed(self.lines, node.lineno):
+            self.findings.append(
+                f"{self.rel}:{node.lineno}: [{self.qual}] {msg}"
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.List):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.accumulators.add(t.id)
+        self.generic_visit(node)
+
+    def _visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            self._loop_depth
+            and isinstance(f, ast.Attribute)
+            and f.attr in ("append", "extend")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.accumulators
+        ):
+            self._finding(
+                node,
+                f"list accumulator '{f.value.id}.{f.attr}' inside a loop — "
+                "write rows into a preallocated ring/staging buffer instead",
+            )
+        if _is_np_attr(f, _NP_CONVERTERS):
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in self.accumulators:
+                    self._finding(
+                        node,
+                        f"np.{f.attr}('{arg.id}') converts a Python-list "
+                        "accumulator per call — keep rows columnar",
+                    )
+                elif isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+                    self._finding(
+                        node,
+                        f"np.{f.attr}(<listcomp>) builds a per-row Python "
+                        "list before the array — keep rows columnar",
+                    )
+        self.generic_visit(node)
+
+
+def _function_index(tree: ast.Module) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+
+def lint_hotpaths(
+    hot_paths: Optional[Dict[str, List[str]]] = None,
+    src_root: Optional[Path] = None,
+) -> List[str]:
+    """Scan the registered hot paths; returns findings (empty = clean)."""
+    findings: List[str] = []
+    root = src_root or SRC_ROOT
+    for rel, quals in (hot_paths or HOT_PATHS).items():
+        path = root / rel
+        if not path.exists():
+            findings.append(f"{rel}: registered module does not exist")
+            continue
+        text = path.read_text()
+        lines = text.splitlines()
+        tree = ast.parse(text)
+        index = _function_index(tree)
+        for qual in quals:
+            fn = index.get(qual)
+            if fn is None:
+                findings.append(
+                    f"{rel}: registered hot function '{qual}' not found — "
+                    "stale HOT_PATHS registry"
+                )
+                continue
+            scanner = _FnScanner(rel, qual, lines)
+            for stmt in fn.body:
+                scanner.visit(stmt)
+            findings.extend(scanner.findings)
+        # module-wide: np.char.* is a hidden per-row Python loop
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and _is_np_attr(
+                node.value, {"char"}
+            ):
+                if not _allowed(lines, node.lineno):
+                    findings.append(
+                        f"{rel}:{node.lineno}: np.char.{node.attr} is a "
+                        "per-row Python loop in disguise — see "
+                        "core.batch.make_event_ids for the cheap pattern"
+                    )
+    return findings
+
+
+def main() -> int:
+    findings = lint_hotpaths()
+    for f in findings:
+        print(f"check_hotpath: {f}", file=sys.stderr)
+    n_fns = sum(len(v) for v in HOT_PATHS.values())
+    print(
+        f"check_hotpath: {n_fns} hot function(s) across "
+        f"{len(HOT_PATHS)} module(s), {len(findings)} finding(s)"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

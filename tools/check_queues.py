@@ -8,12 +8,16 @@ has it dropped) — otherwise shed work is invisible and "no silent loss"
 cannot be audited (docs/ROBUSTNESS.md "Overload & degradation").
 
 The lint scans ``sitewhere_tpu/`` for bounded-queue construction sites
-(``asyncio.Queue(maxsize=...)`` and ``runtime.overload``'s
-``PriorityClassQueue``) and checks each against the REGISTRY below:
+(``asyncio.Queue(maxsize=...)``, ``runtime.overload``'s
+``PriorityClassQueue``, and the feed path's bounded rings —
+``_LaneRing``/``_FrameRing``) and checks each against the REGISTRY
+below:
 
 - every site must be registered with the metric names of its depth
-  gauge and shed/expired counter (an unregistered bounded queue is a
-  finding — register it AND wire its metrics);
+  gauge and either a shed/expired counter or — for rings that
+  backpressure instead of shedding — a backpressure counter (an
+  unregistered bounded queue is a finding — register it AND wire its
+  metrics);
 - each declared metric name must actually be referenced somewhere in
   ``sitewhere_tpu/`` (a registry entry pointing at a metric nobody
   emits is a finding);
@@ -47,15 +51,23 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         "depth_gauge": "receiver_queue_depth",
         "shed_counter": "receiver_shed_total",
     },
-    ("pipeline/media.py", r"asyncio\.Queue\(maxsize="): {
-        "queue": "media frame queue (newest-frame-wins shedding)",
+    ("pipeline/media.py", r"_FrameRing\("): {
+        "queue": "media frame ring (newest-frame-wins shedding)",
         "depth_gauge": "media_queue_depth",
         "shed_counter": "media_frames_shed_total",
+    },
+    ("pipeline/inference.py", r"_LaneRing\("): {
+        "queue": "scoring lane rings (pending rows per (slot, data-shard))",
+        "depth_gauge": "tpu_inference_lane_rows",
+        # lanes never shed: the per-tenant watermark backpressures intake
+        # into the bus (where lag is a gauge and drives overload credit)
+        "backpressure_counter": "tpu_inference.lane_backpressure",
     },
 }
 
 BOUNDED_RE = re.compile(
-    r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*=)"
+    r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
+    r"|= _LaneRing\(|= _FrameRing\()"
 )
 
 
@@ -97,7 +109,8 @@ def lint_queues() -> List[str]:
                 f"construction site — stale registry"
             )
             continue
-        for kind in ("depth_gauge", "shed_counter"):
+        kinds = [k for k in decl if k.endswith(("_gauge", "_counter"))]
+        for kind in kinds:
             name = decl[kind]
             if not _metric_referenced(name, texts):
                 findings.append(
