@@ -343,6 +343,47 @@ def bench_vit(batch: int, steps: int, secs: float = 8.0) -> dict:
     return out
 
 
+def result_path_stats(metrics) -> dict:
+    """Result-path decomposition (docs/PERFORMANCE.md "Result path"):
+    the d2h_wait/resolve split of the old materialize histogram, d2h
+    bytes actually fetched per flush vs the full score plane the
+    pre-gather path would have moved (``d2h_plane_reduction`` is the
+    diet ratio), and the overlap fraction — the share of flushes whose
+    transfer had already landed when the reaper asked (the async copy
+    rode under later compute)."""
+
+    def q(name, quant):
+        return metrics.histogram(
+            f"tpu_inference.{name}", unit="s"
+        ).quantile(quant) * 1e3
+
+    flushes = max(metrics.counter("tpu_inference.flushes").value, 1)
+    reaped = max(metrics.counter("tpu_inference.reaped").value, 1)
+    d2h = metrics.counter("tpu_inference.d2h_bytes").value
+    plane = metrics.counter("tpu_inference.d2h_plane_bytes").value
+    ws = metrics.histogram("tpu_inference.d2h_wait", unit="s").summary()
+    wait_s = ws["mean"] * ws["count"]
+    return {
+        "d2h_wait_ms": q("d2h_wait", 0.5),
+        "d2h_wait_p99_ms": q("d2h_wait", 0.99),
+        "resolve_ms": q("resolve", 0.5),
+        "resolve_p99_ms": q("resolve", 0.99),
+        "d2h_bytes_per_flush": d2h / flushes,
+        "d2h_plane_bytes_per_flush": plane / flushes,
+        # ≥ 8x on the 32-tenant config is the gather acceptance bar
+        "d2h_plane_reduction": plane / max(d2h, 1),
+        "d2h_overlap_fraction": (
+            metrics.counter("tpu_inference.d2h_overlapped").value / reaped
+        ),
+        # MB of scores drained per second of reaper wait — honest only
+        # when overlap is partial (fully-overlapped transfers wait ~0)
+        "d2h_mbps": (d2h / 1e6) / max(wait_s, 1e-9) if d2h else 0.0,
+        "deliver_backpressure": metrics.counter(
+            "tpu_inference.deliver_backpressure"
+        ).value,
+    }
+
+
 def feed_path_stats(metrics) -> dict:
     """Zero-copy feed-path decomposition (docs/PERFORMANCE.md): lane→
     staging assembly time, h2d staging issue time, and the overlap
@@ -577,9 +618,8 @@ async def _bench_e2e(
             "dispatch_p99_ms": h("dispatch", 0.99),
             "acquire_p50_ms": h("acquire_wait", 0.5),
             "acquire_p99_ms": h("acquire_wait", 0.99),
-            "materialize_p50_ms": h("materialize", 0.5),
-            "materialize_p99_ms": h("materialize", 0.99),
             **feed_path_stats(inst.metrics),
+            **result_path_stats(inst.metrics),
         }
         return {
             "score_loop": loop_stats,
@@ -711,6 +751,7 @@ async def _bench_e2e_multitenant(
                 / max(flushes, 1)
             ),
             **feed_path_stats(inst.metrics),
+            **result_path_stats(inst.metrics),
         }
     finally:
         await inst.terminate()
@@ -1052,6 +1093,13 @@ def main() -> None:
             nd=3),
         "h2d_overlap_32t": pick(
             details, "e2e_pipeline_32t", "h2d_overlap_fraction", nd=3),
+        # result-path proof points: overlap > 0 ⇔ async d2h copies land
+        # under later compute; plane reduction ≥ 8 ⇔ the device-side
+        # gather made transfer volume rows-proportional (32 tenants)
+        "d2h_overlap_32t": pick(
+            details, "e2e_pipeline_32t", "d2h_overlap_fraction", nd=3),
+        "d2h_reduction_32t": pick(
+            details, "e2e_pipeline_32t", "d2h_plane_reduction", nd=1),
         "details": args.details_out,
     }
     line = json.dumps(out)

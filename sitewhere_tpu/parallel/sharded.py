@@ -183,6 +183,88 @@ class ShardedScorer:
         """Host→device bytes one staged flush moves (feed observability)."""
         return int(sum(a.nbytes for a in staged))
 
+    # -- d2h result path (device-side row gather) ------------------------
+    # smallest compiled gather size: flushes smaller than this pad up to
+    # it (a few KB of d2h — noise), and the ladder stays short enough to
+    # prewarm every size per bucket
+    GATHER_FLOOR = 2048
+
+    def gather_ladder(self, b_lane: int) -> List[int]:
+        """Padded gather output sizes compiled for one bucket's score
+        plane: powers of two from GATHER_FLOOR up to the full plane.
+        A flush's d2h volume is the smallest rung ≥ its row count, so
+        padding waste is < 2× while the compile count stays O(log).
+        Cached per bucket — gather_rows runs per flush, and the ladder
+        is fixed by (n_slots, data shards, b_lane)."""
+        ladders = getattr(self, "_ladders", None)
+        if ladders is None:
+            ladders = self._ladders = {}
+        cached = ladders.get(b_lane)
+        if cached is not None:
+            return cached
+        plane = self.n_slots * self.mm.n_data_shards * b_lane
+        sizes: List[int] = []
+        g = min(self.GATHER_FLOOR, plane)
+        while g < plane:
+            sizes.append(g)
+            g *= 2
+        sizes.append(plane)
+        ladders[b_lane] = sizes
+        return sizes
+
+    def _gather_fn(self) -> Callable:
+        if getattr(self, "_gather", None) is None:
+            def gather(scores, counts, size):
+                # scores [T, D*B] wire dtype, counts i32[T, D]; the valid
+                # rows are front-contiguous per (slot, data-shard) lane,
+                # so their COMPACTION indices are derivable on device —
+                # no index upload, the counts wire already crossed h2d.
+                # Output order is (slot, data-shard, lane position): the
+                # flush packs its host-side seqs/rows bookkeeping in the
+                # same sorted order (see _flush_family).
+                t, l = scores.shape
+                d = counts.shape[1]
+                b = l // d
+                lanepos = jnp.arange(b, dtype=jnp.int32)
+                valid = (
+                    lanepos[None, None, :] < counts[:, :, None]
+                ).reshape(-1)
+                pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+                idx = jnp.where(valid, pos, size)  # pads scatter-drop
+                out = jnp.full((size,), jnp.nan, scores.dtype)
+                return out.at[idx].set(scores.reshape(-1), mode="drop")
+
+            self._gather = jax.jit(gather, static_argnums=2)
+        return self._gather
+
+    def gather_rows(self, scores_dev, counts_dev, n_rows: int):
+        """Compact one flush's scored rows out of the [T, D*B] plane ON
+        DEVICE: returns a wire-dtype device vector of the smallest ladder
+        size ≥ ``n_rows`` (entries past ``n_rows`` are NaN padding).
+        This is what makes d2h volume rows-proportional instead of
+        tenant-count-proportional — the caller materializes rows×2 bytes
+        per flush, never the T×lane score plane."""
+        t, l = scores_dev.shape
+        b_lane = l // self.mm.n_data_shards
+        size = next(
+            (s for s in self.gather_ladder(b_lane) if s >= n_rows), t * l
+        )
+        if self.mm.mesh.devices.size > 1:
+            # consolidate onto one device BEFORE the jitted compaction:
+            # the cumsum/scatter crosses shards, and letting GSPMD emit
+            # an AllGather gang-schedules a rendezvous across every
+            # device per flush — on the CPU backend (8 virtual devices
+            # on few cores) concurrent flush dispatches deadlock that
+            # rendezvous, and on a pod it serializes the mesh. A
+            # device_put is point-to-point (d2d/ICI, no rendezvous),
+            # rides the same async dispatch, and the single-chip
+            # production mesh skips it entirely.
+            dev = self.mm.mesh.devices.flat[0]
+            scores_dev, counts_dev = jax.device_put(
+                (scores_dev, counts_dev), dev
+            )
+        return self._gather_fn()(scores_dev, counts_dev, size)
+
     # -- compiled step ---------------------------------------------------
     def _build_step(self, counts_mode: bool = False) -> Callable:
         """The scoring jit. Two variants share this builder:
@@ -258,6 +340,11 @@ class ShardedScorer:
             ids, vals, counts = self.stage_inputs(ids, vals, counts)
             s = self.step_counts(ids, vals, counts)
             _np.asarray(s)
+            # the result path's device-side gather: compile every ladder
+            # size for this bucket's plane — a mid-loop gather compile
+            # would stall the pipeline exactly like a step compile
+            for g in self.gather_ladder(b):
+                _np.asarray(self.gather_rows(s, counts, g))
             if t > 1:
                 # the single-used-slot d2h slice the flush path takes
                 # (see TpuInferenceService._flush_family) — same rule:
@@ -399,6 +486,7 @@ class ShardedScorer:
         )
         self._step = self._build_step()
         self._step_counts = self._build_step(counts_mode=True)
+        self._gather = None  # fresh jit cache for the result-path gather
         self._wire_sharding = self.mm.sharding(AXIS_TENANT, AXIS_DATA)
         if getattr(self, "_optimizer", None) is not None:
             opt_state = jax.vmap(self._optimizer.init)(self.params)

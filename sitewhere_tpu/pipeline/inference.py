@@ -20,11 +20,20 @@ docs/PERFORMANCE.md has the full stage walkthrough):
               overlaps the previous flush's device compute
                                      ▼
               ShardedScorer.step_counts — ONE jit call, every tenant
-                                     ▼ (dispatch is async; materialization
-                                        happens OFF the scoring loop)
-              scores scatter back into each batch's ``scores`` column
                                      ▼
-              completed batches → tpu-scored-events[tenant]
+              gather_rows — device-side compaction: only the flushed
+              rows' scores leave the chip (wire dtype; d2h bytes are
+              rows-proportional, never the T×lane plane)
+                                     ▼ (copy_to_host_async issued at
+                                        dispatch — the transfer rides
+                                        under the next flush's compute)
+              completion REAPER — resolves flushes as transfers land:
+              out of order across families, FIFO per family (so every
+              tenant's batches publish in order)
+                                     ▼
+              columnar resolve: scores slice-assign back into each
+              batch's ``scores`` column; completed batches →
+              tpu-scored-events[tenant]
 
 Three latency-hiding moves matter here (SURVEY.md §7 hard parts):
 - the host side never touches per-event Python objects — rows move as
@@ -34,10 +43,13 @@ Three latency-hiding moves matter here (SURVEY.md §7 hard parts):
 - the staged device put is issued BEFORE dispatch and is asynchronous,
   so flush N+1's host→device transfer rides under flush N's compute
   (``tpu_inference.h2d_overlapped`` / ``h2d_staged`` expose the ratio);
-- score materialization (device→host) is pipelined: up to
-  ``max_inflight`` flushes ride concurrently, so one device round-trip
-  never stalls the collect loop. p99 still lands in the
-  ``tpu_inference.latency`` histogram per row.
+- the readback is the mirror image: a device-side gather returns only
+  the flushed rows (``ShardedScorer.gather_rows``), its d2h copy is
+  started asynchronously at dispatch, and a completion reaper resolves
+  up to ``max_inflight`` in-flight flushes as their transfers land
+  (``tpu_inference.d2h_overlapped`` counts transfers that landed before
+  the reaper asked). One device round-trip never stalls the collect
+  loop; p99 still lands in the ``tpu_inference.latency`` histogram.
 
 Tenant start/stop flips the scorer's active mask — no recompile; batch-size
 buckets keep XLA at a handful of compiled shapes.
@@ -68,7 +80,10 @@ from sitewhere_tpu.runtime.lifecycle import (
     SupervisedTask,
     cancel_and_wait,
 )
-from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.metrics import (
+    D2H_OVERLAP_EPS_S as _D2H_OVERLAP_EPS_S,
+    MetricsRegistry,
+)
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
 
@@ -293,6 +308,84 @@ class _StagingSet:
             pass
 
 
+class _PendingFlush:
+    """One dispatched flush awaiting its device→host score transfer.
+
+    ``scores`` is either the device-gathered row vector (``gathered``
+    True — slice ``[:moved]`` is the picks, already in pack order) or
+    the full score plane (fallback for scorers without ``gather_rows``,
+    e.g. monkeypatched test doubles — the host then picks
+    ``scores[slots, cols]``). The d2h copy was started at dispatch
+    (``copy_to_host_async``); outputs that can't copy asynchronously
+    get an eager executor materialization instead (``host_future``), so
+    fallback flushes still overlap each other like the old per-flush
+    deliver tasks did."""
+
+    __slots__ = (
+        "family", "scores", "taken", "moved", "gathered", "t_dispatch",
+        "nbytes", "plane_nbytes", "host_future", "t_wait", "poisoned",
+    )
+
+    def __init__(
+        self, family: str, scores, taken, moved: int, gathered: bool,
+        nbytes: int, plane_nbytes: int, poisoned: bool = False,
+    ) -> None:
+        self.family = family
+        self.scores = scores
+        self.taken = taken
+        self.moved = moved
+        self.gathered = gathered
+        self.t_dispatch = time.perf_counter()
+        self.nbytes = nbytes
+        self.plane_nbytes = plane_nbytes
+        self.host_future = None
+        self.t_wait = None  # when the reaper first started waiting on us
+        # a flush whose DISPATCH failed (no scores, no transfer): it
+        # rides the FIFO so its unscored resolution can't overtake an
+        # earlier in-flight flush of the same family
+        self.poisoned = poisoned
+
+    def landed(self) -> bool:
+        """Probably-complete signal used to PRIORITIZE heads: a finished
+        executor materialization, or (for jax arrays) ``is_ready`` —
+        which only proves the device COMPUTE finished, not that the
+        async host copy crossed the link. Honest overlap accounting is
+        therefore measured at materialize time (see ``_resolve_flush``),
+        never inferred from this."""
+        if self.poisoned:
+            return True  # nothing to wait for — resolvable immediately
+        if self.host_future is not None:
+            return self.host_future.done()
+        try:
+            return bool(self.scores.is_ready())
+        except Exception:  # noqa: BLE001 - non-jax doubles: never "landed"
+            return False
+
+    def ensure_host_future(self, loop, pool):
+        """Lazily start (and cache) an executor materialization — used
+        when the reaper must wait on several families' heads at once."""
+        if self.host_future is None:
+            self.host_future = loop.run_in_executor(
+                pool, np.asarray, self.scores
+            )
+        return self.host_future
+
+
+class _ReapQueue(list):
+    """Per-family FIFO of in-flight flush completions. Depth is bounded
+    by the ``max_inflight`` semaphore (acquired before rows are popped
+    from lanes) and observable via the ``tpu_inference_deliver_inflight``
+    gauge + ``tpu_inference.deliver_backpressure`` counter
+    (tools/check_queues.py registry). FIFO per family is what gives
+    per-tenant in-order delivery: a tenant lives in exactly one family,
+    and the reaper never resolves past an unfinished head."""
+
+    __slots__ = ()
+
+    def popleft(self) -> _PendingFlush:
+        return self.pop(0)
+
+
 class TpuInferenceEngine(TenantEngine):
     """Per-tenant engine: placement on the mesh + stream registry."""
 
@@ -432,7 +525,10 @@ class TpuInferenceService(MultitenantService):
         self.staging_slots = max(2, int(staging_slots))
         self._staging: Dict[Tuple[str, int], list] = {}
         # per-family last dispatch output — the overlap probe (next
-        # flush's staging "overlapped" ⇔ this is still computing)
+        # flush's staging "overlapped" ⇔ this is still computing). With
+        # the device-side gather it holds the GATHERED rows (a few KB),
+        # never the score plane, and the reaper drops it when the
+        # family's in-flight queue drains so an idle family pins nothing
         self._last_scores: Dict[str, object] = {}
         self._first_pending_ts: Dict[str, float] = {}
         self._loop_super: Optional[SupervisedTask] = None
@@ -456,9 +552,22 @@ class TpuInferenceService(MultitenantService):
         self._failover_rounds: Dict[str, int] = {}
         self._parked: set = set()
         self._inflight = asyncio.Semaphore(max_inflight)
-        self._deliver_tasks: set = set()
         self.max_inflight = max_inflight
         self._deliver_pool = None  # created on start, shut down on stop
+        # result path: per-family FIFOs of in-flight flush completions,
+        # drained by the reaper task as d2h transfers land (out of order
+        # across families, in order per tenant)
+        self._reap: Dict[str, _ReapQueue] = {}
+        self._reap_event = asyncio.Event()
+        self._reaper_super: Optional[SupervisedTask] = None
+        # per-family resolve task in flight (≤ 1 per family keeps the
+        # per-tenant FIFO; separate tasks keep one family's backpressured
+        # publish from head-of-line blocking every other family's landed
+        # transfers behind the single reaper coroutine)
+        self._resolving: Dict[str, asyncio.Task] = {}
+        # teardown grace for in-flight transfers before they force-resolve
+        # unscored (a dead device must not hang the stop cascade)
+        self.deliver_drain_timeout_s = 10.0
 
     @property
     def group(self) -> str:
@@ -541,20 +650,47 @@ class TpuInferenceService(MultitenantService):
         )
         await self._loop_super.initialize()
         await self._loop_super.start()
+        # the completion reaper: resolves in-flight flushes as their d2h
+        # transfers land; supervised so a resolve fault can't silently
+        # end score delivery (pending queues survive a restart)
+        self._reaper_super = SupervisedTask(
+            "tpu-inference-reaper", self._reap_loop, max_restarts=5
+        )
+        await self._reaper_super.initialize()
+        await self._reaper_super.start()
 
     async def on_stop(self) -> None:
         if getattr(self, "_loop_super", None) is not None:
             await self._loop_super.terminate()
             self._loop_super = None
-        # let in-flight deliveries finish (they hold rows already popped
-        # from lanes — cancelling would strand their batches unpublished);
-        # only force-cancel if the device never comes back
-        if self._deliver_tasks:
-            _done, pending = await asyncio.wait(
-                list(self._deliver_tasks), timeout=10.0
-            )
-            for t in pending:
-                await cancel_and_wait(t)
+        # let in-flight transfers land and resolve through the reaper
+        # (they hold rows already popped from lanes — dropping them would
+        # lose events); only give up if the device never answers
+        deadline = time.monotonic() + self.deliver_drain_timeout_s
+        while any(self._reap.values()) and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        if self._reaper_super is not None:
+            await self._reaper_super.terminate()
+            self._reaper_super = None
+        # cancel per-family resolves still blocked (e.g. a publish against
+        # a stopped consumer): their CancelledError path resolves the
+        # popped rows unscored via publish_nowait before re-raising
+        for task in list(self._resolving.values()):
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._resolving.clear()
+        # force-resolve anything still stuck, unscored (zero loss even
+        # when a transfer never completes)
+        for q in self._reap.values():
+            while q:
+                pf = q.popleft()
+                _s, _c, seqs, rows = pf.taken
+                await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+                self._inflight.release()
+        self._deliver_gauge()
         # final sweep: rows can land in lanes AFTER their engine's own
         # stop-drain (the scoring loop keeps consuming during the stop
         # cascade) — resolve them unscored so no consumed event is lost
@@ -564,6 +700,7 @@ class TpuInferenceService(MultitenantService):
                 if lane.count:
                     _i, _v, seqs, rows = lane.pop(lane.count)
                     await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+        self._last_scores.clear()  # drop any pinned device score memory
         if self._deliver_pool is not None:
             self._deliver_pool.shutdown(wait=False)
             self._deliver_pool = None
@@ -644,23 +781,70 @@ class TpuInferenceService(MultitenantService):
         rows: np.ndarray,
         scores: Optional[np.ndarray],
         publish_nowait: bool = False,
-    ) -> List[int]:
-        """Scatter scores (or NaN) into their batches; returns seqs whose
-        batches became complete (and publishes them)."""
-        done: List[int] = []
-        for s in np.unique(seqs):
-            entry = self._batches.get(int(s))
-            if entry is None:
-                continue
-            mask = seqs == s
-            if scores is not None:
-                entry[0].scores[rows[mask]] = scores[mask]
-            entry[1] -= int(mask.sum())
-            if entry[1] <= 0:
-                done.append(int(s))
-        for s in done:
-            await self._publish_batch(s, nowait=publish_nowait)
-        return done
+    ) -> int:
+        """Columnar score write-back: scatter ``scores`` (or NaN for an
+        unscored resolution) into their batches' score columns one
+        contiguous run at a time, then publish every batch that became
+        complete — in seq (= enqueue) order, so a tenant's batches leave
+        in order even when a flush carried several. Returns the number
+        of batches published.
+
+        Rows arrive grouped: lanes pop FIFO and flushes pack lanes in
+        sorted order, so equal-seq runs are contiguous and their row
+        indices ascend — a dense run is a pure slice assignment, a
+        sampled/split one a single vectorized scatter. Run count is
+        O(lanes × batches per flush), tiny next to row count; no
+        per-row Python, no list accumulators (tools/check_hotpath.py
+        keeps it that way)."""
+        n = len(seqs)
+        if n == 0:
+            return 0
+        cuts = np.flatnonzero(seqs[1:] != seqs[:-1]) + 1
+        done = np.empty((len(cuts) + 1,), np.int64)
+        k = 0
+        a = 0
+        for b in (*cuts.tolist(), n):
+            s = int(seqs[a])
+            entry = self._batches.get(s)
+            if entry is not None:
+                dst = entry[0].scores
+                run = rows[a:b]
+                # dense ⇔ consecutive ascending rows (one lane's FIFO pop
+                # — the common case); a run spanning several lanes or a
+                # sampled batch falls back to one vectorized scatter
+                dense = b - a == 1 or bool((np.diff(run) == 1).all())
+                if scores is None:
+                    if dense:
+                        dst[int(run[0]) : int(run[-1]) + 1] = np.nan
+                    else:
+                        dst[run] = np.nan
+                elif dense:
+                    dst[int(run[0]) : int(run[-1]) + 1] = scores[a:b]
+                else:
+                    dst[run] = scores[a:b]
+                entry[1] -= b - a
+                if entry[1] <= 0:
+                    done[k] = s
+                    k += 1
+            a = b
+        if k:
+            # publish in ascending seq order (scatter above was
+            # await-free, so no batch state moved under us)
+            done[:k].sort()
+            seq_list = done[:k].tolist()
+            for i, s in enumerate(seq_list):
+                try:
+                    await self._publish_batch(int(s), nowait=publish_nowait)
+                except BaseException:
+                    # cancelled (teardown) or a publish fault mid-loop:
+                    # the remaining completed batches are already out of
+                    # the registry's reach of any later resolve — flush
+                    # them nowait or they strand in _batches and their
+                    # events are lost
+                    for s2 in seq_list[i + 1:]:
+                        await self._publish_batch(int(s2), nowait=True)
+                    raise
+        return k
 
     def _gate(self, tenant: str):
         """Per-tenant inference deadline gate (lazy): expired batches
@@ -716,9 +900,17 @@ class TpuInferenceService(MultitenantService):
             # whole batches past retention. The batch is already out of
             # the registry, so a transient publish fault must be retried
             # here (nowait fallback) or the whole batch would vanish.
-            await publish_at_least_once(
-                self.bus, topic, batch, metrics=self.metrics
-            )
+            try:
+                await publish_at_least_once(
+                    self.bus, topic, batch, metrics=self.metrics
+                )
+            except asyncio.CancelledError:
+                raise  # publish_at_least_once already appended nowait
+            except Exception:
+                # non-transient fault: same registry-reach argument —
+                # append nowait before surfacing, or the batch is lost
+                self.bus.publish_nowait(topic, batch)
+                raise
         # latency accounting: sample rows (full per-row recording would be
         # a Python loop over 10^5 rows/s)
         lat = self.metrics.histogram("tpu_inference.latency", unit="s")
@@ -792,8 +984,13 @@ class TpuInferenceService(MultitenantService):
         mb = any_cfg.microbatch
         # acquire the in-flight slot BEFORE popping rows off the lanes:
         # a cancellation while waiting here must not strand popped rows
-        # (everything from the pop to create_task below is await-free).
+        # (everything from the pop to the reap enqueue below is
+        # await-free).
         t_acq = time.perf_counter()
+        if self._inflight.locked():
+            # all completion slots busy: the flush backpressures here,
+            # where depth is the deliver_inflight gauge (check_queues)
+            self.metrics.counter("tpu_inference.deliver_backpressure").inc()
         await self._inflight.acquire()
         self.metrics.histogram("tpu_inference.acquire_wait", unit="s").record(
             time.perf_counter() - t_acq
@@ -823,7 +1020,11 @@ class TpuInferenceService(MultitenantService):
         rows_cat = np.empty((take_total,), np.int32)
         moved = 0
         used_slots: set = set()
-        for (slot, dshard), lane in list(lanes.items()):
+        # SORTED lane order: the device-side gather compacts valid rows
+        # in (slot, data-shard, lane-position) order, so the host-side
+        # seqs/rows bookkeeping must pack in exactly that order for
+        # gathered[:moved] to line up with seqs_cat/rows_cat
+        for (slot, dshard), lane in sorted(lanes.items()):
             k = min(lane.count, b_lane)
             if k == 0:
                 continue
@@ -868,7 +1069,7 @@ class TpuInferenceService(MultitenantService):
                     prev_scores is not None and not prev_scores.is_ready()
                 )
             except Exception:  # noqa: BLE001 - monkeypatched scorers
-                overlapped = bool(self._deliver_tasks)
+                overlapped = bool(any(self._reap.values()))
             t_stage = time.perf_counter()
             stage = getattr(scorer, "stage_inputs", None)
             if stage is not None:
@@ -891,9 +1092,6 @@ class TpuInferenceService(MultitenantService):
             t_disp = time.perf_counter()
             with _profiler_annotation(self.profile_annotations, family):
                 scores_dev = scorer.step_counts(*staged)  # async dispatch
-            # overlap probe for the NEXT flush (holds ~1 flush of device
-            # score memory per family until then)
-            self._last_scores[family] = scores_dev
             dispatch_s = time.perf_counter() - t_disp
             self.metrics.histogram("tpu_inference.dispatch", unit="s").record(
                 dispatch_s
@@ -920,24 +1118,52 @@ class TpuInferenceService(MultitenantService):
             }
             self.metrics.counter("tpu_inference.flushes").inc()
             self.metrics.counter("tpu_inference.flush_rows").inc(moved)
-            # d2h diet: when ONE slot carries this flush's rows (the common
-            # single-tenant-per-family case), slice that row on device and
-            # materialize 1×lane instead of the full T×lane score plane.
-            # Restricted to one used slot so the gather has ONE shape per
-            # bucket — prewarm compiles it; arbitrary used-counts would
-            # compile mid-loop and stall the pipeline
-            if len(used_slots) == 1 and scorer.n_slots > 1:
+            # device-side gather: compact ONLY the flushed rows out of
+            # the [T, D*B] score plane before anything crosses d2h —
+            # transfer volume becomes rows-proportional (wire dtype),
+            # independent of tenant count. Shapes come from the ladder
+            # prewarm compiles (ShardedScorer.gather_ladder).
+            plane_nbytes = int(getattr(scores_dev, "nbytes", 0))
+            gathered = False
+            gather = getattr(scorer, "gather_rows", None)
+            if gather is not None and hasattr(scores_dev, "is_ready"):
+                try:
+                    scores_dev = gather(scores_dev, staged[2], moved)
+                    gathered = True
+                except Exception as exc:  # noqa: BLE001 - fall back to
+                    # the full-plane readback rather than lose the flush
+                    self._record_error("gather", exc)
+            if not gathered and len(used_slots) == 1 and scorer.n_slots > 1:
+                # legacy d2h diet for gather-less scorers (monkeypatched
+                # doubles): one used slot → slice that row on device
                 only = next(iter(used_slots))
                 scores_dev = scores_dev[np.full((1,), only, np.int32)]
                 slots_cat[:] = 0  # rows now index row 0 of the slice
+            # overlap probe for the NEXT flush — now holds the gathered
+            # rows (a few KB), not a full flush of plane memory; the
+            # reaper drops it when the family goes idle
+            self._last_scores[family] = scores_dev
+            try:
+                # start the d2h copy NOW: it rides under the next
+                # flush's compute and is (ideally) done by the time the
+                # reaper asks — the mirror image of stage_inputs
+                scores_dev.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - numpy/test doubles
+                pass
         except Exception as exc:  # noqa: BLE001 - a failing scorer must
             # not strand popped rows or kill the loop; repeated failures
             # trigger shard failover
             self._record_error("step", exc)
-            self._inflight.release()
             if breaker is not None:
                 breaker.record_failure()
-            await self._resolve_rows(taken[2], taken[3], None)
+            # resolve the rows unscored THROUGH the reap FIFO, not
+            # inline: an earlier flush of this family may still be in
+            # flight, and publishing these batches first would hand a
+            # tenant its later batch before its earlier one. The permit
+            # stays held until the reaper resolves the entry.
+            self._reap_enqueue(_PendingFlush(
+                family, None, taken, moved, False, 0, 0, poisoned=True
+            ))
             await self._note_scorer_error(family)
             return moved
         try:
@@ -946,12 +1172,29 @@ class TpuInferenceService(MultitenantService):
             # leak the inflight permit or strand the step's rows (the
             # scoring step itself succeeded; delivery proceeds below)
             self._record_error("train", exc)
-        task = asyncio.create_task(
-            self._deliver(scores_dev, taken, family), name=f"tpu-deliver-{family}"
+        pf = _PendingFlush(
+            family, scores_dev, taken, moved, gathered,
+            int(getattr(scores_dev, "nbytes", 0)), plane_nbytes,
         )
-        self._deliver_tasks.add(task)
-        task.add_done_callback(self._deliver_tasks.discard)
+        if not hasattr(scores_dev, "copy_to_host_async"):
+            # no async copy available (test doubles): materialize eagerly
+            # on the pool so fallback flushes still overlap each other
+            pf.ensure_host_future(
+                asyncio.get_running_loop(), self._deliver_pool
+            )
+        self._reap_enqueue(pf)
         return moved
+
+    def _reap_enqueue(self, pf: _PendingFlush) -> None:
+        """Queue one pending flush (normal or poisoned) for the reaper:
+        the single definition of the enqueue protocol — FIFO append,
+        gauge refresh, reaper wake."""
+        q = self._reap.get(pf.family)
+        if q is None:
+            q = self._reap[pf.family] = _ReapQueue()
+        q.append(pf)
+        self._deliver_gauge()
+        self._reap_event.set()
 
     # -- auto-failover ----------------------------------------------------
     async def _note_scorer_error(self, family: str) -> None:
@@ -1102,48 +1345,211 @@ class TpuInferenceService(MultitenantService):
         self.metrics.counter("tpu_inference.train_steps").inc()
         return 1
 
-    async def _deliver(self, scores_dev, taken, family: str = "") -> None:
-        """Materialize one flush's scores off the loop and resolve rows.
+    def _deliver_gauge(self) -> None:
+        self.metrics.gauge("tpu_inference_deliver_inflight").set(
+            sum(len(q) for q in self._reap.values())
+        )
 
-        Worker-thread materialization is safe HERE because ``scores_dev``
-        is a jit output nothing ever donates — unlike param trees, whose
+    async def _reap_loop(self) -> None:
+        """The completion reaper: resolve in-flight flushes as their d2h
+        transfers land. Heads that look complete (``landed`` — a cheap
+        priority signal) dispatch first; when several families are in
+        flight and none does, the reaper waits on ALL their heads and
+        takes whichever finishes first — out of order across families,
+        strictly FIFO within one (a tenant lives in exactly one family,
+        so its batches deliver in order). The reaper itself only WAITS —
+        each landed head resolves in a per-family task
+        (``_spawn_resolve``), so one tenant's backpressured scored-topic
+        publish can't head-of-line block other families' landed
+        transfers. Overlap accounting happens at materialize time in
+        ``_resolve_flush``: only a transfer whose materialization
+        returned without measurable wait (and that the reaper never
+        raced on) counts as ``d2h_overlapped``."""
+        loop = asyncio.get_running_loop()
+        while True:
+            # a family with a resolve in flight is ineligible: its next
+            # head must wait its turn (per-tenant FIFO)
+            heads = [
+                q[0] for f, q in self._reap.items()
+                if q and f not in self._resolving
+            ]
+            if not heads:
+                # clear-then-wait is race-free on the single-threaded
+                # loop: any set() that mattered already showed in heads
+                self._reap_event.clear()
+                await self._reap_event.wait()
+                continue
+            pf = next((h for h in heads if h.landed()), None)
+            if pf is not None:
+                self._spawn_resolve(pf)
+                continue
+            # no head has landed: race every eligible family's head (plus
+            # the enqueue/resolve-done event — a NEW family's flush must
+            # be able to join the race and win, or one family's slow
+            # transfer would head-of-line block every other family)
+            self._reap_event.clear()
+            waiter = asyncio.ensure_future(self._reap_event.wait())
+            now = time.perf_counter()
+            futs = []
+            for h in heads:
+                if h.t_wait is None:
+                    h.t_wait = now
+                # one future per in-flight FAMILY (a handful), not per row
+                futs.append(h.ensure_host_future(loop, self._deliver_pool))  # hotpath: ok
+            try:
+                await asyncio.wait(
+                    [*futs, waiter], return_when=asyncio.FIRST_COMPLETED
+                )
+            finally:
+                waiter.cancel()
+            pf = next((h for h, f in zip(heads, futs) if f.done()), None)
+            if pf is not None:
+                self._spawn_resolve(pf)
+
+    def _spawn_resolve(self, pf: _PendingFlush) -> None:
+        """Resolve one landed flush in a per-family task. At most one
+        resolve runs per family (the loop skips families in
+        ``_resolving``), which preserves per-tenant in-order delivery;
+        separate tasks restore the cross-family isolation the old
+        per-flush deliver tasks had — a full scored topic only stalls
+        its own family, and only until ``max_inflight`` backpressures
+        the scoring loop as a whole."""
+        task = asyncio.get_running_loop().create_task(
+            self._resolve_flush(pf)
+        )
+        self._resolving[pf.family] = task
+
+        def _done(t: asyncio.Task, family: str = pf.family) -> None:
+            if self._resolving.get(family) is t:
+                del self._resolving[family]
+            if not t.cancelled() and t.exception() is not None:
+                # _resolve_flush handles its own failures; anything
+                # escaping would otherwise vanish with the task
+                self._record_error("deliver", t.exception())
+            # wake the reaper: this family's next head is eligible now
+            self._reap_event.set()
+
+        task.add_done_callback(_done)
+
+    # the honest boundary for the d2h_overlapped counter, since jax has
+    # no "host copy done" probe — shared with the media readback (see
+    # runtime/metrics.py for the rationale)
+    D2H_OVERLAP_EPS_S = _D2H_OVERLAP_EPS_S
+
+    async def _resolve_flush(self, pf: _PendingFlush) -> None:
+        """Materialize one flush's (gathered) scores and resolve its rows.
+
+        Materialization ALWAYS happens off the loop (executor) unless an
+        earlier race already produced the host array — ``is_ready`` only
+        proves device compute finished, so an inline ``np.asarray`` here
+        could still stall the loop for the copy's remaining link time.
+        Worker-thread materialization is safe because ``pf.scores`` is a
+        jit output nothing ever donates — unlike param trees, whose
         buffers later loop-thread calls donate (see
         ``checkpoint.host_copy_params`` for the full invariant)."""
+        _slots, _cols, seqs, rows = pf.taken
+        scattered = False  # did the (possibly unscored) write-back start?
         try:
+            if pf.poisoned:
+                # the dispatch itself failed (breaker/failover already
+                # recorded at the flush site): no transfer to wait for —
+                # resolve the rows unscored, but through this FIFO slot
+                # so they can't overtake an earlier in-flight flush
+                scattered = True
+                await self._resolve_rows(seqs, rows, None)
+                return
             t0 = time.perf_counter()
-            scores_np = await asyncio.get_running_loop().run_in_executor(
-                self._deliver_pool, np.asarray, scores_dev
+            if pf.host_future is not None:
+                scores_np = await pf.host_future
+            else:
+                scores_np = await asyncio.get_running_loop().run_in_executor(
+                    self._deliver_pool, np.asarray, pf.scores
+                )
+            now = time.perf_counter()
+            # cumulative wait: from the FIRST time the reaper waited on
+            # this flush (race rounds included), not just the last await
+            waited_s = now - pf.t_wait if pf.t_wait is not None else now - t0
+            self.metrics.histogram("tpu_inference.d2h_wait", unit="s").record(
+                waited_s
             )
-            self.metrics.histogram(
-                "tpu_inference.materialize", unit="s"
-            ).record(time.perf_counter() - t0)
-            slots, cols, seqs, rows = taken
+            if pf.t_wait is None and waited_s < self.D2H_OVERLAP_EPS_S:
+                # the transfer had fully landed before the reaper asked —
+                # it rode under later compute (raced-on heads never count,
+                # however fast their future resolved afterwards)
+                self.metrics.counter("tpu_inference.d2h_overlapped").inc()
+            t1 = time.perf_counter()
             # wire dtype (bf16/f16) widens back to f32 at the batch edge
-            picks = scores_np[slots, cols].astype(np.float32, copy=False)
+            if pf.gathered:
+                picks = scores_np[: pf.moved].astype(np.float32, copy=False)
+            else:
+                picks = scores_np[_slots, _cols].astype(np.float32, copy=False)
+            # cancellation past this point observes only INSIDE
+            # _resolve_rows' publish loop (the scatter is await-free), so
+            # scores are written and counts decremented exactly once —
+            # the cancel path below must not resolve a second time
+            scattered = True
             await self._resolve_rows(seqs, rows, picks)
-            self._consec_errors.pop(family, None)  # healthy again
-            self._failover_rounds.pop(family, None)
-            breaker = self.breakers.get(family)
+            self.metrics.histogram("tpu_inference.resolve", unit="s").record(
+                time.perf_counter() - t1
+            )
+            self.metrics.counter("tpu_inference.reaped").inc()
+            self.metrics.counter("tpu_inference.d2h_bytes").inc(pf.nbytes)
+            if pf.plane_nbytes:
+                # what the pre-gather path would have moved — the bench's
+                # d2h_plane_reduction column is this ratio
+                self.metrics.counter("tpu_inference.d2h_plane_bytes").inc(
+                    pf.plane_nbytes
+                )
+            self._consec_errors.pop(pf.family, None)  # healthy again
+            self._failover_rounds.pop(pf.family, None)
+            breaker = self.breakers.get(pf.family)
             if breaker is not None:
                 breaker.record_success()
         except asyncio.CancelledError:
             # cancelled mid-flight (forced teardown): the rows were already
-            # popped from lanes, so resolve them unscored or they're lost
-            _, _, seqs, rows = taken
-            await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+            # popped from lanes, so resolve them unscored or they're lost.
+            # But ONLY if the real-score pass never ran — re-resolving
+            # after it would decrement batch row counts a second time
+            # (premature NaN publishes) and overwrite written scores
+            if not scattered:
+                await self._resolve_rows(seqs, rows, None, publish_nowait=True)
             raise
-        except Exception as exc:  # noqa: BLE001 - a failed materialization
-            # must not strand the batches: resolve rows unscored
+        except Exception as exc:  # noqa: BLE001 - a poisoned transfer
+            # must not strand the batches: resolve rows unscored — but
+            # only if the write-back never ran (same double-decrement
+            # hazard as the cancel path above; a fault AFTER it, e.g. a
+            # non-transient publish error, already flushed the remaining
+            # completed batches inside _resolve_rows)
             self._record_error("deliver", exc)
-            _, _, seqs, rows = taken
-            await self._resolve_rows(seqs, rows, None)
-            if family:
-                breaker = self.breakers.get(family)
+            if not scattered:
+                await self._resolve_rows(seqs, rows, None)
+            if not pf.poisoned:
+                # a poisoned flush's dispatch failure was already counted
+                # at the flush site — recording it again here would let a
+                # downstream bus hiccup double-pace failover/parking
+                breaker = self.breakers.get(pf.family)
                 if breaker is not None:
                     breaker.record_failure()
-                await self._note_scorer_error(family)
+                await self._note_scorer_error(pf.family)
         finally:
+            # the head leaves the queue only once its resolution is DONE
+            # (either way) — queue length and the deliver_inflight gauge
+            # honestly count unfinished flushes, and the teardown drain
+            # can't miss a flush the reaper was cancelled inside
+            q = self._reap.get(pf.family)
+            if q and q[0] is pf:
+                q.popleft()
+            self._deliver_gauge()
             self._inflight.release()
+            if (
+                self._last_scores.get(pf.family) is pf.scores
+                and not self._reap.get(pf.family)
+            ):
+                # family idle: the overlap probe must not pin this
+                # flush's device scores until the next (maybe never)
+                # flush — by now the probe is ready, so dropping it
+                # can't change the next overlap verdict
+                self._last_scores.pop(pf.family, None)
 
     # -- legacy object path (low-volume / tests) --------------------------
     async def _enqueue_events(self, engine: TpuInferenceEngine, events: List) -> List:

@@ -40,7 +40,7 @@ from sitewhere_tpu.runtime.lifecycle import (
     LifecycleState,
     cancel_and_wait,
 )
-from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.metrics import D2H_OVERLAP_EPS_S, MetricsRegistry
 from sitewhere_tpu.services.streaming_media import StreamingMedia
 
 
@@ -308,19 +308,36 @@ class MediaClassificationPipeline(LifecycleComponent):
             # sliced off) — no pad allocation, no concatenate
             n = len(metas)
             bucket = next(b for b in self._buckets() if b >= n)
-            # jit dispatch + materialization off the loop (the classify
-            # output is a jit result nothing donates — worker-thread
-            # materialization is safe, see checkpoint.host_copy_params).
-            # staging[:bucket] is one contiguous buffer → one contiguous
-            # host→device put; concurrent classifies on pooled buffers
-            # overlap transfer with the previous batch's compute
-            results = await asyncio.get_running_loop().run_in_executor(
-                None, self.media.classify_frames, staging[:bucket],
+            # jit dispatch off the loop (the classify output is a jit
+            # result nothing donates — worker-thread materialization is
+            # safe, see checkpoint.host_copy_params). staging[:bucket]
+            # is one contiguous buffer → one contiguous host→device put;
+            # concurrent classifies on pooled buffers overlap transfer
+            # with the previous batch's compute. The d2h copy starts
+            # inside the dispatch (copy_to_host_async — same async
+            # treatment as the scoring reaper), so by materialize time
+            # it has been riding under compute, not starting cold.
+            loop = asyncio.get_running_loop()
+            pv, iv = await loop.run_in_executor(
+                None, self.media.classify_frames_dispatch, staging[:bucket],
                 self.top_k, self.tiny,
             )
+            # materialize OFF the loop: is_ready would only prove the
+            # compute finished, not that the async d2h copy crossed the
+            # link — overlap is measured, not inferred (a materialization
+            # that returns in ~0 never waited on the transfer; same rule
+            # as the scoring reaper's D2H_OVERLAP_EPS_S)
+            t_wait = time.perf_counter()
+            results = await loop.run_in_executor(
+                None, self.media.topk_results, pv, iv, n
+            )
+            waited_s = time.perf_counter() - t_wait
+            self.metrics.histogram("media.d2h_wait", unit="s").record(waited_s)
+            if waited_s < D2H_OVERLAP_EPS_S:
+                self.metrics.counter("media.d2h_overlapped").inc()
             now_mono = time.monotonic()
             now = time.time() * 1000.0
-            for (stream_id, seq, t0), top in zip(metas, results[:n]):
+            for (stream_id, seq, t0), top in zip(metas, results):
                 payload = {
                     "type": "media_classification",
                     "tenant": self.tenant,

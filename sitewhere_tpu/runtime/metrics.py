@@ -26,6 +26,16 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 
+# a device→host materialization that returns faster than this never
+# waited on the link (a non-overlapped fetch costs ≥ one transfer RTT:
+# ~100 ms through the tunnel, ~1 ms host-attached) — the honest boundary
+# for the d2h_overlapped counters. Shared by the scoring reaper
+# (tpu_inference.d2h_overlapped) and the media classify readback
+# (media.d2h_overlapped) so their overlap fractions stay comparable.
+# Lives here (not parallel/sharded.py) so jax-free consumers can import
+# it without paying the jax import.
+D2H_OVERLAP_EPS_S = 1e-3
+
 # circuit-breaker state → gauge value (runtime.bus.CircuitBreaker publishes
 # its transitions through a ``breaker.<name>.state`` gauge using this map,
 # so breaker health rides the normal /metrics scrape + snapshot surface)

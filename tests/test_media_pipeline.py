@@ -114,3 +114,39 @@ async def test_bad_chunk_does_not_kill_pipeline():
         assert got and got[0]["seq"] == 1
     finally:
         await inst.terminate()
+
+
+async def test_classify_dispatch_materialize_split_matches_sync():
+    """The async readback halves (dispatch + topk_results) must agree
+    with the one-shot classify_frames — same jit, same top-k — and the
+    pipeline flow through them records the media d2h metrics."""
+    inst = await _media_instance()
+    try:
+        rt = inst.tenants["cam"]
+        media = rt.media
+        size = rt.media_pipeline.image_size
+        rng = np.random.RandomState(7)
+        frames = rng.randint(0, 255, (3, size, size, 3), np.uint8)
+        sync = media.classify_frames(frames, top_k=4, tiny=True)
+        pv, iv = media.classify_frames_dispatch(frames, top_k=4, tiny=True)
+        split = media.topk_results(pv, iv, 3)
+        assert split == sync
+        # n-slicing drops padded rows
+        assert len(media.topk_results(pv, iv, 2)) == 2
+        # drive one batch through the pipeline: the d2h wait histogram
+        # must populate (overlap counter is rig-dependent, not asserted)
+        topic = media_classifications_topic(inst.bus, "cam")
+        inst.bus.subscribe(topic, "test")
+        stream = rt.media.create_stream("asn-split", content_type="video/raw")
+        await rt.media_pipeline.submit_chunk(
+            stream.stream_id, 0, _raw_chunk(size, 3)
+        )
+        got: list = []
+        for _ in range(200):
+            got.extend(await inst.bus.consume(topic, "test", 10, timeout_s=0.05))
+            if got:
+                break
+        assert got
+        assert inst.metrics.histogram("media.d2h_wait", unit="s").count >= 1
+    finally:
+        await inst.terminate()

@@ -17,7 +17,16 @@ and flags
   an inline list comprehension;
 - **per-row string ops**: any ``np.char.*`` usage anywhere in a
   registered module (vectorized-looking, but a Python loop under the
-  hood — ``core.batch.make_event_ids`` shows the cheap alternative).
+  hood — ``core.batch.make_event_ids`` shows the cheap alternative);
+- **blocking d2h materialization**: ``np.asarray`` / ``np.array``
+  applied to a *device array* inside a hot function — a name assigned
+  from a dispatch/staging call (``step`` / ``step_counts`` /
+  ``gather_rows`` / ``stage_inputs`` / ``device_put`` /
+  ``classify_frames_dispatch``) or any name ending in ``_dev``. A
+  blocking materialization stalls the loop for a full device
+  round-trip; start the copy with ``copy_to_host_async`` and resolve
+  through the completion reaper instead (docs/PERFORMANCE.md "Result
+  path").
 
 A line may opt out with a trailing ``# hotpath: ok`` comment (for a
 cold-path branch living inside a hot function). A registry entry whose
@@ -46,6 +55,9 @@ HOT_PATHS: Dict[str, List[str]] = {
     "pipeline/inference.py": [
         "TpuInferenceService._enqueue_batch",
         "TpuInferenceService._flush_family",
+        "TpuInferenceService._resolve_rows",
+        "TpuInferenceService._reap_loop",
+        "TpuInferenceService._resolve_flush",
         "_LaneRing.push",
         "_LaneRing.pop_into",
     ],
@@ -62,6 +74,15 @@ HOT_PATHS: Dict[str, List[str]] = {
 }
 
 _NP_CONVERTERS = {"asarray", "array", "stack", "concatenate", "fromiter"}
+
+# calls whose result is a device array (async until materialized): a
+# blocking np.asarray on one of these names inside a hot function is a
+# full device round-trip on the loop — the reaper's job, not the flush's
+_DEVICE_PRODUCERS = {
+    "step", "step_counts", "gather_rows", "stage_inputs", "device_put",
+    "classify_frames_dispatch",
+}
+_NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "copy"}
 
 
 def _is_np_attr(node: ast.AST, attrs: set) -> bool:
@@ -88,7 +109,11 @@ class _FnScanner(ast.NodeVisitor):
         self.lines = lines
         self.findings: List[str] = []
         self.accumulators: set = set()
+        self.device_names: set = set()
         self._loop_depth = 0
+
+    def _is_device_name(self, name: str) -> bool:
+        return name in self.device_names or name.endswith("_dev")
 
     def _finding(self, node: ast.AST, msg: str) -> None:
         if not _allowed(self.lines, node.lineno):
@@ -101,6 +126,18 @@ class _FnScanner(ast.NodeVisitor):
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     self.accumulators.add(t.id)
+        if (
+            isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr in _DEVICE_PRODUCERS
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.device_names.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            self.device_names.add(el.id)
         self.generic_visit(node)
 
     def _visit_loop(self, node: ast.AST) -> None:
@@ -137,6 +174,18 @@ class _FnScanner(ast.NodeVisitor):
                         node,
                         f"np.{f.attr}(<listcomp>) builds a per-row Python "
                         "list before the array — keep rows columnar",
+                    )
+        if _is_np_attr(f, _NP_MATERIALIZERS):
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and self._is_device_name(
+                    arg.id
+                ):
+                    self._finding(
+                        node,
+                        f"np.{f.attr}('{arg.id}') blocks on a device "
+                        "array — a full device round-trip on the hot "
+                        "path. Start the copy with copy_to_host_async() "
+                        "and resolve via the completion reaper",
                     )
         self.generic_visit(node)
 

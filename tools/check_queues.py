@@ -63,11 +63,19 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         # into the bus (where lag is a gauge and drives overload credit)
         "backpressure_counter": "tpu_inference.lane_backpressure",
     },
+    ("pipeline/inference.py", r"_ReapQueue\("): {
+        "queue": "deliver reap queues (in-flight flush completions per "
+                 "family; bounded by the max_inflight semaphore)",
+        "depth_gauge": "tpu_inference_deliver_inflight",
+        # completions never shed: a full in-flight window backpressures
+        # the NEXT flush at the semaphore (counted before the acquire)
+        "backpressure_counter": "tpu_inference.deliver_backpressure",
+    },
 }
 
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
-    r"|= _LaneRing\(|= _FrameRing\()"
+    r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\()"
 )
 
 
