@@ -196,6 +196,9 @@ class RestApi:
 
         r.add_get("/api/traces", self.list_traces)
         r.add_get("/api/traces/{id}", self.get_trace)
+        r.add_get("/api/flightrec", self.flightrec)
+        r.add_get("/api/flightrec/snapshots", self.flightrec_snapshots)
+        r.add_get("/api/metrics/history", self.metrics_history)
 
         r.add_get("/api/schedules", self.list_schedules)
         r.add_post("/api/schedules", self.create_schedule)
@@ -395,6 +398,70 @@ class RestApi:
         d = tr.to_dict()
         d["traceEvents"] = chrome_trace_events(tr)
         return web.json_response(d)
+
+    async def flightrec(self, request) -> web.Response:
+        """The flight recorder's live rings (per-flush + per-stage
+        blackbox records, oldest→newest) plus snapshot summaries;
+        ``?chrome=1`` adds a Chrome trace-event export joining the host
+        spans with the device dispatch windows (load ``.traceEvents``
+        into Perfetto beside a GET /api/traces/{id} export)."""
+        from sitewhere_tpu.runtime.flightrec import chrome_flush_events
+
+        body = self.instance.flightrec.describe()
+        if request.query.get("chrome", "") in ("1", "true"):
+            body["traceEvents"] = chrome_flush_events(body["rings"])
+        return web.json_response(body)
+
+    async def flightrec_snapshots(self, request) -> web.Response:
+        """Dump-on-incident snapshots (breaker trip / SLO breach /
+        watchdog alert froze the rings). ``?id=N`` returns one snapshot
+        in full, with its Chrome trace-event export; without it, a
+        summary row (id/reason/meta) per retained snapshot newest-last —
+        full rings stay per-id so the listing can't serialize tens of MB
+        on the event loop mid-incident."""
+        from sitewhere_tpu.runtime.flightrec import chrome_flush_events
+
+        fr = self.instance.flightrec
+        snap_id = request.query.get("id", "")
+        if snap_id:
+            try:
+                wanted = int(snap_id)
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad snapshot id {snap_id!r}"}, status=400
+                )
+            snap = fr.get_snapshot(wanted)
+            if snap is None:
+                return web.json_response(
+                    {"error": f"unknown snapshot {snap_id}"}, status=404
+                )
+            body = dict(snap)
+            body["traceEvents"] = chrome_flush_events(snap["rings"])
+            return web.json_response(body)
+        return web.json_response({
+            "snapshots": fr.snapshot_summaries(),
+            "taken": fr.snapshots_taken,
+            "suppressed": fr.snapshots_suppressed,
+        })
+
+    async def metrics_history(self, request) -> web.Response:
+        """The in-process metrics history ring: ``?name=`` (repeatable)
+        filters series, ``?since_s=`` trims to the recent window,
+        ``?step=N`` max-pools N-sample buckets server-side (spikes
+        survive downsampling). Watchdog alerts ride along."""
+        q = request.query
+        names = q.getall("name", []) or None
+        try:
+            since_s = float(q["since_s"]) if "since_s" in q else None
+            step = max(1, int(q.get("step", 1)))
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        body = self.instance.history.series(
+            names=names, since_s=since_s, step=step
+        )
+        wd = self.instance.watchdog
+        body["alerts"] = list(wd.alerts) if wd is not None else []
+        return web.json_response(body)
 
     async def tenant_slo(self, request) -> web.Response:
         """Per-tenant SLO report: stage latency summaries + tail-sampling

@@ -169,6 +169,43 @@ class SiteWhereInstance(LifecycleComponent):
         from sitewhere_tpu.runtime.overload import OverloadController
 
         self.overload = OverloadController(self.metrics, tracer=self.tracer)
+        # flight recorder + metrics history + watchdog: the always-on
+        # blackbox (per-flush/per-stage recent history, dump-on-incident)
+        # and the 15-minute time-series memory its rules watch
+        from sitewhere_tpu.runtime.flightrec import FlightRecorder
+        from sitewhere_tpu.runtime.history import (
+            WATCHDOG_REQUIRED,
+            MetricsHistory,
+            Watchdog,
+        )
+
+        self.flightrec = FlightRecorder()
+        self.tracer.flightrec = self.flightrec  # SLO-breach snapshots
+        allowlist = (
+            tuple(cfg.metrics_history_allowlist)
+            if cfg.metrics_history_allowlist
+            else None
+        )
+        if allowlist is not None and cfg.watchdog_enabled:
+            # a trimmed allowlist must not starve the watchdog's rules
+            # of the families they read — that would silently disable
+            # every rule while the config still claims watchdog_enabled
+            allowlist += tuple(
+                n for n in WATCHDOG_REQUIRED if n not in allowlist
+            )
+        self.history = MetricsHistory(
+            self.metrics,
+            allowlist=allowlist,
+            resolution_s=cfg.history_resolution_s,
+        )
+        self.watchdog = (
+            Watchdog(
+                self.metrics, self.history,
+                flightrec=self.flightrec, tracer=self.tracer,
+            )
+            if cfg.watchdog_enabled
+            else None
+        )
         self.inference = TpuInferenceService(
             self.bus, self.mesh, self.metrics,
             slots_per_shard=cfg.mesh.slots_per_shard,
@@ -176,6 +213,7 @@ class SiteWhereInstance(LifecycleComponent):
             checkpoints=self.checkpoints,
             tracer=self.tracer,
             overload=self.overload,
+            flightrec=self.flightrec,
         )
         # profile hooks: annotate scoring dispatches inside the jax
         # profiler trace when the instance is capturing one
@@ -206,6 +244,7 @@ class SiteWhereInstance(LifecycleComponent):
         self._updates_task: Optional[asyncio.Task] = None
         self._autosave_task: Optional[asyncio.Task] = None
         self._overload_task: Optional[asyncio.Task] = None
+        self._history_task: Optional[asyncio.Task] = None
         self._shared_targets: Optional[list] = None  # see _on_shared_input
         self._profiling = False  # jax.profiler trace active (profile_dir)
         self._debug_nans_set = False  # we flipped the global NaN flag
@@ -446,7 +485,8 @@ class SiteWhereInstance(LifecycleComponent):
             from sitewhere_tpu.pipeline.media import MediaClassificationPipeline
 
             media_pipe = MediaClassificationPipeline(
-                tenant, self.bus, media, self.metrics, tiny=cfg.media_tiny
+                tenant, self.bus, media, self.metrics, tiny=cfg.media_tiny,
+                flightrec=self.flightrec,
             )
         return TenantRuntime(
             tenant=tenant,
@@ -607,6 +647,11 @@ class SiteWhereInstance(LifecycleComponent):
         self._overload_task = asyncio.create_task(
             self._overload_loop(), name=f"{self.name}-overload"
         )
+        # metrics history tick: sample the allowlisted families into the
+        # 15-minute ring and run the watchdog rules over it
+        self._history_task = asyncio.create_task(
+            self._history_loop(), name=f"{self.name}-history"
+        )
 
     OVERLOAD_TICK_S = 0.1
 
@@ -622,6 +667,29 @@ class SiteWhereInstance(LifecycleComponent):
             except Exception as exc:  # noqa: BLE001 - a control-loop
                 # fault must not kill overload protection; next tick retries
                 self._record_error("overload-tick", exc)
+
+    def _refresh_mfu(self) -> None:
+        """Decay every idle MFU gauge — the scoring families AND each
+        tenant's media pipeline account (a stopped video stream must
+        read 0, not its last busy value)."""
+        self.inference.refresh_mfu()
+        for rt in list(self.tenants.values()):
+            if rt.media_pipeline is not None:
+                rt.media_pipeline.refresh_mfu()
+
+    async def _history_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.history.resolution_s)
+            try:
+                # decay idle families' MFU gauges BEFORE sampling so the
+                # ring never records a stale "last busy" value forever
+                self._refresh_mfu()
+                self.history.sample()
+                if self.watchdog is not None:
+                    self.watchdog.evaluate()
+            except Exception as exc:  # noqa: BLE001 - a sampling fault
+                # must not kill the blackbox; next tick retries
+                self._record_error("history-tick", exc)
 
     async def _autosave_loop(self) -> None:
         """Periodic live checkpoint: bounds the loss window of a HARD kill
@@ -646,6 +714,8 @@ class SiteWhereInstance(LifecycleComponent):
         self._autosave_task = None
         await cancel_and_wait(self._overload_task)
         self._overload_task = None
+        await cancel_and_wait(self._history_task)
+        self._history_task = None
         await super().stop()
         # checkpoint-on-stop: a clean shutdown always leaves a current
         # snapshot (engines already saved their params in the cascade)
@@ -662,6 +732,8 @@ class SiteWhereInstance(LifecycleComponent):
         self._autosave_task = None
         await cancel_and_wait(getattr(self, "_overload_task", None))
         self._overload_task = None
+        await cancel_and_wait(getattr(self, "_history_task", None))
+        self._history_task = None
         if self._profiling:
             import jax
 
@@ -776,6 +848,9 @@ class SiteWhereInstance(LifecycleComponent):
         /metrics scrape handler so the labels are current at scrape time —
         a 10^3-topic instance pays this only when someone is looking."""
         m = self.metrics
+        # scrape-time MFU decay: an idle family must scrape as ~0, not
+        # hold its last busy window value
+        self._refresh_mfu()
         m.describe("bus_topic_depth", "retained entries per bus topic")
         m.describe(
             "bus_consumer_lag",

@@ -17,7 +17,13 @@ from dataclasses import dataclass, fields
 from typing import Any, Callable, Dict, Optional
 
 from sitewhere_tpu.models import deepar, lstm_ad, transformer, vit
-from sitewhere_tpu.models.common import param_count
+from sitewhere_tpu.models.common import (
+    deepar_flops_per_row,
+    lstm_ad_flops_per_row,
+    param_count,
+    transformer_flops_per_row,
+    vit_flops_per_image,
+)
 
 __all__ = [
     "ModelSpec",
@@ -42,6 +48,10 @@ class ModelSpec:
     forecast: Optional[Callable] = None
     apply: Optional[Callable] = None      # classifier contract (images)
     train_step: Optional[Callable] = None
+    # analytic matmul FLOPs to score ONE row (or classify one image) at a
+    # given series-window length — the device-time/MFU attribution
+    # contract (models.common; docs/PERFORMANCE.md "MFU accounting")
+    flops_per_row: Optional[Callable] = None
 
 
 MODEL_REGISTRY: Dict[str, ModelSpec] = {
@@ -52,6 +62,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         score=lstm_ad.score,
         loss=lstm_ad.loss,
         train_step=lstm_ad.train_step,
+        flops_per_row=lstm_ad_flops_per_row,
     ),
     "deepar": ModelSpec(
         name="deepar",
@@ -61,6 +72,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         loss=deepar.loss,
         forecast=deepar.forecast,
         train_step=deepar.train_step,
+        flops_per_row=deepar_flops_per_row,
     ),
     "transformer": ModelSpec(
         name="transformer",
@@ -70,6 +82,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         loss=transformer.loss,
         forecast=transformer.forecast,
         train_step=transformer.train_step,
+        flops_per_row=transformer_flops_per_row,
     ),
     "vit_b16": ModelSpec(
         name="vit_b16",
@@ -78,6 +91,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         apply=vit.apply,
         loss=vit.loss,
         train_step=vit.train_step,
+        flops_per_row=vit_flops_per_image,
     ),
 }
 

@@ -156,6 +156,89 @@ def param_count(params: Params) -> int:
     return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
 
 
+# -- analytic FLOP accounting (device-time / MFU attribution) --------------
+#
+# Each model family declares ``flops_per_row(cfg, window)``: the matmul
+# FLOPs (2 × MACs — the MFU convention; elementwise/nonlinearity ops are
+# excluded) the device executes to score ONE row with a length-``window``
+# series window. The scoring hot path multiplies by the flushed PLANE
+# (every padded lane row executes, valid or not) to feed the live
+# ``tpu_flops_total{family}`` / ``tpu_mfu_pct{family}`` accounting, and
+# ``bench.py`` reads its engine MFU from the same functions.
+#
+# Why analytic instead of XLA's cost analysis: XLA's ``cost_analysis()``
+# counts a ``lax.scan`` BODY once, not per trip — for the window-scan
+# models here that under-reports FLOPs by ~(window-1)×, which is exactly
+# the discrepancy between BENCH_r05's 0.043% "MFU" and the chip's real
+# utilization (see docs/PERFORMANCE.md "MFU accounting").
+
+def dense_flops(in_dim: int, out_dim: int) -> float:
+    """Matmul FLOPs for one row through a dense layer (2 per MAC)."""
+    return 2.0 * in_dim * out_dim
+
+
+def lstm_scan_flops(hidden: int, steps: int, in_dim: int = 1) -> float:
+    """One row through an LSTM scan: fused 4-gate input + recurrent
+    matmuls per step."""
+    per_step = dense_flops(in_dim, 4 * hidden) + dense_flops(hidden, 4 * hidden)
+    return per_step * steps
+
+
+def gru_scan_flops(hidden: int, steps: int, in_dim: int = 1) -> float:
+    """One row through a GRU scan: fused 3-gate input + recurrent
+    matmuls per step."""
+    per_step = dense_flops(in_dim, 3 * hidden) + dense_flops(hidden, 3 * hidden)
+    return per_step * steps
+
+
+def transformer_block_flops(dim: int, seq: int, mlp_ratio: int = 4) -> float:
+    """One transformer block over a length-``seq`` sequence (all rows):
+    QKV+output projections, the two attention matmuls, and the MLP."""
+    proj = 4 * dense_flops(dim, dim) * seq              # wq/wk/wv/wo
+    attn = 2 * (2.0 * seq * seq * dim)                  # QK^T and AV
+    mlp = (dense_flops(dim, mlp_ratio * dim)
+           + dense_flops(mlp_ratio * dim, dim)) * seq
+    return proj + attn + mlp
+
+
+def lstm_ad_flops_per_row(cfg, window: int) -> float:
+    """lstm_ad.score: LSTM over window-1 steps + per-step head."""
+    t = max(1, int(window) - 1)
+    return lstm_scan_flops(cfg.hidden, t) + dense_flops(cfg.hidden, 1) * t
+
+
+def deepar_flops_per_row(cfg, window: int) -> float:
+    """deepar.score: GRU encode over window-1 steps + per-step
+    (mu, sigma) heads."""
+    t = max(1, int(window) - 1)
+    return gru_scan_flops(cfg.hidden, t) + 2 * dense_flops(cfg.hidden, 1) * t
+
+
+def transformer_flops_per_row(cfg, window: int) -> float:
+    """transformer.score: embed + causal backbone over window-1 tokens +
+    the (mu, raw_sigma) head."""
+    t = max(1, int(window) - 1)
+    return (
+        dense_flops(1, cfg.dim) * t
+        + cfg.depth * transformer_block_flops(cfg.dim, t)
+        + dense_flops(cfg.dim, 2) * t
+    )
+
+
+def vit_flops_per_image(cfg, window: int = 0) -> float:
+    """vit.apply: patch embed + backbone over N+1 tokens + CLS head.
+    ``window`` is ignored (frames carry no series window) — the arg keeps
+    the ``flops_per_row`` contract uniform across the registry."""
+    del window
+    n = cfg.num_patches
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    return (
+        dense_flops(patch_dim, cfg.dim) * n
+        + cfg.depth * transformer_block_flops(cfg.dim, n + 1)
+        + dense_flops(cfg.dim, cfg.num_classes)
+    )
+
+
 # -- tensor parallelism (Megatron-style, over the mesh 'model' axis) -------
 #
 # Column-parallel Q/K/V and fc1 (each device owns heads/n heads and

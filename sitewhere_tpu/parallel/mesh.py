@@ -104,6 +104,16 @@ class MeshManager:
     def replicated(self) -> NamedSharding:
         return self.sharding()
 
+    def device_labels(self) -> list:
+        """Short stable metric-label strings for the mesh's devices
+        (``"cpu:0"`` / ``"tpu:3"``), in mesh-flat order. Bounded by the
+        mesh size by construction, so stamping them on metric families
+        keeps label cardinality device-count-bounded — the per-device
+        attribution ROADMAP item 1's mesh promotion needs."""
+        return [
+            f"{d.platform}:{d.id}" for d in self.mesh.devices.flat
+        ]
+
     def describe(self) -> dict:
         return {
             "devices": self.n_devices,

@@ -183,6 +183,37 @@ class ShardedScorer:
         """Host→device bytes one staged flush moves (feed observability)."""
         return int(sum(a.nbytes for a in staged))
 
+    # -- device-time / MFU attribution -----------------------------------
+    def flops_per_row(self, b_lane: int = 0) -> float:
+        """Analytic matmul FLOPs the device executes per lane row of one
+        scoring step (``models.common`` — the family's declared
+        ``flops_per_row`` at this scorer's window). ``b_lane`` rides the
+        contract for future bucket-dependent models; the window-scan
+        models here are bucket-independent."""
+        fn = getattr(self.spec, "flops_per_row", None)
+        if fn is None:
+            return 0.0
+        return float(fn(self.cfg, self.window))
+
+    def flops_per_flush(self, b_lane: int) -> float:
+        """FLOPs one flush at lane bucket ``b_lane`` executes: the FULL
+        padded plane (every slot × data-shard × lane row runs through the
+        model, valid or not) × per-row flops. This is what feeds
+        ``tpu_flops_total{family}`` — executed work, the honest MFU
+        numerator for a padded-static-shape engine."""
+        plane_rows = self.n_slots * self.mm.n_data_shards * int(b_lane)
+        return plane_rows * self.flops_per_row(b_lane)
+
+    @property
+    def device_label(self) -> str:
+        """Metric label for the device that anchors this scorer's result
+        path (the gather consolidation target — mesh device 0). Per-flush
+        device attribution on a multi-device mesh stamps this; finer
+        per-shard attribution arrives with the mesh promotion (ROADMAP
+        item 1)."""
+        d = self.mm.mesh.devices.flat[0]
+        return f"{d.platform}:{d.id}"
+
     # -- d2h result path (device-side row gather) ------------------------
     # smallest compiled gather size: flushes smaller than this pad up to
     # it (a few KB of d2h — noise), and the ladder stays short enough to

@@ -324,11 +324,13 @@ class _PendingFlush:
     __slots__ = (
         "family", "scores", "taken", "moved", "gathered", "t_dispatch",
         "nbytes", "plane_nbytes", "host_future", "t_wait", "poisoned",
+        "flops", "rec",
     )
 
     def __init__(
         self, family: str, scores, taken, moved: int, gathered: bool,
         nbytes: int, plane_nbytes: int, poisoned: bool = False,
+        flops: float = 0.0, rec: Optional[dict] = None,
     ) -> None:
         self.family = family
         self.scores = scores
@@ -344,6 +346,11 @@ class _PendingFlush:
         # rides the FIFO so its unscored resolution can't overtake an
         # earlier in-flight flush of the same family
         self.poisoned = poisoned
+        # device-time attribution: FLOPs this flush's padded plane
+        # executes (scorer.flops_per_flush) and the flight-recorder
+        # record completed in place when the flush resolves
+        self.flops = flops
+        self.rec = rec
 
     def landed(self) -> bool:
         """Probably-complete signal used to PRIORITIZE heads: a finished
@@ -487,6 +494,7 @@ class TpuInferenceService(MultitenantService):
         overload=None,
         fair_quantum: int = 4096,
         staging_slots: int = 2,
+        flightrec=None,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
@@ -507,6 +515,13 @@ class TpuInferenceService(MultitenantService):
         # cliff SURVEY §7 warns about), and optional jax.profiler
         # annotations so device time shows up in profile_dir traces
         self.tracer = tracer
+        # flight recorder (runtime.flightrec): always-on per-flush
+        # blackbox records + dump-on-incident (breaker trip) snapshots;
+        # None (direct service construction in tests) = fully guarded out
+        self.flightrec = flightrec
+        # live device-time/MFU attribution per family (runtime.metrics
+        # .MfuAccount; fed by resolved flushes, decayed by refresh_mfu)
+        self._mfu: Dict[str, object] = {}
         self._stage_timers: Dict[str, object] = {}
         self._seen_shapes: set = set()
         self._last_flush: Dict[str, dict] = {}
@@ -701,6 +716,12 @@ class TpuInferenceService(MultitenantService):
                     _i, _v, seqs, rows = lane.pop(lane.count)
                     await self._resolve_rows(seqs, rows, None, publish_nowait=True)
         self._last_scores.clear()  # drop any pinned device score memory
+        if self.mm.n_devices > 1:
+            # cardinality guard (the drop_labeled pattern): a stopped
+            # service's device-labeled children must not be exported
+            # forever — device labels track the LIVE mesh
+            for lbl in self.mm.device_labels():
+                self.metrics.drop_labeled(device=lbl)
         if self._deliver_pool is not None:
             self._deliver_pool.shutdown(wait=False)
             self._deliver_pool = None
@@ -1050,13 +1071,17 @@ class TpuInferenceService(MultitenantService):
             if breaker is not None:
                 breaker.release_trial()  # allowed, but no call was made
             return 0
+        assembly_s = time.perf_counter() - t_asm
         self.metrics.histogram("tpu_inference.flush_assembly", unit="s").record(
-            time.perf_counter() - t_asm
+            assembly_s
         )
 
         taken = (slots_cat, cols_cat, seqs_cat, rows_cat)
         shape_key = (family, b_lane)
         compiling = shape_key not in self._seen_shapes
+        h2d_stage_s: Optional[float] = None  # for the fault record when
+        dispatch_s: Optional[float] = None   # the try below dies early
+        rec: Optional[dict] = None           # blackbox record, once made
         try:
             # h2d prefetch: issue the ASYNC device copy before dispatch.
             # "Overlapped" is measured honestly: the previous flush's
@@ -1077,8 +1102,9 @@ class TpuInferenceService(MultitenantService):
                 st.staged = staged
             else:  # monkeypatched/minimal scorers (tests)
                 staged = (ids, vals, counts)
+            h2d_stage_s = time.perf_counter() - t_stage
             self.metrics.histogram("tpu_inference.h2d_stage", unit="s").record(
-                time.perf_counter() - t_stage
+                h2d_stage_s
             )
             self.metrics.counter("tpu_inference.h2d_staged").inc()
             if overlapped:
@@ -1096,8 +1122,18 @@ class TpuInferenceService(MultitenantService):
             self.metrics.histogram("tpu_inference.dispatch", unit="s").record(
                 dispatch_s
             )
+            disp_labels = {"family": family}
+            if self.mm.n_devices > 1:
+                # multichip path: stamp the device so ROADMAP item 1's
+                # mesh promotion lands with per-device attribution in
+                # place. Cardinality is mesh-bounded (device labels come
+                # only from live mesh devices) and the service drops its
+                # device children on stop (drop_labeled)
+                disp_labels["device"] = getattr(
+                    scorer, "device_label", "device:?"
+                )
             self.metrics.histogram(
-                "tpu_inference_dispatch_seconds", family=family
+                "tpu_inference_dispatch_seconds", **disp_labels
             ).record(dispatch_s)
             if compiling:
                 # first flush at this (family, bucket) shape = XLA compile;
@@ -1118,6 +1154,20 @@ class TpuInferenceService(MultitenantService):
             }
             self.metrics.counter("tpu_inference.flushes").inc()
             self.metrics.counter("tpu_inference.flush_rows").inc(moved)
+            if self.flightrec is not None:
+                # the blackbox record for this flush — completed in place
+                # (d2h/resolve/device timings) when the reaper resolves it
+                rec = self.flightrec.record(
+                    "flush", family,
+                    rows=moved, bucket=b_lane,
+                    assembly_s=round(assembly_s, 6),
+                    h2d_stage_s=round(h2d_stage_s, 6),
+                    dispatch_s=round(dispatch_s, 6),
+                    h2d_overlapped=bool(overlapped),
+                    compiled=compiling,
+                    trace_id=self._flush_trace_id(seqs_cat),
+                    status="inflight",
+                )
             # device-side gather: compact ONLY the flushed rows out of
             # the [T, D*B] score plane before anything crosses d2h —
             # transfer volume becomes rows-proportional (wire dtype),
@@ -1156,14 +1206,55 @@ class TpuInferenceService(MultitenantService):
             self._record_error("step", exc)
             if breaker is not None:
                 breaker.record_failure()
+            err_rec = None
+            if self.flightrec is not None:
+                if rec is not None:
+                    # the flush already has an inflight record (the fault
+                    # hit AFTER dispatch, e.g. device-side slicing):
+                    # complete IT — appending a second record would leave
+                    # a phantom stuck forever at status="inflight" in the
+                    # ring and in any breaker-trip snapshot
+                    rec["status"] = "error"
+                    rec["error"] = repr(exc)
+                    err_rec = rec
+                else:
+                    err_rec = self.flightrec.record(
+                        "flush", family,
+                        rows=moved, bucket=b_lane,
+                        assembly_s=round(assembly_s, 6),
+                        h2d_stage_s=(
+                            round(h2d_stage_s, 6)
+                            if h2d_stage_s is not None else None
+                        ),
+                        dispatch_s=(
+                            round(dispatch_s, 6)
+                            if dispatch_s is not None else None
+                        ),
+                        compiled=compiling,
+                        trace_id=self._flush_trace_id(seqs_cat),
+                        status="error", error=repr(exc),
+                    )
             # resolve the rows unscored THROUGH the reap FIFO, not
             # inline: an earlier flush of this family may still be in
             # flight, and publishing these batches first would hand a
             # tenant its later batch before its earlier one. The permit
             # stays held until the reaper resolves the entry.
             self._reap_enqueue(_PendingFlush(
-                family, None, taken, moved, False, 0, 0, poisoned=True
+                family, None, taken, moved, False, 0, 0, poisoned=True,
+                rec=err_rec,
             ))
+            if (
+                self.flightrec is not None
+                and breaker is not None
+                and breaker.state == "open"
+            ):
+                # breaker TRIP: freeze the blackbox NOW, with the
+                # faulting flush's record (timings + trace_id) already
+                # in the ring it snapshots
+                self.flightrec.snapshot(
+                    f"breaker:{family}", family=family,
+                    trace_id=err_rec.get("trace_id") if err_rec else None,
+                )
             await self._note_scorer_error(family)
             return moved
         try:
@@ -1172,9 +1263,12 @@ class TpuInferenceService(MultitenantService):
             # leak the inflight permit or strand the step's rows (the
             # scoring step itself succeeded; delivery proceeds below)
             self._record_error("train", exc)
+        flops_fn = getattr(scorer, "flops_per_flush", None)
         pf = _PendingFlush(
             family, scores_dev, taken, moved, gathered,
             int(getattr(scores_dev, "nbytes", 0)), plane_nbytes,
+            flops=float(flops_fn(b_lane)) if flops_fn is not None else 0.0,
+            rec=rec,
         )
         if not hasattr(scores_dev, "copy_to_host_async"):
             # no async copy available (test doubles): materialize eagerly
@@ -1184,6 +1278,18 @@ class TpuInferenceService(MultitenantService):
             )
         self._reap_enqueue(pf)
         return moved
+
+    def _flush_trace_id(self, seqs_cat: np.ndarray) -> Optional[str]:
+        """The first packed batch's trace id — links a flight-recorder
+        flush record to its GET /api/traces/{id} trace (one flush packs
+        many batches; the head batch anchors the join)."""
+        if not len(seqs_cat):
+            return None
+        entry = self._batches.get(int(seqs_cat[0]))
+        if entry is None:
+            return None
+        ctx = getattr(entry[0], "trace_ctx", None)
+        return getattr(ctx, "trace_id", None)
 
     def _reap_enqueue(self, pf: _PendingFlush) -> None:
         """Queue one pending flush (normal or poisoned) for the reaper:
@@ -1349,6 +1455,32 @@ class TpuInferenceService(MultitenantService):
         self.metrics.gauge("tpu_inference_deliver_inflight").set(
             sum(len(q) for q in self._reap.values())
         )
+        # labeled variant beside the legacy aggregate: the reap queues
+        # are PER-FAMILY, so per-family depth is where a wedged tenant
+        # family actually shows (the aggregate hides it). Separate
+        # family name — mixing bare and {family} children under one
+        # name would double-count sum() aggregations.
+        for family, q in self._reap.items():
+            self.metrics.gauge(
+                "tpu_inference_deliver_inflight_family", family=family
+            ).set(len(q))
+
+    # -- device-time / MFU attribution -----------------------------------
+    def _mfu_account(self, family: str):
+        acc = self._mfu.get(family)
+        if acc is None:
+            from sitewhere_tpu.runtime.metrics import MfuAccount
+
+            acc = self._mfu[family] = MfuAccount(self.metrics, family)
+        return acc
+
+    def refresh_mfu(self) -> None:
+        """Decay idle families' ``tpu_mfu_pct`` gauges from the sliding
+        window (called by the instance's 1 s history tick and the
+        /metrics scrape — a family that stopped flushing must read 0,
+        not its last busy value)."""
+        for acc in self._mfu.values():
+            acc.refresh()
 
     async def _reap_loop(self) -> None:
         """The completion reaper: resolve in-flight flushes as their d2h
@@ -1472,7 +1604,10 @@ class TpuInferenceService(MultitenantService):
             self.metrics.histogram("tpu_inference.d2h_wait", unit="s").record(
                 waited_s
             )
-            if pf.t_wait is None and waited_s < self.D2H_OVERLAP_EPS_S:
+            d2h_overlapped = (
+                pf.t_wait is None and waited_s < self.D2H_OVERLAP_EPS_S
+            )
+            if d2h_overlapped:
                 # the transfer had fully landed before the reaper asked —
                 # it rode under later compute (raced-on heads never count,
                 # however fast their future resolved afterwards)
@@ -1489,11 +1624,35 @@ class TpuInferenceService(MultitenantService):
             # the cancel path below must not resolve a second time
             scattered = True
             await self._resolve_rows(seqs, rows, picks)
+            resolve_s = time.perf_counter() - t1
             self.metrics.histogram("tpu_inference.resolve", unit="s").record(
-                time.perf_counter() - t1
+                resolve_s
             )
             self.metrics.counter("tpu_inference.reaped").inc()
             self.metrics.counter("tpu_inference.d2h_bytes").inc(pf.nbytes)
+            # device-time / MFU attribution: the dispatch was outstanding
+            # from issue until its transfer landed — that window times
+            # this flush's executed FLOPs (padded plane; see
+            # ShardedScorer.flops_per_flush)
+            device_s = max(0.0, now - pf.t_dispatch)
+            if pf.flops:
+                self._mfu_account(pf.family).record(pf.flops, device_s)
+            d2h_labels = {"family": pf.family}
+            if self.mm.n_devices > 1:
+                scorer = self.scorers.get(pf.family)
+                d2h_labels["device"] = getattr(
+                    scorer, "device_label", "device:?"
+                )
+            self.metrics.counter(
+                "tpu_inference_d2h_bytes_total", **d2h_labels
+            ).inc(pf.nbytes)
+            if pf.rec is not None:
+                # complete the blackbox record in place (see flightrec)
+                pf.rec["d2h_wait_s"] = round(waited_s, 6)
+                pf.rec["d2h_overlapped"] = d2h_overlapped
+                pf.rec["resolve_s"] = round(resolve_s, 6)
+                pf.rec["device_s"] = round(device_s, 6)
+                pf.rec["status"] = "ok"
             if pf.plane_nbytes:
                 # what the pre-gather path would have moved — the bench's
                 # d2h_plane_reduction column is this ratio
@@ -1523,6 +1682,9 @@ class TpuInferenceService(MultitenantService):
             self._record_error("deliver", exc)
             if not scattered:
                 await self._resolve_rows(seqs, rows, None)
+            if pf.rec is not None and not pf.poisoned:
+                pf.rec["status"] = "error"
+                pf.rec["error"] = repr(exc)
             if not pf.poisoned:
                 # a poisoned flush's dispatch failure was already counted
                 # at the flush site — recording it again here would let a
@@ -1530,6 +1692,16 @@ class TpuInferenceService(MultitenantService):
                 breaker = self.breakers.get(pf.family)
                 if breaker is not None:
                     breaker.record_failure()
+                    if (
+                        self.flightrec is not None
+                        and breaker.state == "open"
+                    ):
+                        self.flightrec.snapshot(
+                            f"breaker:{pf.family}", family=pf.family,
+                            trace_id=(
+                                pf.rec.get("trace_id") if pf.rec else None
+                            ),
+                        )
                 await self._note_scorer_error(pf.family)
         finally:
             # the head leaves the queue only once its resolution is DONE

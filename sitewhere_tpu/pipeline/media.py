@@ -131,6 +131,7 @@ class MediaClassificationPipeline(LifecycleComponent):
         # tenant, not just backlog; live video (newest-wins shedding)
         # never usefully holds more than a few classify batches anyway
         ring_capacity: int = 256,
+        flightrec=None,
     ) -> None:
         super().__init__(f"media-pipeline[{tenant}]")
         self.tenant = tenant
@@ -154,6 +155,18 @@ class MediaClassificationPipeline(LifecycleComponent):
         self._task: Optional[asyncio.Task] = None
         self._inflight = asyncio.Semaphore(max_inflight)
         self._deliver_tasks: set = set()
+        # flight-recorder + live MFU attribution for the ViT leg (wired
+        # on start — the flops figure needs the classifier config)
+        self.flightrec = flightrec
+        self._mfu = None
+        self._flops_per_frame = 0.0
+
+    def refresh_mfu(self) -> None:
+        """Decay this tenant's idle ``tpu_mfu_pct`` gauge from the
+        sliding window (instance history tick / scrape — a stream that
+        stopped must read 0, not its last busy value)."""
+        if self._mfu is not None:
+            self._mfu.refresh()
 
     def pending_frames(self) -> int:
         """Decoded frames awaiting classification (media_queue_depth)."""
@@ -221,6 +234,18 @@ class MediaClassificationPipeline(LifecycleComponent):
         await asyncio.get_running_loop().run_in_executor(
             None, self.media._get_classifier, self.tiny
         )
+        # device-time/MFU attribution: per-frame analytic flops from the
+        # classifier config (labeled per tenant — media pipelines are
+        # per-tenant, and drop_labeled(tenant=...) reclaims the children)
+        try:
+            self._flops_per_frame = self.media.classifier_flops_per_frame(
+                self.tiny
+            )
+        except Exception:  # noqa: BLE001 - attribution must not block start
+            self._flops_per_frame = 0.0
+        from sitewhere_tpu.runtime.metrics import MfuAccount
+
+        self._mfu = MfuAccount(self.metrics, "vit_b16", tenant=self.tenant)
         self._task = asyncio.create_task(self._run(), name=self.name)
 
     async def on_stop(self) -> None:
@@ -318,10 +343,14 @@ class MediaClassificationPipeline(LifecycleComponent):
             # treatment as the scoring reaper), so by materialize time
             # it has been riding under compute, not starting cold.
             loop = asyncio.get_running_loop()
+            t_disp0 = time.perf_counter()
             pv, iv = await loop.run_in_executor(
                 None, self.media.classify_frames_dispatch, staging[:bucket],
                 self.top_k, self.tiny,
             )
+            t_disp1 = time.perf_counter()
+            dispatch_s = t_disp1 - t_disp0
+            disp_end_wall_ms = time.time() * 1000.0
             # materialize OFF the loop: is_ready would only prove the
             # compute finished, not that the async d2h copy crossed the
             # link — overlap is measured, not inferred (a materialization
@@ -333,8 +362,33 @@ class MediaClassificationPipeline(LifecycleComponent):
             )
             waited_s = time.perf_counter() - t_wait
             self.metrics.histogram("media.d2h_wait", unit="s").record(waited_s)
-            if waited_s < D2H_OVERLAP_EPS_S:
+            overlapped = waited_s < D2H_OVERLAP_EPS_S
+            if overlapped:
                 self.metrics.counter("media.d2h_overlapped").inc()
+            # device-time/MFU attribution + blackbox record: the window
+            # runs from dispatch RETURN until the top-k landed — the same
+            # definition as the scoring path's device_s (which starts at
+            # _PendingFlush construction, after its dispatch returned);
+            # starting at dispatch issue would count the host dispatch
+            # call and executor-queue wait as chip-busy time
+            device_s = time.perf_counter() - t_disp1
+            if self._mfu is not None and self._flops_per_frame:
+                self._mfu.record(self._flops_per_frame * bucket, device_s)
+            if self.flightrec is not None:
+                # ts_ms must mark the DISPATCH return, not this (post-
+                # resolution) record call: the Chrome export anchors the
+                # host phases to end and the device window to start at
+                # ts_ms, and media only records once the batch resolved
+                self.flightrec.record(
+                    "flush", f"vit_b16[{self.tenant}]",
+                    ts_ms=disp_end_wall_ms,
+                    rows=n, bucket=bucket,
+                    dispatch_s=round(dispatch_s, 6),
+                    d2h_wait_s=round(waited_s, 6),
+                    d2h_overlapped=overlapped,
+                    device_s=round(device_s, 6),
+                    status="ok",
+                )
             now_mono = time.monotonic()
             now = time.time() * 1000.0
             for (stream_id, seq, t0), top in zip(metas, results):

@@ -235,6 +235,16 @@ class InstanceConfig:
     # (jax_debug_nans) — the SURVEY §5 sanitizer-analog flag. Costly
     # (disables async dispatch); for debugging sessions, never production
     debug_nans: bool = False
+    # metrics history ring + watchdog (runtime.history): a ~15-minute,
+    # 1 s-resolution in-process time-series over an allowlist of metric
+    # families (None = runtime.history.DEFAULT_ALLOWLIST), served at
+    # GET /api/metrics/history; the watchdog evaluates its rules every
+    # sample tick (recompile / overlap collapse / credit / d2h-wait
+    # spike) and alerts through watchdog_alerts_total{rule}, forced
+    # trace retention, and a flight-recorder snapshot
+    metrics_history_allowlist: Optional[List[str]] = None
+    history_resolution_s: float = 1.0
+    watchdog_enabled: bool = True
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

@@ -1,0 +1,288 @@
+"""Flight recorder: an always-on blackbox for the scoring hot path.
+
+The observability stack so far answers "how long did it take" (traces,
+labeled histograms) but not "what exactly was the device doing in the
+seconds before things went wrong". This module is the missing blackbox:
+
+- a bounded **per-key ring** of structured records — one per scoring
+  FLUSH (rows, bucket, assembly / h2d-stage / dispatch / d2h-wait /
+  resolve timings, overlap flags, compile events, the first batch's
+  ``trace_id``) plus strided per-stage pipeline records — cheap enough
+  to stay on in production (a record is one small dict append; the
+  32-tenant engine bench reports the measured cost as
+  ``flightrec_overhead_pct``);
+- **dump-on-incident**: a scorer breaker trip, an SLO-breach tail
+  decision, or a watchdog alert calls :meth:`FlightRecorder.snapshot`,
+  which freezes a copy of every ring — the state of the last ~N flushes
+  per family at the moment of the incident — into a bounded snapshot
+  list served over ``GET /api/flightrec/snapshots``. Snapshots are
+  rate-limited per reason so an incident storm can't churn the evidence
+  of the FIRST failure out of the list;
+- a **Chrome trace-event export** (``chrome://tracing`` / Perfetto)
+  that joins the host-side spans (assembly, h2d staging, dispatch call)
+  with the device dispatch window (dispatch → transfer landed) and the
+  readback (d2h wait, resolve) on one timeline per family.
+
+Everything here is event-loop-threaded like the TraceStore — no locks;
+the REST handlers and the recording sites share the loop.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _now_wall_ms() -> float:
+    return time.time() * 1000.0
+
+
+class _Ring:
+    """Fixed-capacity append-only ring of record dicts."""
+
+    __slots__ = ("buf", "head", "count", "total")
+
+    def __init__(self, capacity: int) -> None:
+        self.buf: List[Optional[dict]] = [None] * capacity
+        self.head = 0       # index of the OLDEST record
+        self.count = 0
+        self.total = 0      # lifetime appends (wrap diagnostics)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.buf)
+
+    def append(self, rec: dict) -> None:
+        cap = len(self.buf)
+        if self.count < cap:
+            self.buf[(self.head + self.count) % cap] = rec
+            self.count += 1
+        else:  # full: overwrite the oldest
+            self.buf[self.head] = rec
+            self.head = (self.head + 1) % cap
+        self.total += 1
+
+    def records(self) -> List[dict]:
+        """Oldest → newest copy (the record dicts themselves are shared —
+        in-flight flushes complete their timings in place)."""
+        cap = len(self.buf)
+        return [
+            self.buf[(self.head + i) % cap] for i in range(self.count)
+        ]
+
+
+class FlightRecorder:
+    """Bounded structured blackbox with incident snapshots.
+
+    ``record(kind, key, **fields)`` appends to the ring for ``(kind,
+    key)`` (e.g. ``("flush", "lstm_ad")`` or ``("stage", "t1/decode")``)
+    and returns the record dict so the caller can complete it in place
+    as later phases land (the flush path fills d2h/resolve timings at
+    resolution time). Ring count is capped; the least-recently-touched
+    ring is evicted so hostile key churn can't grow the recorder. The
+    default cap must sit ABOVE the steady-state key population (stage
+    keys are tenant×stage — the benched 32-tenant instance runs ~200 —
+    plus one flush key per family): a cap below it would LRU-churn every
+    ring under round-robin traffic and snapshots would freeze near-empty
+    evidence.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        stage_capacity: int = 64,
+        max_rings: int = 512,
+        max_snapshots: int = 8,
+        min_snapshot_interval_s: float = 5.0,
+        clock=time.monotonic,
+    ) -> None:
+        self.capacity = int(capacity)
+        self.stage_capacity = int(stage_capacity)
+        self.max_rings = int(max_rings)
+        self.min_snapshot_interval_s = float(min_snapshot_interval_s)
+        self._clock = clock
+        # insertion-ordered; move-to-end on touch = LRU eviction order
+        self._rings: Dict[Tuple[str, str], _Ring] = {}
+        self._snapshots: deque = deque(maxlen=max(1, int(max_snapshots)))
+        self._last_snapshot_at: Dict[str, float] = {}
+        self._next_snapshot_id = 1
+        self.snapshots_taken = 0
+        self.snapshots_suppressed = 0
+
+    # -- recording -------------------------------------------------------
+    def _ring(self, kind: str, key: str) -> _Ring:
+        k = (kind, key)
+        ring = self._rings.get(k)
+        if ring is None:
+            if len(self._rings) >= self.max_rings:
+                # evict the least-recently-touched ring (dict order =
+                # touch order; see the move-to-end below)
+                self._rings.pop(next(iter(self._rings)))
+            cap = self.stage_capacity if kind == "stage" else self.capacity
+            ring = self._rings[k] = _Ring(cap)
+        else:
+            # move-to-end: keeps eviction order honest under mixed traffic
+            self._rings[k] = self._rings.pop(k)
+        return ring
+
+    def record(self, kind: str, key: str, **fields: Any) -> dict:
+        """Append one record; returns the (mutable) dict for in-place
+        completion. ``ts_ms`` (wall) is stamped here so the Chrome export
+        can place the record absolutely; callers recording AFTER the fact
+        (the media path records once the batch resolved) pass an explicit
+        ``ts_ms`` marking their dispatch point instead."""
+        rec = {"ts_ms": _now_wall_ms(), **fields}
+        self._ring(kind, str(key)).append(rec)
+        return rec
+
+    # -- views -----------------------------------------------------------
+    def describe(self) -> dict:
+        """Live rings, oldest→newest per key (the REST GET /api/flightrec
+        body, minus the Chrome export)."""
+        out: Dict[str, dict] = {}
+        for (kind, key), ring in self._rings.items():
+            out.setdefault(kind, {})[key] = {
+                "capacity": ring.capacity,
+                "total": ring.total,
+                "records": ring.records(),
+            }
+        return {
+            "rings": out,
+            "snapshots": [self._snapshot_summary(s) for s in self._snapshots],
+        }
+
+    @staticmethod
+    def _snapshot_summary(snap: dict) -> dict:
+        return {
+            "id": snap["id"],
+            "reason": snap["reason"],
+            "ts_ms": snap["ts_ms"],
+            "meta": snap["meta"],
+            "n_records": snap["n_records"],
+        }
+
+    # -- incident snapshots ----------------------------------------------
+    def snapshot(self, reason: str, **meta: Any) -> Optional[dict]:
+        """Freeze a copy of every ring under ``reason``. Rate-limited per
+        reason (``min_snapshot_interval_s``) so a flapping incident can't
+        churn earlier evidence out of the bounded snapshot list; returns
+        None when suppressed."""
+        now = self._clock()
+        last = self._last_snapshot_at.get(reason)
+        if last is not None and now - last < self.min_snapshot_interval_s:
+            self.snapshots_suppressed += 1
+            return None
+        self._last_snapshot_at[reason] = now
+        rings: Dict[str, dict] = {}
+        n = 0
+        for (kind, key), ring in self._rings.items():
+            # records are completed in place by in-flight flushes; the
+            # snapshot must be immutable evidence — copy each dict
+            recs = [dict(r) for r in ring.records()]
+            rings.setdefault(kind, {})[key] = recs
+            n += len(recs)
+        snap = {
+            "id": self._next_snapshot_id,
+            "reason": reason,
+            "ts_ms": _now_wall_ms(),
+            "meta": dict(meta),
+            "n_records": n,
+            "rings": rings,
+        }
+        self._next_snapshot_id += 1
+        self._snapshots.append(snap)
+        self.snapshots_taken += 1
+        return snap
+
+    def snapshots(self) -> List[dict]:
+        return list(self._snapshots)
+
+    def snapshot_summaries(self) -> List[dict]:
+        """Id/reason/meta/ts rows for every retained snapshot — the REST
+        listing body. Full rings are per-``id`` fetches only: several
+        retained snapshots × up to ``max_rings`` rings each can be tens
+        of MB, which the listing must not serialize inline on the event
+        loop mid-incident."""
+        return [self._snapshot_summary(s) for s in self._snapshots]
+
+    def get_snapshot(self, snap_id: int) -> Optional[dict]:
+        for s in self._snapshots:
+            if s["id"] == snap_id:
+                return s
+        return None
+
+
+# -- Chrome trace-event export ---------------------------------------------
+#
+# One timeline per family (pid), with host and device phases on separate
+# tracks (tid): the host lane shows assembly → h2d stage → dispatch call,
+# the device lane shows the dispatch window (dispatch issued → transfer
+# landed — the span the chip + link were busy on this flush), and the
+# readback lane shows d2h wait and host resolve. Loading this next to a
+# GET /api/traces/{id} export lines the pipeline spans up with the device
+# windows they paid for.
+
+_FLUSH_PHASES = (
+    # (slice name, duration field, track)
+    ("assembly", "assembly_s", "host"),
+    ("h2d_stage", "h2d_stage_s", "host"),
+    ("dispatch", "dispatch_s", "host"),
+    ("device", "device_s", "device"),
+    ("d2h_wait", "d2h_wait_s", "readback"),
+    ("resolve", "resolve_s", "readback"),
+)
+
+
+def chrome_flush_events(rings: Dict[str, dict]) -> List[dict]:
+    """Trace-event JSON for the ``flush`` rings of a ``describe()`` /
+    snapshot body. Host phases are laid out back-to-back ending at the
+    record's dispatch point; the device window starts there; d2h/resolve
+    follow the device window (their true interleaving is what the
+    timings measured — the export preserves durations and the dispatch
+    anchor, which is what's diagnostic)."""
+    out: List[dict] = []
+    flush = rings.get("flush", {})
+    for family, body in flush.items():
+        recs = body["records"] if isinstance(body, dict) else body
+        for rec in recs:
+            # ts_ms marks record creation = just after dispatch returned
+            host_end = rec["ts_ms"] * 1000.0  # Chrome wants µs
+            host_dur = sum(
+                (rec.get(f) or 0.0)
+                for _n, f, track in _FLUSH_PHASES
+                if track == "host"
+            ) * 1e6
+            host_cursor = host_end - host_dur
+            # device window starts where the host dispatch call returned;
+            # the readback phases follow it sequentially
+            rb_cursor = host_end + (rec.get("device_s") or 0.0) * 1e6
+            for name, fieldname, track in _FLUSH_PHASES:
+                dur_s = rec.get(fieldname)
+                if not dur_s:
+                    continue
+                if track == "host":
+                    ts = host_cursor
+                    host_cursor += dur_s * 1e6
+                elif track == "device":
+                    ts = host_end
+                else:  # readback
+                    ts = rb_cursor
+                    rb_cursor += dur_s * 1e6
+                args = {
+                    k: rec[k]
+                    for k in ("rows", "bucket", "compiled", "trace_id",
+                              "error", "status")
+                    if rec.get(k) is not None
+                }
+                out.append({
+                    "name": name,
+                    "cat": "flightrec",
+                    "ph": "X",
+                    "ts": ts,
+                    "dur": max(dur_s * 1e6, 1.0),
+                    "pid": family,
+                    "tid": track,
+                    "args": args,
+                })
+    return out

@@ -1,0 +1,486 @@
+"""In-process metrics history ring + watchdog.
+
+The /metrics scrape is a point-in-time cut: by the time an operator looks,
+the interesting transient (the overlap collapse, the credit dip, the
+recompile burst) is gone. This module keeps the **recent past** resident:
+
+- :class:`MetricsHistory` — a ~15-minute, 1-second-resolution time-series
+  ring over a configurable allowlist of metric families. Counters and
+  gauges sample their value; histograms sample their p99 plus auxiliary
+  cumulative ``<name>#count`` / ``<name>#sum`` series (histogram state is
+  lifetime-cumulative, so a lifetime p99 barely moves after hours of
+  uptime — window rules need deltas to form a true window mean). Labeled
+  families expand to one series per live child (bounded by live tenants /
+  families / devices — the same cardinality guard as the registry).
+  Served over ``GET /api/metrics/history`` with server-side
+  downsampling (``step=N`` max-pools N-sample buckets, preserving
+  spikes).
+- :class:`Watchdog` — rules evaluated every sample tick against the
+  history, each with a cooldown so a persistent condition alerts once
+  per window instead of once per second:
+
+  * ``steady_state_recompile`` — ``tpu_inference.compiles`` moved after
+    the warmup window (a mid-traffic XLA compile is the classic p99
+    cliff; prewarm was supposed to cover every shape);
+  * ``h2d_overlap_collapse`` / ``d2h_overlap_collapse`` — the overlap
+    fraction the feed/result paths are built around dropped to ~zero
+    after having been healthy (transfer no longer rides under compute);
+  * ``overload_credit`` — a tenant's intake credit pinned below 1 for a
+    sustained window (the overload controller is throttling it);
+  * ``d2h_wait_spike`` — the d2h wait's WINDOW mean (from count/sum
+    deltas) jumped vs the previous window (link stall / device
+    contention).
+
+  A firing rule bumps ``watchdog_alerts_total{rule}``, forces trace
+  retention for a window (every tail decision keeps its trace —
+  ``Tracer.force_retain``), and snapshots the flight recorder, so the
+  evidence around the alert is preserved without anyone watching.
+
+Single-threaded like the rest of the runtime observability: the instance
+samples from its 1 s loop on the event loop thread.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Families worth 15 minutes of memory by default: the scoring hot path's
+# health signals plus the overload-control pressure signals. Entries match
+# a snapshot key exactly or any labeled child of it (``entry{...}``).
+DEFAULT_ALLOWLIST: Tuple[str, ...] = (
+    "tpu_inference.flushes",
+    "tpu_inference.flush_rows",
+    "tpu_inference.compiles",
+    "tpu_inference.scored_total",
+    "tpu_inference.h2d_staged",
+    "tpu_inference.h2d_overlapped",
+    "tpu_inference.reaped",
+    "tpu_inference.d2h_overlapped",
+    "tpu_inference.d2h_wait",          # histogram → p99 series
+    "tpu_inference.latency",           # histogram → p99 series
+    "tpu_inference_deliver_inflight",
+    "tpu_inference_lane_rows",
+    "tpu_mfu_pct",
+    "tpu_device_seconds_total",
+    "overload_credit",
+    "overload_degradation_level",
+    "watchdog_alerts_total",
+)
+
+# Families the Watchdog rules read from the history ring. A custom
+# ``metrics_history_allowlist`` that omits these would starve every rule
+# of data — each would permanently return None while the config still
+# claims ``watchdog_enabled`` — so the instance unions them in whenever
+# the watchdog is on.
+WATCHDOG_REQUIRED: Tuple[str, ...] = (
+    "tpu_inference.compiles",
+    "tpu_inference.h2d_staged",
+    "tpu_inference.h2d_overlapped",
+    "tpu_inference.reaped",
+    "tpu_inference.d2h_overlapped",
+    "tpu_inference.d2h_wait",
+    "overload_credit",
+)
+
+
+class MetricsHistory:
+    """Fixed-capacity 1 s-resolution ring of allowlisted metric samples."""
+
+    def __init__(
+        self,
+        registry,
+        allowlist: Optional[Tuple[str, ...]] = None,
+        capacity: int = 900,           # 15 min at 1 s
+        resolution_s: float = 1.0,
+        clock=time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self.allowlist = tuple(allowlist) if allowlist else DEFAULT_ALLOWLIST
+        self.capacity = int(capacity)
+        self.resolution_s = float(resolution_s)
+        self._clock = clock
+        self._ts = np.full((self.capacity,), np.nan, np.float64)
+        self._series: Dict[str, np.ndarray] = {}
+        self._cursor = 0    # next write index
+        self.count = 0      # valid samples (≤ capacity)
+        self.total = 0      # lifetime samples (wrap diagnostics)
+
+    # -- collection ------------------------------------------------------
+    def _matches(self, key: str) -> bool:
+        for entry in self.allowlist:
+            if key == entry or (
+                key.startswith(entry) and key[len(entry):][:1] == "{"
+            ):
+                return True
+        return False
+
+    def _write(self, key: str, idx: int, val: float, seen: set) -> None:
+        arr = self._series.get(key)
+        if arr is None:
+            arr = self._series[key] = np.full(
+                (self.capacity,), np.nan, np.float64
+            )
+        arr[idx] = float(val)
+        seen.add(key)
+
+    def sample(self, now: Optional[float] = None) -> int:
+        """Record one tick from the allowlisted registry families;
+        returns the number of series written. Series appearing mid-flight
+        backfill NaN; series that vanished (dropped labels) record NaN
+        from then on."""
+        now = self._clock() if now is None else now
+        snap = self.registry.snapshot_families(self.allowlist)
+        idx = self._cursor
+        self._ts[idx] = now
+        seen = set()
+        for key, val in snap.items():
+            if not self._matches(key):
+                continue
+            if isinstance(val, dict):      # histogram summary → p99 + the
+                # cumulative count/sum feed the windowed rules delta over
+                n = val.get("count", 0.0)
+                self._write(key + "#count", idx, n, seen)
+                self._write(
+                    key + "#sum", idx, val.get("mean", 0.0) * n, seen
+                )
+                val = val.get("p99", 0.0)
+            self._write(key, idx, float(val), seen)
+        for key, arr in self._series.items():
+            if key not in seen:
+                arr[idx] = np.nan
+        self._cursor = (idx + 1) % self.capacity
+        self.count = min(self.count + 1, self.capacity)
+        self.total += 1
+        return len(seen)
+
+    # -- access ----------------------------------------------------------
+    def _ordered(self, arr: np.ndarray) -> np.ndarray:
+        """Ring → oldest-first contiguous view (copy)."""
+        if self.count < self.capacity:
+            return arr[: self.count].copy()
+        return np.concatenate((arr[self._cursor:], arr[: self._cursor]))
+
+    def names(self) -> List[str]:
+        return sorted(self._series)
+
+    def values(self, name: str) -> Optional[np.ndarray]:
+        arr = self._series.get(name)
+        if arr is None:
+            return None
+        return self._ordered(arr)
+
+    def timestamps(self) -> np.ndarray:
+        return self._ordered(self._ts)
+
+    def latest(self, name: str) -> Optional[float]:
+        v = self.values(name)
+        if v is None or not len(v) or np.isnan(v[-1]):
+            return None
+        return float(v[-1])
+
+    def value_ago(self, name: str, samples_ago: int) -> Optional[float]:
+        v = self.values(name)
+        if v is None or len(v) <= samples_ago:
+            return None
+        x = v[-1 - samples_ago]
+        return None if np.isnan(x) else float(x)
+
+    def delta(self, name: str, samples: int) -> Optional[float]:
+        """Counter movement over the last ``samples`` ticks."""
+        now = self.latest(name)
+        then = self.value_ago(name, samples)
+        if now is None or then is None:
+            return None
+        return now - then
+
+    def children(self, family: str) -> List[str]:
+        prefix = family + "{"
+        return sorted(
+            k for k in self._series if k == family or k.startswith(prefix)
+        )
+
+    @staticmethod
+    def downsample(values: np.ndarray, step: int) -> List[Optional[float]]:
+        """Max-pool ``step``-sample buckets (NaN-aware — spikes survive,
+        all-NaN buckets render null)."""
+        step = max(1, int(step))
+        out: List[Optional[float]] = []
+        for i in range(0, len(values), step):
+            chunk = values[i : i + step]
+            if np.isnan(chunk).all():
+                out.append(None)
+            else:
+                out.append(float(np.nanmax(chunk)))
+        return out
+
+    def series(
+        self,
+        names: Optional[List[str]] = None,
+        since_s: Optional[float] = None,
+        step: int = 1,
+    ) -> dict:
+        """The ``GET /api/metrics/history`` body: per-series downsampled
+        values on a shared (downsampled) time base."""
+        ts = self.timestamps()
+        start = 0
+        if since_s is not None and len(ts):
+            now = self._clock()
+            valid = ~np.isnan(ts)
+            recent = valid & (ts >= now - float(since_s))
+            idx = np.flatnonzero(recent)
+            start = int(idx[0]) if len(idx) else len(ts)
+        ts = ts[start:]
+        if names:
+            # a FAMILY name expands to its labeled children (most
+            # allowlisted families are labeled-only — an exact lookup
+            # would silently return nothing for them)
+            picked = list(dict.fromkeys(
+                k for n in names for k in (self.children(n) or [n])
+            ))
+        else:
+            picked = self.names()
+        out = {}
+        for name in picked:
+            v = self.values(name)
+            if v is None:
+                continue
+            out[name] = self.downsample(v[start:], step)
+        now = self._clock()
+        return {
+            "resolution_s": self.resolution_s * max(1, int(step)),
+            "samples": len(self.downsample(ts, step)) if len(ts) else 0,
+            # ages (seconds before "now") instead of raw monotonic stamps
+            "age_s": [
+                None if x is None else round(max(0.0, now - x), 3)
+                for x in self.downsample(ts, step)
+            ],
+            "series": out,
+        }
+
+
+class Watchdog:
+    """History-driven anomaly rules with alert plumbing (see module doc)."""
+
+    def __init__(
+        self,
+        registry,
+        history: MetricsHistory,
+        flightrec=None,
+        tracer=None,
+        *,
+        window: float = 60.0,          # rule lookback, seconds
+        warmup: float = 120.0,         # recompile-rule grace, seconds
+        cooldown_s: float = 60.0,      # per-rule re-alert hold-down
+        min_flushes: int = 20,         # overlap rules need real traffic
+        overlap_healthy: float = 0.3,
+        overlap_collapsed: float = 0.05,
+        credit_window: float = 30.0,   # seconds
+        d2h_spike_ratio: float = 4.0,
+        d2h_spike_floor_s: float = 0.05,
+        d2h_spike_min_count: int = 10,
+        force_retain_s: float = 60.0,
+        clock=time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self.history = history
+        self.flightrec = flightrec
+        self.tracer = tracer
+        # windows are GIVEN in seconds but the history is indexed in
+        # samples — convert through the ring's actual resolution (the
+        # instance's history_resolution_s is configurable; rules sized
+        # in raw sample counts would silently rescale with it). Each is
+        # then clamped to what the ring can actually hold: the overlap /
+        # d2h rules look back 2*window samples and the recompile gate
+        # compares against history.count (which caps at capacity), so
+        # windows past those bounds would make the rules permanently
+        # return None — a silently dead watchdog
+        res = max(1e-9, float(history.resolution_s))
+        cap = int(history.capacity)
+        self.window_s = float(window)
+        self.warmup_s = float(warmup)
+        self.credit_window_s = float(credit_window)
+        self.window = min(
+            max(1, int(round(window / res))), max(1, (cap - 1) // 2)
+        )
+        self.warmup = min(max(1, int(round(warmup / res))), cap - 1)
+        self.credit_window = min(
+            max(1, int(round(credit_window / res))), cap
+        )
+        self.cooldown_s = cooldown_s
+        self.min_flushes = min_flushes
+        self.overlap_healthy = overlap_healthy
+        self.overlap_collapsed = overlap_collapsed
+        self.d2h_spike_ratio = d2h_spike_ratio
+        self.d2h_spike_floor_s = d2h_spike_floor_s
+        self.d2h_spike_min_count = d2h_spike_min_count
+        self.force_retain_s = force_retain_s
+        self._clock = clock
+        self._last_fired: Dict[str, float] = {}
+        self.alerts: deque = deque(maxlen=64)
+        registry.describe(
+            "watchdog_alerts_total", "watchdog rule firings, by rule"
+        )
+        registry.describe(
+            "watchdog_rule_errors_total",
+            "watchdog rule evaluations that raised, by rule",
+        )
+
+    # -- rules (each returns a detail string when firing) ----------------
+    def _rule_steady_state_recompile(self) -> Optional[str]:
+        if self.history.count <= self.warmup:
+            return None
+        d = self.history.delta("tpu_inference.compiles", self.window)
+        if d is not None and d > 0:
+            return (
+                f"{int(d)} XLA compile(s) in the last "
+                f"{self.window_s:g}s of steady state"
+            )
+        return None
+
+    def _overlap_fraction(
+        self, num: str, den: str, newer: int, older: int
+    ) -> Optional[float]:
+        """Overlap fraction over the sample interval [-older, -newer)."""
+        dn = self.history.value_ago(num, newer)
+        dn0 = self.history.value_ago(num, older)
+        dd = self.history.value_ago(den, newer)
+        dd0 = self.history.value_ago(den, older)
+        if None in (dn, dn0, dd, dd0):
+            return None
+        flushes = dd - dd0
+        if flushes < self.min_flushes:
+            return None
+        return (dn - dn0) / flushes
+
+    def _rule_overlap_collapse(self, num: str, den: str) -> Optional[str]:
+        w = self.window
+        now_f = self._overlap_fraction(num, den, 0, w)
+        prev_f = self._overlap_fraction(num, den, w, 2 * w)
+        if (
+            now_f is not None
+            and prev_f is not None
+            and prev_f >= self.overlap_healthy
+            and now_f <= self.overlap_collapsed
+        ):
+            return (
+                f"overlap fraction {prev_f:.2f} → {now_f:.2f} over the "
+                f"last {self.window_s:g}s"
+            )
+        return None
+
+    def _rule_h2d_overlap_collapse(self) -> Optional[str]:
+        return self._rule_overlap_collapse(
+            "tpu_inference.h2d_overlapped", "tpu_inference.h2d_staged"
+        )
+
+    def _rule_d2h_overlap_collapse(self) -> Optional[str]:
+        return self._rule_overlap_collapse(
+            "tpu_inference.d2h_overlapped", "tpu_inference.reaped"
+        )
+
+    def _rule_overload_credit(self) -> Optional[str]:
+        # one alert names EVERY currently-throttled tenant: the rule
+        # shares a single cooldown, so returning on the first hit would
+        # leave concurrently-throttled tenants unalerted (and
+        # un-snapshotted) for the whole hold-down
+        hits = []
+        for name in self.history.children("overload_credit"):
+            v = self.history.values(name)
+            if v is None or len(v) < self.credit_window:
+                continue
+            tail = v[-self.credit_window:]
+            if np.isnan(tail).any():
+                continue
+            if (tail < 1.0).all():
+                hits.append(f"{name} (now {tail[-1]:.2f})")
+        if hits:
+            return (
+                f"credit < 1 for {self.credit_window_s:g}s: "
+                + ", ".join(hits)
+            )
+        return None
+
+    def _windowed_mean(
+        self, hname: str, newer: int, older: int
+    ) -> Optional[float]:
+        """Mean histogram value over the sample interval [-older, -newer)
+        from the cumulative count/sum deltas — the histogram itself is
+        lifetime-cumulative, so its p99 goes inert as uptime grows; only
+        deltas see the recent window."""
+        c1 = self.history.value_ago(hname + "#count", newer)
+        c0 = self.history.value_ago(hname + "#count", older)
+        s1 = self.history.value_ago(hname + "#sum", newer)
+        s0 = self.history.value_ago(hname + "#sum", older)
+        if None in (c0, c1, s0, s1):
+            return None
+        dc = c1 - c0
+        if dc < self.d2h_spike_min_count:
+            return None
+        return (s1 - s0) / dc
+
+    def _rule_d2h_wait_spike(self) -> Optional[str]:
+        w = self.window
+        now_m = self._windowed_mean("tpu_inference.d2h_wait", 0, w)
+        prev_m = self._windowed_mean("tpu_inference.d2h_wait", w, 2 * w)
+        if now_m is None or prev_m is None:
+            return None
+        if now_m >= self.d2h_spike_floor_s and (
+            now_m > self.d2h_spike_ratio * max(prev_m, 1e-9)
+        ):
+            return (
+                f"d2h_wait window mean {prev_m * 1e3:.1f} ms → "
+                f"{now_m * 1e3:.1f} ms over {self.window_s:g}s"
+            )
+        return None
+
+    RULES = (
+        ("steady_state_recompile", "_rule_steady_state_recompile"),
+        ("h2d_overlap_collapse", "_rule_h2d_overlap_collapse"),
+        ("d2h_overlap_collapse", "_rule_d2h_overlap_collapse"),
+        ("overload_credit", "_rule_overload_credit"),
+        ("d2h_wait_spike", "_rule_d2h_wait_spike"),
+    )
+
+    # -- evaluation ------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Run every rule; fire alerts past their cooldown. Returns the
+        alerts fired this tick."""
+        now = self._clock() if now is None else now
+        fired: List[dict] = []
+        for rule, method in self.RULES:
+            try:
+                detail = getattr(self, method)()
+            except Exception:  # noqa: BLE001 - a rule bug must not kill
+                # the instance's sampling loop — but it must not go dark
+                # either: a rule raising every tick would otherwise be
+                # dead for the life of the process with zero evidence
+                self.registry.counter(
+                    "watchdog_rule_errors_total", rule=rule
+                ).inc()
+                continue
+            if detail is None:
+                continue
+            last = self._last_fired.get(rule)
+            if last is not None and now - last < self.cooldown_s:
+                continue
+            self._last_fired[rule] = now
+            self.registry.counter("watchdog_alerts_total", rule=rule).inc()
+            alert = {
+                "rule": rule,
+                "detail": detail,
+                "ts_ms": time.time() * 1000.0,
+            }
+            self.alerts.append(alert)
+            fired.append(alert)
+            if self.tracer is not None:
+                # keep EVERY trace for a window after the alert — the
+                # traffic around an anomaly is exactly what tail sampling
+                # would otherwise throw away
+                self.tracer.force_retain(self.force_retain_s * 1000.0)
+            if self.flightrec is not None:
+                self.flightrec.snapshot(f"watchdog:{rule}", detail=detail)
+        return fired

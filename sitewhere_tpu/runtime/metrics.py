@@ -36,6 +36,14 @@ from typing import Dict, List, Optional, Tuple
 # it without paying the jax import.
 D2H_OVERLAP_EPS_S = 1e-3
 
+# bf16 peak of one TPU v5e chip — THE denominator for every MFU figure in
+# the repo (``tpu_mfu_pct{family}`` live gauges, bench.py's engine MFU, the
+# check_bench regression gate). The CPU backend reports against the same
+# peak by design, so CPU MFU reads ~0 and the number stays comparable
+# across rigs. Lives here (jax-free) so bench, the scoring service, and
+# the jax-free media module can all import one constant.
+PEAK_FLOPS_BF16 = 197e12
+
 # circuit-breaker state → gauge value (runtime.bus.CircuitBreaker publishes
 # its transitions through a ``breaker.<name>.state`` gauge using this map,
 # so breaker health rides the normal /metrics scrape + snapshot surface)
@@ -256,6 +264,75 @@ class MeterRate:
         return total / elapsed
 
 
+class MfuAccount:
+    """Live device-time & MFU attribution for one model family.
+
+    Every resolved scoring flush (or media classify batch) reports the
+    FLOPs the device executed (padded plane × analytic per-row flops —
+    ``models.common``) and the wall seconds its dispatch was outstanding
+    (dispatch → transfer landed). The account feeds three metric
+    families:
+
+    - ``tpu_flops_total{family}``          — executed model FLOPs;
+    - ``tpu_device_seconds_total{family}`` — dispatch→ready seconds;
+    - ``tpu_mfu_pct{family}``              — live gauge: FLOP/s over the
+      sliding window ÷ ``peak`` × 100. The window rate reuses MeterRate,
+      so the gauge is honest right after startup and decays to 0 when
+      the family goes idle (refresh on read via :meth:`refresh`).
+
+    ``bench.py`` computes its engine MFU from the SAME per-row flops
+    functions over wall time, so the live gauge and the bench agree by
+    construction (the 5% acceptance bar is slack for window edges).
+    """
+
+    __slots__ = ("family", "peak", "_flops_c", "_secs_c", "_gauge", "_meter")
+
+    def __init__(
+        self,
+        registry: "MetricsRegistry",
+        family: str,
+        peak: float = PEAK_FLOPS_BF16,
+        window_s: float = 10.0,
+        **extra_labels: str,
+    ) -> None:
+        self.family = family
+        self.peak = float(peak)
+        labels = {"family": family, **extra_labels}
+        registry.describe(
+            "tpu_flops_total", "executed model FLOPs per family "
+            "(analytic matmul count x padded plane rows)"
+        )
+        registry.describe(
+            "tpu_device_seconds_total",
+            "wall seconds scoring dispatches were outstanding "
+            "(dispatch -> transfer landed) per family",
+        )
+        registry.describe(
+            "tpu_mfu_pct", "live MFU: windowed FLOP/s / chip peak x 100"
+        )
+        self._flops_c = registry.counter("tpu_flops_total", **labels)
+        self._secs_c = registry.counter(
+            "tpu_device_seconds_total", **labels
+        )
+        self._gauge = registry.gauge("tpu_mfu_pct", **labels)
+        self._meter = MeterRate(f"mfu.{family}", window_s=window_s)
+
+    def record(self, flops: float, device_s: float) -> None:
+        if flops <= 0 and device_s <= 0:
+            return
+        self._flops_c.inc(float(flops))
+        self._secs_c.inc(max(0.0, float(device_s)))
+        self._meter.mark(float(flops))
+        self._gauge.set(100.0 * self._meter.rate() / self.peak)
+
+    def refresh(self) -> float:
+        """Re-derive the gauge from the current window (scrape-time decay
+        for idle families); returns the pct."""
+        pct = 100.0 * self._meter.rate() / self.peak
+        self._gauge.set(pct)
+        return pct
+
+
 class MetricsRegistry:
     """Named metric registry; one per instance, shared across services."""
 
@@ -344,23 +421,53 @@ class MetricsRegistry:
             m = self._meters.setdefault(name, MeterRate(name, window_s))
         return m
 
-    def snapshot(self) -> Dict[str, object]:
-        out: Dict[str, object] = {}
-        for n, c in list(self._counters.items()):
-            out[n] = c.value
-        for n, g in list(self._gauges.items()):
-            out[n] = g.value
-        for n, h in list(self._histos.items()):
-            out[n] = h.summary()
-        for n, m in list(self._meters.items()):
-            out[n] = m.rate()
-        for name, fam in list(self._labeled.items()):
+    def _snapshot_family(self, name: str, out: Dict[str, object]) -> None:
+        """Serialize one family — unlabeled value/summary/rate plus every
+        labeled child under its ``name{labels}`` key — into ``out``. The
+        single definition snapshot() and snapshot_families() share, so
+        the scrape and the metrics-history tick can't diverge."""
+        c = self._counters.get(name)
+        if c is not None:
+            out[name] = c.value
+        g = self._gauges.get(name)
+        if g is not None:
+            out[name] = g.value
+        h = self._histos.get(name)
+        if h is not None:
+            out[name] = h.summary()
+        m = self._meters.get(name)
+        if m is not None:
+            out[name] = m.rate()
+        fam = self._labeled.get(name)
+        if fam is not None:
             for _key, metric in list(fam.items()):
                 k = f"{name}{{{_labels_text(metric.labels)}}}"
                 if isinstance(metric, Histogram):
                     out[k] = metric.summary()
                 else:
                     out[k] = metric.value
+
+    def snapshot(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        names = (
+            list(self._counters) + list(self._gauges)
+            + list(self._histos) + list(self._meters)
+            + list(self._labeled)
+        )
+        for n in dict.fromkeys(names):
+            self._snapshot_family(n, out)
+        return out
+
+    def snapshot_families(self, names) -> Dict[str, object]:
+        """``snapshot()`` restricted to the given family names (exact
+        unlabeled keys and labeled families — children expand as usual).
+        The metrics-history 1 s tick samples a ~20-family allowlist;
+        paying a full-registry summary (every histogram child's
+        interpolated quantiles) for it would scale the tick with total
+        metric count instead of allowlist size."""
+        out: Dict[str, object] = {}
+        for n in names:
+            self._snapshot_family(n, out)
         return out
 
     def prometheus_text(self) -> str:
@@ -426,6 +533,10 @@ class MetricsRegistry:
                     lines.append(f"{base}_count{{{lbl}}} {int(s['count'])}")
                 else:
                     lines.append(f"{base}{{{lbl}}} {metric.value}")
+        # OpenMetrics-compatible terminator: consumers use it to tell a
+        # complete exposition from a truncated one (tools/check_metrics.py
+        # lints for it)
+        lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

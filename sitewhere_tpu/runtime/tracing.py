@@ -255,6 +255,14 @@ class Tracer:
         self.store = TraceStore(max_active, max_retained)
         self._policies: Dict[str, TracingConfig] = {}
         self._gc_tick = 0
+        # flight-recorder bridge (runtime.flightrec, wired by the
+        # instance): SLO-breach tail decisions snapshot the blackbox, and
+        # StageTimers feed it strided per-stage records
+        self.flightrec = None
+        # watchdog-forced retention: until this wall-ms, EVERY tail
+        # decision keeps its trace (reason "watchdog") — the traffic
+        # around an alert is exactly what sampling would discard
+        self._force_until_ms = 0.0
         self.metrics.describe(
             "traces_retained", "traces kept by tail-based sampling, by reason"
         )
@@ -350,6 +358,14 @@ class Tracer:
         tr.force(reason)
         self.metrics.counter("trace_hits", reason=reason).inc()
 
+    def force_retain(self, duration_ms: float) -> None:
+        """Keep EVERY trace deciding within the next ``duration_ms``
+        (reason "watchdog"). Extension-only: overlapping alerts never
+        shorten an earlier window."""
+        until = now_ms() + max(0.0, duration_ms)
+        if until > self._force_until_ms:
+            self._force_until_ms = until
+
     # -- tail decision ----------------------------------------------------
     def _decide(self, tr: TraceRecord) -> None:
         pol = self.policy_for(tr.tenant)
@@ -357,6 +373,8 @@ class Tracer:
             reason = tr.forced[0]
         elif tr.duration_ms >= pol.slo_ms:
             reason = "slo"
+        elif now_ms() < self._force_until_ms:
+            reason = "watchdog"
         elif self.rng.random() < pol.sample_rate:
             reason = "sampled"
         else:
@@ -367,6 +385,17 @@ class Tracer:
         self.metrics.counter(
             "traces_retained", tenant=tr.tenant, reason=reason
         ).inc()
+        if reason == "slo" and self.flightrec is not None:
+            # an SLO breach is an incident: freeze the blackbox. The
+            # reason must be the FIXED string "slo" (tenant goes in the
+            # meta): a per-tenant reason would let a multi-tenant breach
+            # storm mint N unsuppressed reasons at once and churn the
+            # first failure's snapshot out of the bounded list — exactly
+            # what the per-reason rate limit exists to prevent
+            self.flightrec.snapshot(
+                "slo", tenant=tr.tenant, trace_id=tr.trace_id,
+                duration_ms=round(tr.duration_ms, 3),
+            )
 
     def gc(self, now: Optional[float] = None, force: bool = False) -> int:
         """Run due tail decisions; ``force`` decides every in-flight trace
@@ -395,7 +424,15 @@ class StageTimer:
     every span of a traced event; untraced tenants pay two histogram
     records per batch and nothing else)."""
 
-    __slots__ = ("tracer", "tenant", "stage", "service_h", "wait_h", "events_c")
+    __slots__ = (
+        "tracer", "tenant", "stage", "service_h", "wait_h", "events_c",
+        "_fr_tick",
+    )
+
+    # flight-recorder stride: one per-stage blackbox record every Nth
+    # batch — recent-history evidence at ~zero steady-state cost (the
+    # per-flush records carry the fine-grained story)
+    FLIGHTREC_STRIDE = 8
 
     def __init__(
         self,
@@ -407,6 +444,9 @@ class StageTimer:
         self.tracer = tracer
         self.tenant = tenant
         self.stage = stage
+        # primed so the FIRST batch records (evidence exists from the
+        # start), then every FLIGHTREC_STRIDE-th
+        self._fr_tick = self.FLIGHTREC_STRIDE - 1
         metrics.describe(
             "pipeline_stage_seconds",
             "per-stage service time (handler run) per tenant",
@@ -451,6 +491,19 @@ class StageTimer:
                 advance=self.stage not in FORK_STAGES,
                 **annotations,
             )
+            fr = self.tracer.flightrec
+            if fr is not None:
+                self._fr_tick += 1
+                if error or self._fr_tick >= self.FLIGHTREC_STRIDE:
+                    self._fr_tick = 0
+                    rec = fr.record(
+                        "stage", f"{self.tenant}/{self.stage}",
+                        service_ms=round(max(0.0, end_ms - start_ms), 3),
+                        queue_wait_ms=round(max(0.0, queue_wait_ms), 3),
+                        n_events=n_events,
+                    )
+                    if error:
+                        rec["error"] = error
 
 
 def queue_wait_from(item: Any, start_ms: float) -> float:

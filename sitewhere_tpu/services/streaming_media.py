@@ -108,6 +108,15 @@ class StreamingMedia:
             )
         return self._classifier
 
+    def classifier_flops_per_frame(self, tiny: bool = False) -> float:
+        """Analytic matmul FLOPs one frame costs through the classifier
+        (models.common.vit_flops_per_image) — the media leg's numerator
+        for the live ``tpu_mfu_pct{family="vit_b16"}`` attribution."""
+        from sitewhere_tpu.models.common import vit_flops_per_image
+        from sitewhere_tpu.models.vit import VIT_B16, VIT_TINY_TEST
+
+        return vit_flops_per_image(VIT_TINY_TEST if tiny else VIT_B16)
+
     def load_classifier_params(self, params, tiny: bool = False) -> None:
         """Install trained ViT params (e.g. restored via runtime.checkpoint)."""
         spec, cfg, _, apply = self._get_classifier(tiny)

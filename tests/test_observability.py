@@ -250,7 +250,7 @@ def test_check_metrics_lint_catches_malformations():
     lint = check_metrics.lint_exposition
     ok = (
         "# HELP x_total events\n# TYPE x_total counter\n"
-        'x_total{tenant="a b",q="c\\"d"} 5.0\n'
+        'x_total{tenant="a b",q="c\\"d"} 5.0\n# EOF\n'
     )
     assert lint(ok) == []
     # sample without TYPE

@@ -9,8 +9,16 @@ stdin) and fails on malformed exposition lines:
   NaN/Inf float value;
 - every sample's family must be preceded by ``# HELP`` and ``# TYPE``
   lines (one pair per family, HELP before TYPE);
-- new-style (labeled) counters must carry the ``_total`` suffix;
-- duplicate TYPE declarations and unknown metric types are errors.
+- new-style (labeled) counters must carry the ``_total`` suffix; labeled
+  gauges must NOT (kind/suffix conformance for the new families);
+- duplicate TYPE declarations and unknown metric types are errors;
+- the exposition must end with the OpenMetrics ``# EOF`` terminator (a
+  scrape without it is indistinguishable from a truncated one);
+- label sets must be bounded: label NAMES from the known-unbounded list
+  (``trace_id``, ``span_id``, ``seq``, …) are findings, and a family
+  exceeding ``MAX_CHILDREN`` distinct label-value tuples is flagged as
+  unbounded cardinality (labels must track live tenants / families /
+  devices, never per-event identity).
 
 Used two ways: ``python tools/check_metrics.py`` boots a small instance,
 drives events through the pipeline, and lints the scrape (exit 1 on
@@ -31,6 +39,19 @@ KNOWN_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 
 # summary/histogram child-sample suffixes that belong to a base family
 CHILD_SUFFIXES = ("_sum", "_count", "_bucket")
+
+# label names that encode per-event / per-request identity — a family
+# carrying one grows without bound (one child per event) and will
+# eventually OOM the registry and the scraper alike
+UNBOUNDED_LABEL_NAMES = frozenset({
+    "trace_id", "span_id", "seq", "event_id", "offset", "request_id",
+    "ts", "timestamp",
+})
+
+# distinct label-value tuples one family may carry before the lint calls
+# it unbounded (live tenants × stages × devices lands far below this;
+# per-event identity blows past it immediately)
+MAX_CHILDREN = 1000
 
 
 def _parse_labels(block: str) -> Tuple[Dict[str, str], str]:
@@ -79,13 +100,27 @@ def _family_of(name: str) -> str:
     return name
 
 
-def lint_exposition(text: str, require_labeled_total: bool = True) -> List[str]:
+def lint_exposition(
+    text: str,
+    require_labeled_total: bool = True,
+    require_eof: bool = True,
+    max_children: int = MAX_CHILDREN,
+) -> List[str]:
     """Lint one exposition payload; returns a list of findings (empty =
     conformant)."""
     errors: List[str] = []
     types: Dict[str, str] = {}
     helps: set = set()
-    for lineno, line in enumerate(text.splitlines(), 1):
+    children: Dict[str, set] = {}  # family → distinct label tuples
+    lines = text.splitlines()
+    if require_eof:
+        tail = next((l for l in reversed(lines) if l.strip()), "")
+        if tail.strip() != "# EOF":
+            errors.append(
+                "missing terminal '# EOF' (OpenMetrics terminator — a "
+                "scrape without it may be truncated)"
+            )
+    for lineno, line in enumerate(lines, 1):
         if not line.strip():
             continue
         if line.startswith("# HELP "):
@@ -140,6 +175,27 @@ def lint_exposition(text: str, require_labeled_total: bool = True) -> List[str]:
         ):
             errors.append(
                 f"line {lineno}: labeled counter {name} lacks _total suffix"
+            )
+        if kind == "gauge" and name.endswith("_total"):
+            errors.append(
+                f"line {lineno}: gauge {name} carries the _total suffix "
+                f"(counters only)"
+            )
+        bad_names = UNBOUNDED_LABEL_NAMES & real_labels.keys()
+        if bad_names:
+            errors.append(
+                f"line {lineno}: {name} carries per-event identity "
+                f"label(s) {sorted(bad_names)} — unbounded cardinality"
+            )
+        if real_labels:
+            children.setdefault(fam, set()).add(
+                tuple(sorted(real_labels.items()))
+            )
+    for fam, tuples in sorted(children.items()):
+        if len(tuples) > max_children:
+            errors.append(
+                f"family {fam} has {len(tuples)} labeled children "
+                f"(> {max_children}) — unbounded label set"
             )
     return errors
 

@@ -67,6 +67,10 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         "queue": "deliver reap queues (in-flight flush completions per "
                  "family; bounded by the max_inflight semaphore)",
         "depth_gauge": "tpu_inference_deliver_inflight",
+        # per-family labeled variant beside the legacy aggregate: the
+        # queues ARE per-family, so a wedged family shows here while the
+        # aggregate hides it behind healthy siblings
+        "family_depth_gauge": "tpu_inference_deliver_inflight_family",
         # completions never shed: a full in-flight window backpressures
         # the NEXT flush at the semaphore (counted before the acquire)
         "backpressure_counter": "tpu_inference.deliver_backpressure",
