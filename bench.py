@@ -816,6 +816,164 @@ def bench_e2e_multitenant(secs: float, **kw) -> dict:
     return asyncio.run(_bench_e2e_multitenant(secs, **kw))
 
 
+# ---------------------------------------------------------------- config 6
+def _storage_batches(n_rows: int, burst: int = 8192, n_devices: int = 64,
+                     t0_ms: float = 0.0, span_ms: float = 3_600_000.0):
+    """Synthetic measurement batches with a linear event-time ramp across
+    ``span_ms`` — segments get DISJOINT zone-map time ranges, so the
+    windowed-plan phase can prove pruning on realistic metadata."""
+    from sitewhere_tpu.core.batch import MeasurementBatch
+
+    rng = np.random.RandomState(7)
+    devs = np.array([f"dev-{i:04d}" for i in range(n_devices)], object)
+    out = []
+    for off in range(0, n_rows, burst):
+        k = min(burst, n_rows - off)
+        ts = t0_ms + (off + np.arange(k, dtype=np.float64)) * (
+            span_ms / max(n_rows, 1)
+        )
+        out.append(MeasurementBatch(
+            tenant="bench",
+            stream_ids=np.zeros((k,), np.int32),
+            values=rng.rand(k).astype(np.float32),
+            event_ts=ts,
+            received_ts=ts + 5.0,
+            valid=np.ones((k,), bool),
+            device_tokens=devs[np.arange(off, off + k) % n_devices],
+            names=np.full((k,), "temp", object),
+        ))
+    return out
+
+
+async def _bench_storage(
+    secs: float,
+    write_rows: int = 1_048_576,
+    replay_rows: int = 262_144,
+    seg_rows: int = 65_536,
+) -> dict:
+    """Config 6: the storage/replay axis (ROADMAP item 5, docs/STORAGE.md).
+
+    Three phases: (1) **write** — columnar batches append + seal into a
+    disk-backed segment store (durable: fsync + manifest commit per
+    seal); (2) **scan** — a FRESH store recovers from the manifest and
+    scans every sealed segment mmap'd (zero-copy column views; this is
+    the replay feed's disk side), plus a time-windowed plan proving
+    zone-map pruning; (3) **replay-to-rescore** — a live instance's
+    replay job streams unscored history through the REAL scoring path
+    (lane rings → h2d prefetch → device gather → async-D2H reaper) and
+    the clock stops when the persistence stage has seen every replayed
+    row come back scored."""
+    import shutil
+    import tempfile
+
+    from sitewhere_tpu.storage.segstore import SegmentColumns
+
+    tmp = tempfile.mkdtemp(prefix="bench-segstore-")
+    out: dict = {"write_rows": write_rows, "rows_per_segment": seg_rows}
+    try:
+        # -- phase 1: write ------------------------------------------------
+        batches = _storage_batches(write_rows)
+        store = SegmentColumns(
+            "bench", directory=tmp, rows_per_segment=seg_rows
+        )
+        t0 = time.perf_counter()
+        for b in batches:
+            store.append_batch(b)
+        store._seal()
+        dt_w = time.perf_counter() - t0
+        disk = sum(s.nbytes for s in store.segments)
+        out.update({
+            "write_s": round(dt_w, 3),
+            "write_ev_s": round(write_rows / dt_w, 1),
+            "write_mbps": round(disk / dt_w / 1e6, 1),
+            "disk_bytes": int(disk),
+            "segments": len(store.segments),
+        })
+        # -- phase 2: mmap recovery + sealed scan --------------------------
+        t0 = time.perf_counter()
+        rd = SegmentColumns("bench", directory=tmp, rows_per_segment=seg_rows)
+        out["recover_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        t0 = time.perf_counter()
+        seen = 0
+        nbytes = 0
+        for sl in rd.scan(batch_rows=65_536, include_tail=False):
+            seen += sl.n
+            nbytes += sl.n * 24  # value+score+event_ts+received_ts widths
+        dt_s = time.perf_counter() - t0
+        out.update({
+            "scan_rows": int(seen),
+            "scan_s": round(dt_s, 3),
+            "scan_ev_s": round(seen / dt_s, 1),
+            "scan_mbps": round(nbytes / dt_s / 1e6, 1),
+        })
+        # zone-map pruning: a mid-span hour-window plan must not touch
+        # segments outside it
+        z0, z1 = 1_200_000, 1_500_000  # ms window inside the 1h ramp
+        planned, pruned = rd.plan(ts0=z0, ts1=z1, include_tail=False)
+        out["windowed_plan"] = {
+            "planned": len(planned), "pruned": pruned,
+            "total": len(rd.segments),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- phase 3: end-to-end replay-to-rescore -----------------------------
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig, MicroBatchConfig
+
+    inst = SiteWhereInstance(InstanceConfig(instance_id="storage-bench"))
+    await inst.start()
+    try:
+        mb = MicroBatchConfig(
+            max_batch=16_384, deadline_ms=5.0,
+            buckets=(4096, 16_384), window=16,
+        )
+        await inst.tenant_management.create_tenant(
+            "bench", template="iot-temperature", microbatch=mb,
+            decoder="binary", max_streams=256, wire_dtype="bf16",
+            model_config={"hidden": 32},
+        )
+        await inst.drain_tenant_updates()
+        for _ in range(300):
+            if "bench" in inst.tenants:
+                break
+            await asyncio.sleep(0.05)
+        store = inst.tenants["bench"].event_store
+        now = time.time() * 1000.0
+        for b in _storage_batches(replay_rows, t0_ms=now - 60_000.0,
+                                  span_ms=60_000.0):
+            store.add_measurement_batch(b)  # persisted UNSCORED (DR story)
+        store.measurements._seal()
+        await asyncio.get_running_loop().run_in_executor(
+            None, inst.inference.prewarm
+        )
+        rescored = inst.metrics.counter(
+            "replay_rescored_total", tenant="bench"
+        )
+        t0 = time.perf_counter()
+        job = inst.replay.start_job("bench", store, target="rescore")
+        deadline = t0 + max(secs * 6, 120.0)
+        while (
+            rescored.value < replay_rows and time.perf_counter() < deadline
+        ):
+            await asyncio.sleep(0.05)
+        dt_r = time.perf_counter() - t0
+        out.update({
+            "replay_rows": int(rescored.value),
+            "replay_s": round(dt_r, 3),
+            "replay_ev_s": round(rescored.value / dt_r, 1),
+            "replay_drained": bool(rescored.value >= replay_rows),
+            "replay_job": job.report(),
+        })
+    finally:
+        await inst.terminate()
+    return out
+
+
+def bench_storage(secs: float, **kw) -> dict:
+    return asyncio.run(_bench_storage(secs, **kw))
+
+
 def _run_bench_subprocess(
     flags: list, key: str, timeout_s: float, env=None
 ) -> dict:
@@ -903,7 +1061,7 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--configs", default="all",
                    help="comma list: e2e,e2e-json,e2e-cpu,lstm,deepar,"
-                        "tenants32,vit or all")
+                        "tenants32,vit,storage or all")
     p.add_argument("--e2e-secs", type=float, default=10.0)
     p.add_argument("--e2e-wire", default="binary", choices=["binary", "json"])
     # 1: the single-tenant config sizes its stack to one slot (the
@@ -948,7 +1106,7 @@ def main() -> None:
     args = p.parse_args()
     which = set(args.configs.split(",")) if args.configs != "all" else {
         "e2e", "e2e-json", "e2e-cpu", "e2e-32t", "lstm", "deepar",
-        "tenants32", "vit"
+        "tenants32", "vit", "storage"
     }
 
     import jax
@@ -1070,6 +1228,24 @@ def main() -> None:
         else:
             log(f"  -> FAILED: {details['e2e_pipeline_32t']['error'][:300]}")
 
+    if "storage" in which:
+        log("config 6: segment store write/scan + replay-to-rescore ...")
+        if isolate:
+            details["storage"] = run_config_subprocess(
+                "storage", "storage", args)
+        else:
+            details["storage"] = bench_storage(args.e2e_secs)
+        st = details["storage"]
+        if "error" not in st:
+            log(f"  -> write {st['write_mbps']:.0f} MB/s, scan "
+                f"{st['scan_ev_s']/1e6:.2f}M ev/s, replay-to-rescore "
+                f"{st['replay_ev_s']/1e6:.2f}M ev/s "
+                f"(pruned {st['windowed_plan']['pruned']}/"
+                f"{st['windowed_plan']['total']} segments on the "
+                f"windowed plan)")
+        else:
+            log(f"  -> FAILED: {st['error'][:300]}")
+
     if "e2e-cpu" in which:
         log("config 1c: E2E latency on the CPU backend (RTT=0) ...")
         details["e2e_pipeline_cpu"] = bench_e2e_cpu_subprocess(6.0)
@@ -1162,6 +1338,12 @@ def main() -> None:
             details, "e2e_pipeline_32t", "d2h_overlap_fraction", nd=3),
         "d2h_reduction_32t": pick(
             details, "e2e_pipeline_32t", "d2h_plane_reduction", nd=1),
+        # storage axis (ROADMAP item 5): sealed-segment scan + end-to-end
+        # replay-to-rescore through the REAL scoring path, both
+        # regression-gated as throughput by tools/check_bench.py
+        "storage_scan_ev_s": pick(details, "storage", "scan_ev_s"),
+        "storage_replay_ev_s": pick(details, "storage", "replay_ev_s"),
+        "storage_write_mbps": pick(details, "storage", "write_mbps"),
         "details": args.details_out,
     }
     line = json.dumps(out)

@@ -193,6 +193,10 @@ class RestApi:
         )
         r.add_get("/api/tenants/{token}/slo", self.tenant_slo)
         r.add_get("/api/tenants/{token}/overload", self.tenant_overload)
+        r.add_post("/api/tenants/{token}/replay", self.replay_start)
+        r.add_get("/api/tenants/{token}/replay", self.replay_list)
+        r.add_get("/api/tenants/{token}/replay/{job}", self.replay_status)
+        r.add_get("/api/tenants/{token}/storage", self.tenant_storage)
 
         r.add_get("/api/traces", self.list_traces)
         r.add_get("/api/traces/{id}", self.get_trace)
@@ -480,6 +484,71 @@ class RestApi:
         if rep is None:
             return web.json_response({"error": "unknown tenant"}, status=404)
         return web.json_response(rep)
+
+    async def replay_start(self, request) -> web.Response:
+        """Launch a replay job over the tenant's segment store (docs/
+        STORAGE.md "Replay"): body ``{"target": "rescore"|"rules"|"train",
+        "t0"/"t1"`` (event-time ms), ``"seq0"/"seq1"`` (store seqs),
+        ``"device"``, ``"force"``}`` — all optional; default rescores the
+        whole store, skipping already-scored rows. Planning happens via
+        zone maps; the response reports segments planned vs pruned."""
+        token = request.match_info["token"]
+        rt = self.instance.tenants.get(token)
+        if rt is None:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except ValueError:
+            return web.json_response({"error": "malformed JSON"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be an object"},
+                                     status=400)
+        target = str(body.get("target", "rescore"))
+        try:
+            seq_hi = body.get("seq1")
+            job = self.instance.replay.start_job(
+                token, rt.event_store,
+                ts0=int(body.get("t0", 0)),
+                ts1=int(body.get("t1", 0)),
+                seq_lo=int(body.get("seq0", 0)),
+                seq_hi=None if seq_hi is None else int(seq_hi),
+                # `or ""`: a JSON null must mean "no device filter", not
+                # the literal filter string "None"
+                device=str(body.get("device") or ""),
+                target=target,
+                force=bool(body.get("force", False)),
+            )
+        except (ValueError, TypeError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"job": job.job_id, **job.report()})
+
+    async def replay_list(self, request) -> web.Response:
+        """All replay jobs of one tenant (progress, ev/s, zone pruning)."""
+        token = request.match_info["token"]
+        if token not in self.instance.tenants:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        return web.json_response(
+            {"jobs": self.instance.replay.list_jobs(token)}
+        )
+
+    async def replay_status(self, request) -> web.Response:
+        """One replay job's live report: status, cursor, replayed ∪
+        skipped-dedupe accounting, throttle ticks, ev/s, segments
+        planned/pruned by the zone maps, lag ratio."""
+        token = request.match_info["token"]
+        rep = self.instance.replay.report(request.match_info["job"])
+        if rep is None or rep["tenant"] != token:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response(rep)
+
+    async def tenant_storage(self, request) -> web.Response:
+        """The tenant's segment-store shape: segments, zone maps, rows,
+        retention/compaction accounting (docs/STORAGE.md)."""
+        token = request.match_info["token"]
+        rt = self.instance.tenants.get(token)
+        if rt is None:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        return web.json_response(rt.event_store.measurements.describe())
 
     async def topology(self, request) -> web.Response:
         return web.json_response(self.instance.topology())

@@ -215,6 +215,22 @@ class SiteWhereInstance(LifecycleComponent):
             overload=self.overload,
             flightrec=self.flightrec,
         )
+        # replay-to-rescore engine (pipeline/replay.py): streams the
+        # segment store back through the live feed path as a low-priority
+        # lane arbitrated by the overload controller; job cursors persist
+        # under data_dir when checkpointing so crashed replays resume
+        from pathlib import Path as _Path
+
+        from sitewhere_tpu.pipeline.replay import ReplayEngine
+
+        self.replay = ReplayEngine(
+            self.bus, self.metrics,
+            overload=self.overload,
+            flightrec=self.flightrec,
+            state_dir=(
+                _Path(cfg.data_dir) / "replay" if cfg.checkpointing else None
+            ),
+        )
         # profile hooks: annotate scoring dispatches inside the jax
         # profiler trace when the instance is capturing one
         self.inference.profile_annotations = bool(cfg.profile_dir)
@@ -549,6 +565,7 @@ class SiteWhereInstance(LifecycleComponent):
         # blocks SimBroker.publish for every publisher in the process
         if rt.broker_handler is not None:
             self.broker.unsubscribe(rt.broker_handler)
+        await self.replay.cancel_tenant(tenant)
         await self.inference.remove_tenant(tenant)
         for comp in reversed(rt.components()):
             await comp.terminate()
@@ -690,6 +707,21 @@ class SiteWhereInstance(LifecycleComponent):
             except Exception as exc:  # noqa: BLE001 - a sampling fault
                 # must not kill the blackbox; next tick retries
                 self._record_error("history-tick", exc)
+            # background storage maintenance: retention horizon +
+            # small-segment compaction per tenant store (O(segments)
+            # no-op when there is nothing to do — docs/STORAGE.md).
+            # Faults isolate PER TENANT: one tenant's broken store
+            # directory must not starve every later tenant's retention.
+            # max_units=2 bounds the inline re-encode work per tick: a
+            # fully-rescored store durable-izes over several ticks
+            # instead of stalling the loop (and every REST handler) for
+            # one giant synchronous pass
+            for rt in list(self.tenants.values()):
+                try:
+                    rt.event_store.maintain(max_units=2)
+                except Exception as exc:  # noqa: BLE001 - storage upkeep
+                    # must not kill the history loop; next tick retries
+                    self._record_error("storage-maintain", exc)
 
     async def _autosave_loop(self) -> None:
         """Periodic live checkpoint: bounds the loss window of a HARD kill
@@ -716,6 +748,9 @@ class SiteWhereInstance(LifecycleComponent):
         self._overload_task = None
         await cancel_and_wait(self._history_task)
         self._history_task = None
+        # park replay jobs BEFORE the stop cascade takes consumers down
+        # (cursors persist; unfinished jobs resume after restore)
+        await self.replay.stop()
         await super().stop()
         # checkpoint-on-stop: a clean shutdown always leaves a current
         # snapshot (engines already saved their params in the cascade)
@@ -734,6 +769,7 @@ class SiteWhereInstance(LifecycleComponent):
         self._overload_task = None
         await cancel_and_wait(getattr(self, "_history_task", None))
         self._history_task = None
+        await self.replay.stop()
         if self._profiling:
             import jax
 
@@ -839,6 +875,11 @@ class SiteWhereInstance(LifecycleComponent):
                     entry["token"], entry.get("template", "default")
                 )
             await self.add_tenant(cfg)
+        # relaunch replay jobs a crash interrupted: cursors committed
+        # after each published batch, so resume is exactly-once
+        self.replay.resume_jobs(
+            {t: rt.event_store for t, rt in self.tenants.items()}
+        )
         return len(manifest)
 
     # -- observability ---------------------------------------------------

@@ -933,11 +933,15 @@ class TpuInferenceService(MultitenantService):
                 self.bus.publish_nowait(topic, batch)
                 raise
         # latency accounting: sample rows (full per-row recording would be
-        # a Python loop over 10^5 rows/s)
-        lat = self.metrics.histogram("tpu_inference.latency", unit="s")
-        now = time.time() * 1000.0
-        rts = batch.received_ts[:: max(1, batch.n // 16)]
-        lat.record_many(((now - rts) / 1000.0).tolist())
+        # a Python loop over 10^5 rows/s). Replayed history carries its
+        # ORIGINAL received_ts — hours-old samples would flood the live
+        # p99/SLO series for the whole replay, so only live traffic
+        # records latency (replay progress has its own metric family).
+        if "replay" not in batch.trace:
+            lat = self.metrics.histogram("tpu_inference.latency", unit="s")
+            now = time.time() * 1000.0
+            rts = batch.received_ts[:: max(1, batch.n // 16)]
+            lat.record_many(((now - rts) / 1000.0).tolist())
         self.metrics.counter("tpu_inference.scored_total").inc(batch.n)
         self.metrics.meter("tpu_inference.scored").mark(batch.n)
 

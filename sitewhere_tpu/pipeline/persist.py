@@ -63,6 +63,12 @@ class EventPersistence(LifecycleComponent):
         # hoisted out of the per-item handler (hot path)
         self._out_topic = bus.naming.persisted_events(tenant)
         self._persisted = self.metrics.counter("event_management.persisted")
+        # replay-to-rescore output: rows that are ALREADY rows of this
+        # store come back around with fresh scores (pipeline/replay.py);
+        # appending them again would duplicate history
+        self._replay_rescored = self.metrics.counter(
+            "replay_rescored_total", tenant=tenant
+        )
         self._task: Optional[asyncio.Task] = None
 
     @property
@@ -87,6 +93,23 @@ class EventPersistence(LifecycleComponent):
     async def _handle(self, item) -> None:
         import time as _time
 
+        if isinstance(item, MeasurementBatch) and "replay" in item.trace:
+            # replayed rescore batch: its rows are the store's own rows
+            # riding the scoring path again (docs/STORAGE.md "Replay").
+            # Never re-append (zero duplicate history) and never re-fan
+            # downstream (rules/outbound already fired on the original
+            # pass; the scored topic carried the fresh scores to any
+            # subscriber that wants them). The fresh scores DO write
+            # back onto the sealed rows (copy-on-write overlays), so a
+            # later rescore job's only_unscored dedupe skips them — no
+            # re-publish of already-rescored history. Counted so
+            # store ∪ replay accounting stays exact.
+            if item.scores is not None and item.event_ids is not None:
+                self.store.measurements.write_back_scores(
+                    item.event_ids, item.scores
+                )
+            self._replay_rescored.inc(item.n)
+            return
         if self.deadline_gate.check(item):
             return  # strict mode only; default gate never drops here
         t0 = _time.time() * 1000.0

@@ -1,0 +1,606 @@
+"""Replay-to-rescore engine: stream the segment store back through the
+pipeline at wire speed (ROADMAP item 5; docs/STORAGE.md "Replay").
+
+A :class:`ReplayJob` names a tenant, a time/seq window, and a target:
+
+- ``rescore`` — replayed batches publish to the tenant's inbound-events
+  topic, so they ride the IDENTICAL feed path as live traffic: the
+  scoring loop's ``_LaneRing`` staging, the double-buffered h2d prefetch,
+  the device-side gather, and the async-D2H completion reaper (PR 4/5).
+  Scored output lands on the scored-events topic like any live batch;
+  the persistence stage recognizes the replay mark and does NOT append
+  the rows again (they ARE the store). This is the DR path for PR 1's
+  at-least-once story: rows that persisted unscored (outage, parked
+  family) get their scores computed and re-emitted downstream.
+- ``rules`` — already-scored history re-publishes to the persisted-events
+  topic so the rule engine re-fires over it (alert backfill after a rule
+  change).
+- ``train`` — scored history publishes to the tenant's replay-train-feed
+  topic: the feeder for on-device continual learning (ROADMAP item 3).
+
+Mechanics:
+
+- **planning** goes through the store's zone maps (``SegmentColumns.plan``)
+  — segments outside the window are pruned without touching a row
+  (``replay_segments_pruned_total``);
+- **scanning** streams mmap'd column slices (``SegmentColumns.scan`` →
+  ``slice_columns``) into ``MeasurementBatch`` construction with the
+  vocab/inverse group index inherited for free — no per-event objects,
+  no string sorts (tools/check_hotpath.py registers the path);
+- a **bounded intake ring** (``_ReplayRing``, tools/check_queues.py) sits
+  between the scanner and the publish pump, so a throttled pump
+  backpressures the disk scan instead of buffering the store in memory;
+- the pump is a **low-priority lane arbitrated by the PR 3 overload
+  controller**: live traffic always wins credit — while the tenant's
+  ``overload_credit`` is below 1.0 or any degradation rung is engaged,
+  the pump parks (``replay_throttled_total``) and resumes only when the
+  pressure clears;
+- **dedupe**: ``rescore`` (without ``force``) skips rows whose stored
+  score is already set — no row is double-scored — and the job's
+  **cursor** (last raw seq covered) commits after each published batch
+  with no await in between, so a crashed job resumes exactly: replayed ∪
+  skipped accounting stays exact and nothing is lost or re-published;
+- the cursor (plus accounting) persists to ``state_dir`` when the
+  instance checkpoints, and ``resume_jobs`` restarts unfinished jobs.
+
+Guarantee boundary: the cursor marks PUBLISHED, not scored-and-written-
+back — scores land asynchronously at the persistence stage. A graceful
+stop checkpoints the bus, so in-flight replayed batches survive the
+restart and drain through scoring. A hard kill without a checkpoint can
+leave a published window unscored past the cursor; those rows are still
+NaN in the store, so the NEXT rescore job's ``only_unscored`` plan picks
+them up — the recovery move is re-running the job, the same at-least-
+once posture as the rest of the PR 1 delivery story. The same in-flight
+window means a job that just finished may have scores still landing; a
+back-to-back second rescore job can re-publish that boundary window
+(idempotent — write-back overwrites with the same model's scores).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sitewhere_tpu.core.batch import MeasurementBatch
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.storage.segstore import slice_columns
+
+REPLAY_TARGETS = ("rescore", "rules", "train")
+
+
+class _ReplayRing:
+    """Bounded intake ring between the segment scanner and the publish
+    pump: prepared scan slices queue here, and a full ring backpressures
+    the scanner (``replay.ring_backpressure``) instead of letting a
+    throttled replay buffer the store into memory. Depth is the
+    ``replay_ring_depth{tenant}`` gauge (tools/check_queues.py)."""
+
+    def __init__(self, capacity: int, metrics: MetricsRegistry,
+                 tenant: str) -> None:
+        self.capacity = max(1, int(capacity))
+        self._items: deque = deque()
+        self._data = asyncio.Event()
+        self._space = asyncio.Event()
+        self._space.set()
+        self._gauge = metrics.gauge("replay_ring_depth", tenant=tenant)
+        self._backpressure = metrics.counter("replay.ring_backpressure")
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    async def put(self, item) -> None:
+        while len(self._items) >= self.capacity:
+            self._backpressure.inc()
+            self._space.clear()
+            await self._space.wait()
+        self._items.append(item)
+        self._gauge.set(len(self._items))
+        self._data.set()
+
+    async def get(self):
+        while not self._items:
+            self._data.clear()
+            await self._data.wait()
+        item = self._items.popleft()
+        self._gauge.set(len(self._items))
+        self._space.set()
+        return item
+
+
+@dataclass
+class ReplayJob:
+    """One replay job's identity, window, cursor, and exact accounting."""
+
+    job_id: str
+    tenant: str
+    target: str = "rescore"
+    ts0: int = 0
+    ts1: int = 0
+    seq_lo: int = 0
+    seq_hi: Optional[int] = None
+    device: str = ""
+    force: bool = False
+    status: str = "running"      # running | paused | done | failed | cancelled
+    cursor: int = 0              # next raw seq to cover (resume point)
+    plan_seq_end: int = -1       # last raw seq the plan covers
+    replayed: int = 0            # rows published
+    skipped_dedupe: int = 0      # rows skipped: already scored (dedupe)
+    throttled: int = 0           # pump park ticks (overload arbitration)
+    segments_planned: int = 0
+    segments_pruned: int = 0     # zone-map pruned, zero rows touched
+    bytes_read: int = 0
+    started_ms: float = field(default_factory=lambda: time.time() * 1000.0)
+    finished_ms: Optional[float] = None
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplayJob":
+        known = cls.__dataclass_fields__
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def report(self) -> dict:
+        out = self.to_dict()
+        end = self.finished_ms or time.time() * 1000.0
+        dt_s = max((end - self.started_ms) / 1000.0, 1e-9)
+        out["ev_s"] = round(self.replayed / dt_s, 1)
+        span = max(self.plan_seq_end - self.seq_lo + 1, 1)
+        remaining = max(self.plan_seq_end - self.cursor + 1, 0)
+        out["lag_ratio"] = round(
+            0.0 if self.status == "done" else min(remaining / span, 1.0), 4
+        )
+        return out
+
+
+def _slice_to_batch(tenant: str, cols: Dict[str, object],
+                    target: str) -> MeasurementBatch:
+    """One scan slice's columns → a columnar MeasurementBatch. Vectorized
+    end to end: numeric views pick, token columns fan out from the
+    segment vocab AND hand the batch its group-index cache (no string
+    sort downstream — the ``lookup_or_assign_bulk`` feed is free), ids
+    come from the store so replayed identity matches persisted identity.
+    The ``replay`` trace mark is the contract with the persistence stage
+    (replayed rows are already rows of the store — never re-appended)."""
+    n = int(len(cols["values"]))
+    tok_u, tok_inv = cols["tok"]
+    name_u, name_inv = cols["name"]
+    tok_inv = np.ascontiguousarray(tok_inv, np.int32)
+    name_inv = np.ascontiguousarray(name_inv, np.int32)
+    asg = cols.get("asg")
+    area = cols.get("area")
+    batch = MeasurementBatch(
+        tenant=tenant,
+        stream_ids=np.zeros((n,), np.int32),
+        values=np.ascontiguousarray(cols["values"], np.float32),
+        event_ts=cols["event_ts"].astype(np.float64),
+        received_ts=cols["received_ts"].astype(np.float64),
+        valid=np.ones((n,), bool),
+        event_ids=cols["event_ids"],
+        device_tokens=(
+            tok_u[tok_inv] if len(tok_u) else np.full((n,), "", object)
+        ),
+        names=(
+            name_u[name_inv] if len(name_u) else np.full((n,), "", object)
+        ),
+        assignment_tokens=(
+            asg[0][np.asarray(asg[1])] if asg is not None and len(asg[0])
+            else None
+        ),
+        area_tokens=(
+            area[0][np.asarray(area[1])] if area is not None and len(area[0])
+            else None
+        ),
+        # rescore recomputes scores (fresh NaN column is created at lane
+        # enqueue); rules/train re-emit the STORED scores
+        scores=(
+            None if target == "rescore"
+            else np.ascontiguousarray(cols["scores"], np.float32)
+        ),
+        tok_index=(tok_u, tok_inv),
+        name_index=(name_u, name_inv),
+    )
+    batch.mark("replay")  # the persistence-skip + provenance mark
+    return batch
+
+
+class ReplayEngine:
+    """Owns replay jobs across tenants: planning, the scanner/pump task
+    pair per job, overload arbitration, cursor persistence, metrics."""
+
+    def __init__(
+        self,
+        bus,
+        metrics: Optional[MetricsRegistry] = None,
+        overload=None,
+        flightrec=None,
+        state_dir: Optional[str | Path] = None,
+        batch_rows: int = 8192,
+        ring_capacity: int = 4,
+        throttle_tick_s: float = 0.02,
+        max_finished: int = 64,
+    ) -> None:
+        self.bus = bus
+        self.metrics = metrics or MetricsRegistry()
+        self.overload = overload
+        self.flightrec = flightrec
+        self.state_dir = Path(state_dir) if state_dir is not None else None
+        if self.state_dir is not None:
+            self.state_dir.mkdir(parents=True, exist_ok=True)
+        self.batch_rows = int(batch_rows)
+        self.ring_capacity = int(ring_capacity)
+        self.throttle_tick_s = float(throttle_tick_s)
+        self.max_finished = int(max_finished)
+        self.jobs: Dict[str, ReplayJob] = {}
+        self._tasks: Dict[str, List[asyncio.Task]] = {}
+        m = self.metrics
+        m.describe(
+            "replay_events_total",
+            "rows replayed from the segment store, per tenant and target",
+        )
+        m.describe(
+            "replay_bytes_total",
+            "segment-store column bytes streamed by replay, per tenant",
+        )
+        m.describe(
+            "replay_segments_pruned_total",
+            "segments skipped by zone-map planning (zero rows touched)",
+        )
+        m.describe(
+            "replay_throttled_total",
+            "replay pump park ticks while live traffic held the tenant's "
+            "overload credit",
+        )
+        m.describe(
+            "replay_lag_ratio",
+            "active RESCORE job's unreplayed fraction of its planned seq "
+            "span (0 = caught up / idle); rules/train backfills don't "
+            "drive it — concurrent jobs would clobber the tenant gauge",
+        )
+        m.describe(
+            "replay_ring_depth",
+            "prepared replay batches queued between segment scanner and "
+            "publish pump, per tenant",
+        )
+
+    # -- job control -------------------------------------------------------
+    def start_job(
+        self,
+        tenant: str,
+        store,
+        *,
+        ts0: int = 0,
+        ts1: int = 0,
+        seq_lo: int = 0,
+        seq_hi: Optional[int] = None,
+        device: str = "",
+        target: str = "rescore",
+        force: bool = False,
+        job: Optional[ReplayJob] = None,
+    ) -> ReplayJob:
+        """Plan + launch one replay job (or relaunch a resumed one)."""
+        if target not in REPLAY_TARGETS:
+            raise ValueError(
+                f"unknown replay target '{target}' (one of {REPLAY_TARGETS})"
+            )
+        if job is None and target == "rescore":
+            # one rescore job per tenant at a time: two concurrent jobs
+            # over overlapping windows would each plan the same rows as
+            # unscored (scores only write back at the persistence stage)
+            # and double-publish them
+            for j in self.jobs.values():
+                if (
+                    j.tenant == tenant and j.target == "rescore"
+                    and j.status == "running"
+                ):
+                    raise ValueError(
+                        f"tenant '{tenant}' already has a running rescore "
+                        f"job ({j.job_id}); wait or cancel it first"
+                    )
+        resumed = job is not None
+        if job is None:
+            job = ReplayJob(
+                job_id=f"rj-{uuid.uuid4().hex[:12]}",
+                tenant=tenant, target=target, ts0=int(ts0), ts1=int(ts1),
+                seq_lo=int(seq_lo), seq_hi=seq_hi, device=device,
+                force=bool(force), cursor=int(seq_lo),
+            )
+        job.status = "running"
+        # plan NOW (synchronous): the zone-map pruning result is part of
+        # the job's identity and the REST response
+        segments, pruned = store.measurements.plan(
+            job.ts0, job.ts1, job.cursor, job.seq_hi, job.device
+        )
+        if not resumed:
+            # a RESUMED job keeps its persisted plan accounting: the
+            # re-plan from the committed cursor prunes segments the job
+            # already replayed pre-crash, and counting those as
+            # "zone-pruned, zero rows touched" would corrupt both the
+            # report and replay_segments_pruned_total
+            job.segments_planned = len(segments)
+            job.segments_pruned = pruned
+            job.plan_seq_end = max(
+                (s.seq0 + s.n - 1 for s in segments),
+                default=job.cursor - 1,
+            )
+            self.metrics.counter(
+                "replay_segments_pruned_total", tenant=tenant
+            ).inc(pruned)
+        self.jobs[job.job_id] = job
+        self._persist(job)
+        if not segments:
+            job.status = "done"
+            job.finished_ms = time.time() * 1000.0
+            self._persist(job)
+            return job
+        ring = _ReplayRing(self.ring_capacity, self.metrics, tenant)
+        loop = asyncio.get_running_loop()
+        self._tasks[job.job_id] = [
+            loop.create_task(
+                self._scan_loop(job, store, segments, ring),
+                name=f"replay-scan[{job.job_id}]",
+            ),
+            loop.create_task(
+                self._pump_loop(job, ring), name=f"replay-pump[{job.job_id}]"
+            ),
+        ]
+        return job
+
+    def report(self, job_id: str) -> Optional[dict]:
+        job = self.jobs.get(job_id)
+        return job.report() if job is not None else None
+
+    def list_jobs(self, tenant: str = "") -> List[dict]:
+        return [
+            j.report() for j in self.jobs.values()
+            if not tenant or j.tenant == tenant
+        ]
+
+    async def cancel_job(self, job_id: str) -> bool:
+        tasks = self._tasks.pop(job_id, [])
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        job = self.jobs.get(job_id)
+        if job is not None and job.status in ("running", "paused"):
+            job.status = "cancelled"
+            job.finished_ms = time.time() * 1000.0
+            self._persist(job)
+        return bool(tasks)
+
+    async def cancel_tenant(self, tenant: str) -> int:
+        n = 0
+        for job_id in [
+            j.job_id for j in self.jobs.values() if j.tenant == tenant
+        ]:
+            if await self.cancel_job(job_id):
+                n += 1
+        return n
+
+    async def stop(self) -> None:
+        """Cancel every running job (cursors persisted — jobs resume)."""
+        for job_id in list(self._tasks):
+            tasks = self._tasks.pop(job_id, [])
+            for t in tasks:
+                t.cancel()
+            for t in tasks:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+
+    # -- cursor persistence / resume ---------------------------------------
+    def _state_path(self, job_id: str) -> Optional[Path]:
+        if self.state_dir is None:
+            return None
+        return self.state_dir / f"{job_id}.json"
+
+    def _persist(self, job: ReplayJob) -> None:
+        """Commit the job's cursor + accounting. Called with NO await
+        between the batch publish and this write, so a cancellation can
+        never observe a published-but-uncommitted batch (the crash/resume
+        zero-dup contract); the file replace is atomic for real crashes.
+        A job in a terminal state retires instead — its cursor file is
+        deleted, never rewritten (a pump still draining buffered slices
+        after the scanner failed the job must not resurrect the file)."""
+        if job.status not in ("running", "paused"):
+            self._retire(job)
+            return
+        path = self._state_path(job.job_id)
+        if path is None:
+            return
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(job.to_dict()))
+        tmp.replace(path)
+
+    def _retire(self, job: ReplayJob) -> None:
+        """Terminal transition (done/failed/cancelled): a finished job
+        never resumes, so its cursor file is deleted rather than
+        persisted, and the in-memory report history is bounded to the
+        ``max_finished`` most recent — a year of nightly jobs must not
+        grow state_dir or the jobs dict without bound."""
+        path = self._state_path(job.job_id)
+        if path is not None:
+            path.unlink(missing_ok=True)
+        finished = [
+            j for j in self.jobs.values()
+            if j.status not in ("running", "paused")
+        ]
+        if len(finished) > self.max_finished:
+            finished.sort(key=lambda j: j.finished_ms)
+            for j in finished[: len(finished) - self.max_finished]:
+                self.jobs.pop(j.job_id, None)
+
+    def resume_jobs(self, stores: Dict[str, object]) -> int:
+        """Relaunch unfinished jobs from their persisted cursors (called
+        by the instance after tenants restore). A mid-replay crash loses
+        nothing: scanning restarts at the committed cursor, and rows
+        before it were already published exactly once."""
+        if self.state_dir is None:
+            return 0
+        n = 0
+        for path in sorted(self.state_dir.glob("rj-*.json")):
+            try:
+                job = ReplayJob.from_dict(json.loads(path.read_text()))
+            except (ValueError, TypeError):
+                continue
+            if job.job_id in self.jobs:
+                continue
+            if job.status not in ("running", "paused"):
+                # a terminal file only survives a crash inside _retire's
+                # tiny window — finish the cleanup, don't resurrect it
+                path.unlink(missing_ok=True)
+                continue
+            store = stores.get(job.tenant)
+            if store is None:
+                continue
+            self.start_job(job.tenant, store, job=job)
+            n += 1
+        return n
+
+    # -- the two loops -----------------------------------------------------
+    def _throttled(self, tenant: str) -> bool:
+        """Low-priority arbitration: live traffic always wins credit.
+        Any credit reduction or engaged degradation rung parks replay."""
+        ov = self.overload
+        if ov is None:
+            return False
+        return ov.credit(tenant) < 1.0 or ov.level(tenant) > 0
+
+    async def _scan_loop(self, job: ReplayJob, store, segments, ring) -> None:
+        """Stream the planned segments' filtered slices into the ring.
+        Dedupe (already-scored rows) happens here, per raw window, so the
+        pump's cursor commit makes replayed ∪ skipped accounting exact
+        across crash/resume."""
+        only_unscored = job.target == "rescore" and not job.force
+        try:
+            for sl in store.measurements.scan(
+                job.ts0, job.ts1, job.cursor, job.seq_hi, job.device,
+                only_unscored=only_unscored, batch_rows=self.batch_rows,
+                segments=segments,
+            ):
+                if sl.seq_end < job.cursor:
+                    continue  # resumed mid-segment: window already covered
+                await ring.put(sl)
+            await ring.put(None)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - a scan fault ends the
+            # job visibly instead of wedging the pump forever
+            job.status = "failed"
+            job.error = repr(exc)
+            job.finished_ms = time.time() * 1000.0
+            self._persist(job)
+            await ring.put(None)
+
+    async def _pump_loop(self, job: ReplayJob, ring) -> None:
+        """Publish prepared slices at low priority: park while the tenant
+        is under pressure, build + publish one batch per slice, commit
+        the cursor (no await between publish and commit)."""
+        naming = self.bus.naming
+        topic = {
+            "rescore": naming.inbound_events,
+            "rules": naming.persisted_events,
+            "train": naming.train_feed,
+        }[job.target](job.tenant)
+        ev_c = self.metrics.counter(
+            "replay_events_total", tenant=job.tenant, target=job.target
+        )
+        bytes_c = self.metrics.counter(
+            "replay_bytes_total", tenant=job.tenant
+        )
+        throttled_c = self.metrics.counter(
+            "replay_throttled_total", tenant=job.tenant
+        )
+        # only the tenant's (single, guarded) rescore job drives the lag
+        # gauge — a concurrent rules/train backfill finishing would zero
+        # it while the rescore job is still behind
+        lag_g = (
+            self.metrics.gauge("replay_lag_ratio", tenant=job.tenant)
+            if job.target == "rescore" else None
+        )
+        try:
+            while True:
+                sl = await ring.get()
+                if sl is None:
+                    break
+                while self._throttled(job.tenant):
+                    # live traffic holds the credit: park (never drop —
+                    # the ring backpressures the scanner behind us)
+                    job.throttled += 1
+                    throttled_c.inc()
+                    if lag_g is not None:
+                        lag_g.set(job.report()["lag_ratio"])
+                    await asyncio.sleep(self.throttle_tick_s)
+                if sl.n:
+                    t0 = time.perf_counter()
+                    cols = slice_columns(sl)
+                    batch = _slice_to_batch(job.tenant, cols, job.target)
+                    nbytes = (
+                        cols["values"].nbytes + cols["scores"].nbytes
+                        + cols["event_ts"].nbytes
+                        + cols["received_ts"].nbytes
+                        + cols["tok"][1].nbytes + cols["name"][1].nbytes
+                    )
+                    await self.bus.publish(topic, batch)
+                    # publish returned → commit, with no await between:
+                    # a cancellation cannot split publish from commit
+                    job.replayed += sl.n
+                    job.bytes_read += nbytes
+                    ev_c.inc(sl.n)
+                    bytes_c.inc(nbytes)
+                    if self.flightrec is not None:
+                        self.flightrec.record(
+                            "replay", job.tenant,
+                            rows=sl.n, target=job.target, job=job.job_id,
+                            seq_end=int(sl.seq_end),
+                            skipped=int(sl.skipped),
+                            build_publish_s=round(
+                                time.perf_counter() - t0, 6
+                            ),
+                        )
+                job.skipped_dedupe += sl.skipped
+                job.cursor = int(sl.seq_end) + 1
+                self._persist(job)
+                if lag_g is not None:
+                    lag_g.set(job.report()["lag_ratio"])
+            # the sentinel also ends a FAILED scan (the scanner already
+            # persisted status="failed") — only a clean drain is "done"
+            if job.status == "running":
+                job.status = "done"
+                job.finished_ms = time.time() * 1000.0
+                self._persist(job)
+                if lag_g is not None:
+                    lag_g.set(0.0)
+        except asyncio.CancelledError:
+            if job.status == "running":
+                job.status = "paused"  # resumable from the committed cursor
+                self._persist(job)
+            raise
+        except Exception as exc:  # noqa: BLE001 - fail visibly; the
+            # committed cursor stays in the report, so a NEW job over
+            # seq_lo=cursor covers the remainder (dedupe makes overlap
+            # harmless anyway)
+            job.status = "failed"
+            job.error = repr(exc)
+            job.finished_ms = time.time() * 1000.0
+            self._persist(job)
+        finally:
+            # the pump leaving first (fault/cancel) must not strand the
+            # scanner blocked on a full ring — take the sibling down too
+            for t in self._tasks.pop(job.job_id, []):
+                if t is not asyncio.current_task():
+                    t.cancel()

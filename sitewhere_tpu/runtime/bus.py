@@ -81,6 +81,12 @@ class TopicNaming:
     def tenant_model_updates(self) -> str:
         return self.global_topic("tenant-model-updates")
 
+    def train_feed(self, tenant: str) -> str:
+        """Rebuild-only: replayed measurement windows destined for the
+        continual-learning train lane (ROADMAP item 3). The replay
+        engine's ``train`` target publishes scored history here."""
+        return self.tenant_topic(tenant, "replay-train-feed")
+
     # dead-letter topics (at-least-once: exhausted/poison items per stage;
     # the decode stage's failed-decode topic predates this naming and is
     # surfaced beside them by the DLQ REST endpoints)

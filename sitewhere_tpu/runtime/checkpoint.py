@@ -131,23 +131,6 @@ class CheckpointManager:
         return True
 
     # -- device model + events -------------------------------------------
-    @staticmethod
-    def _encode_parquet(cols: Dict[str, "np.ndarray"]) -> bytes:
-        """Columns → parquet bytes ON THE CALLING THREAD. Native
-        serialization must run on the event-loop thread: constructing a
-        ParquetWriter on an executor thread while the jax runtime is live
-        segfaults intermittently in this image."""
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
-        table = pa.table({
-            k: pa.array([str(x) for x in v] if v.dtype == object else v)
-            for k, v in cols.items()
-        })
-        sink = pa.BufferOutputStream()
-        pq.write_table(table, sink)
-        return sink.getvalue().to_pybytes()
-
     def _seg_meta_path(self, tenant: str) -> Path:
         return self.root / "events" / f"segments-{tenant}.json"
 
@@ -155,45 +138,58 @@ class CheckpointManager:
         """Capture + serialize a consistent cut of one tenant's device
         model + events (synchronous, no awaits — safe on a live instance).
 
-        Events persist as LOG-STRUCTURED PARQUET SEGMENTS: each sealed
-        64k-row chunk encodes exactly once, ever (the chunks are
-        immutable), so the steady-state loop-thread cost per checkpoint is
-        bounded by the live tail — not by total stored rows. A segment
-        manifest (row counts) detects a data_dir that belongs to a
-        different store lineage and forces a full rewrite."""
+        Events persist as LOG-STRUCTURED COLUMNAR SEGMENTS in the wire
+        format of ``storage/segstore.py`` (dtype-tagged raw column
+        buffers + vocab/int32-inverse token columns + zone maps): each
+        sealed segment's bytes were encoded exactly once, at seal — the
+        snapshot hands them over verbatim, so the steady-state loop-thread
+        cost per checkpoint is bounded by the live tail, not by total
+        stored rows. Restores mmap the committed files (zero-copy column
+        views). Parquet is kept only as a read fallback for pre-segstore
+        checkpoints and for the export/import surface. The segment meta
+        carries the store lineage (a foreign data_dir forces a full
+        rewrite) and the committed file names — reuse is keyed on each
+        segment's remembered file identity, so a segment replaced by
+        ``maintain`` re-checkpoints even when row counts line up."""
         tenant = store.tenant
-        chunks = store.measurements.sealed_chunks()
-        counts = [int(len(c["value"])) for c in chunks]
+        segs = store.measurements.segments
+        counts = [int(s.n) for s in segs]
         meta = self._load_seg_meta(tenant) or {}
-        on_disk = meta.get("counts", [])
         gen = int(meta.get("gen", 0)) + 1
-        reuse = (
-            meta.get("lineage") == store.lineage
-            and len(on_disk) <= len(counts)
-            and counts[: len(on_disk)] == on_disk
-        )
+        prev_names = list(meta.get("seg_names") or [])
+        # incremental reuse is keyed on SEGMENT IDENTITY (each live
+        # Segment remembers the committed checkpoint file holding exactly
+        # its bytes), not on row counts: a maintain() rewrite of a dirty
+        # segment (score write-back) keeps the count but changes the
+        # bytes — a count-keyed reuse would silently keep the stale file
+        # and lose the rescore on restore. A merge/rewrite produces a new
+        # Segment (ckpt_name None), so reuse stops at the first changed
+        # position. Pre-seg_names (parquet) metas never match — the
+        # legacy files re-encode to .sws once and cleanup drops them.
+        keep = 0
+        if meta.get("lineage") == store.lineage:
+            while (
+                keep < len(prev_names)
+                and keep < len(segs)
+                and segs[keep].ckpt_name == prev_names[keep]
+            ):
+                keep += 1
         # every file this snapshot WRITES carries the new generation in its
         # name — committed files are never overwritten in place, so a crash
         # before the meta commit cannot corrupt the previous set even on a
-        # full lineage rewrite. A meta from the pre-seg_names layout keeps
-        # its segments via the legacy naming scheme (they must re-enter the
-        # new meta or cleanup would delete committed rows).
-        legacy = [
-            f"measurements-{tenant}-seg{i:06d}.parquet"
-            for i in range(len(on_disk))
-        ]
-        seg_names: List[str] = (
-            list(meta.get("seg_names") or legacy) if reuse else []
-        )
+        # full lineage rewrite.
+        seg_names: List[str] = prev_names[:keep]
         segments = []
-        for i, ch in enumerate(chunks):
-            if reuse and i < len(on_disk):
-                continue  # already committed, immutable, name kept
-            name = f"measurements-{tenant}-seg{i:06d}-g{gen:08d}.parquet"
+        for i in range(keep, len(segs)):
+            name = f"measurements-{tenant}-seg{i:06d}-g{gen:08d}.sws"
             seg_names.append(name)
-            segments.append((name, self._encode_parquet(ch)))
-        tail = self._encode_parquet(store.measurements._tail_arrays())
-        tail_name = f"measurements-{tenant}-tail{gen:08d}.parquet"
+            segments.append((name, segs[i].encoded))
+            # the commit (meta replace) happens in write_tenant_stores; if
+            # it never does, the stale ckpt_name simply forces a re-encode
+            # next snapshot
+            segs[i].ckpt_name = name
+        tail = store.measurements.encode_tail()
+        tail_name = f"measurements-{tenant}-tail{gen:08d}.sws"
         other_name = f"events-{tenant}-g{gen:08d}.jsonl"
         return {
             "devices": json.dumps(dm.snapshot(), default=str),
@@ -261,10 +257,11 @@ class CheckpointManager:
         keep = set(meta["seg_names"]) | {meta["tail"], meta["other"]}
         t = re.escape(tenant)
         pq_pat = re.compile(
-            rf"^measurements-{t}-(seg\d{{6}}(-g\d{{8}})?|tail\d{{8}})\.parquet$"
+            rf"^measurements-{t}-(seg\d{{6}}(-g\d{{8}})?|tail\d{{8}})"
+            rf"\.(parquet|sws)$"
         )
         jl_pat = re.compile(rf"^events-{t}-g\d{{8}}\.jsonl$")
-        for old in ev_dir.glob(f"measurements-{tenant}-*.parquet"):
+        for old in ev_dir.glob(f"measurements-{tenant}-*"):
             if pq_pat.match(old.name) and old.name not in keep:
                 old.unlink(missing_ok=True)
         for old in ev_dir.glob(f"events-{tenant}-g*.jsonl"):
@@ -283,11 +280,20 @@ class CheckpointManager:
         return DeviceManagement.load(path)
 
     def load_event_store(self, tenant: str):
-        """Rebuild a store from its parquet segments + tail: columns load
-        straight into sealed chunks (no per-row object rebuild). Falls
-        back to the legacy single-file layout."""
+        """Rebuild a store from its committed segment files + tail.
+
+        ``.sws`` segments are **mmap'd** straight into the store (zero
+        row bytes touched at load; columns are frombuffer views over the
+        map) and the generational tail adopts as a small segment the
+        store's background compaction later merges. Pre-segstore parquet
+        checkpoints decode through the legacy path into sealed segments.
+        Falls back to the legacy single-file layout."""
         from sitewhere_tpu.core.events import event_from_dict
         from sitewhere_tpu.services.event_store import EventStore
+        from sitewhere_tpu.storage.segstore import (
+            Segment,
+            SegmentFormatError,
+        )
 
         meta = self._load_seg_meta(tenant)
         if meta is None:
@@ -307,12 +313,12 @@ class CheckpointManager:
         ]
         tail_path = self.root / "events" / meta["tail"]
 
-        import pyarrow.parquet as pq
-
         dtypes = {"value": np.float32, "score": np.float32,
                   "event_ts": np.int64, "received_ts": np.int64}
 
         def read_chunk(path: Path) -> dict:
+            import pyarrow.parquet as pq  # legacy checkpoints only
+
             t = pq.read_table(path)
             return {
                 name: (
@@ -327,7 +333,25 @@ class CheckpointManager:
         # restored store CONTINUES the on-disk lineage: future checkpoints
         # may extend these segments incrementally
         store.lineage = meta.get("lineage", store.lineage)
+        committed = set(n for n in meta.get("seg_names", []))
         for p in list(seg_files) + ([tail_path] if tail_path.exists() else []):
+            if p.suffix == ".sws":
+                try:
+                    seg = Segment.open(p)
+                except (SegmentFormatError, OSError, ValueError):
+                    # a torn committed file must never half-read; the
+                    # commit protocol makes this unreachable short of
+                    # disk corruption — skip the segment, keep the rest
+                    continue
+                if seg.n:
+                    if p.name in committed:
+                        # identity survives the restart: the next
+                        # checkpoint reuses this file unless maintain()
+                        # replaces the segment (the tail file stays
+                        # anonymous — it re-encodes as a proper segment)
+                        seg.ckpt_name = p.name
+                    store.measurements.add_segment(seg)
+                continue
             ch = read_chunk(p)
             if len(ch["value"]):
                 store.measurements.add_sealed_chunk(ch)

@@ -1,22 +1,27 @@
-"""Event persistence: columnar store + paged queries + replay.
+"""Event persistence: columnar segment store + paged queries + replay.
 
 Capability parity with the reference's service-event-management
 (``IDeviceEventManagement`` per tenant: persist each event type, paged
 queries by assignment/time, re-emit enriched events — SURVEY.md §2.2/§3.1/
 §3.4 [U]; reference mount empty, see provenance banner). The reference
-persists to InfluxDB/Cassandra; the rebuild persists to in-memory column
-chunks spillable to **Parquet** (pyarrow) — the same columnar layout the
-TPU batcher wants, so replay into the DeepAR/forecast configs
-(BASELINE.json:9) is a zero-copy array slice, not a row scan.
+persists to InfluxDB/Cassandra; the rebuild persists to the wire-speed
+columnar segment store (``storage/segstore.py``): append-only zone-mapped
+segments sealed at a fixed row budget, mmap zero-copy reads, tiered
+retention with compaction, and ``plan``/``scan`` feeding the replay
+engine (``pipeline/replay.py``) at feed-path rates. **Parquet remains an
+export/import format** (``save_parquet``/``load_parquet``) — it is no
+longer the hot path (docs/STORAGE.md).
 
 Replay contract: ``replay_measurements`` yields windows of raw values per
 stream in event-time order — the feed for forecaster training/backtesting.
+Bulk replay-to-rescore rides ``measurements.scan`` instead (zone-planned
+column slices, no object materialization).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -29,6 +34,10 @@ from sitewhere_tpu.core.events import (
     EventType,
     event_from_dict,
 )
+from sitewhere_tpu.storage.segstore import SegmentColumns
+
+# back-compat alias: the chunk store grew into the segment store
+_MeasurementColumns = SegmentColumns
 
 
 @dataclass
@@ -46,240 +55,39 @@ class EventQuery:
     page_size: int = 100
 
 
-def _pin_prefix(b) -> str:
-    """Pin (or reuse) a batch's lazy event-id prefix (see
-    MeasurementBatch.id_prefix for the identity contract)."""
-    if b.id_prefix is None:
-        import uuid
-
-        b.id_prefix = uuid.uuid4().hex[:16] + "-"
-    return b.id_prefix
-
-
-class _MeasurementColumns:
-    """Append-only struct-of-arrays chunk store for measurements."""
-
-    CHUNK = 65536
-
-    def __init__(self) -> None:
-        self._chunks: List[Dict[str, np.ndarray]] = []
-        self._cur: Dict[str, list] = self._fresh()
-        # batch-append path: whole array chunks parked as-is (O(1) per
-        # batch, zero per-row work) until the next seal concatenates them
-        self._pending: List[Dict[str, np.ndarray]] = []
-        self._pending_rows = 0
-        self._materialized: Optional[Dict[str, np.ndarray]] = None
-        # concat of SEALED chunks only — invalidated on seal, not on every
-        # append, so live-ingest reads pay O(tail) not O(n) per query
-        self._sealed_cache: Optional[Dict[str, np.ndarray]] = None
-
-    @staticmethod
-    def _fresh() -> Dict[str, list]:
-        return {
-            "event_id": [], "device_token": [], "assignment_token": [],
-            "area_token": [], "name": [], "value": [], "score": [],
-            "event_ts": [], "received_ts": [],
-        }
-
-    def append(self, e: DeviceMeasurement) -> None:
-        c = self._cur
-        c["event_id"].append(e.id)
-        c["device_token"].append(e.device_token)
-        c["assignment_token"].append(e.assignment_token)
-        c["area_token"].append(e.area_token)
-        c["name"].append(e.name)
-        c["value"].append(e.value)
-        c["score"].append(e.score if e.score is not None else np.nan)
-        c["event_ts"].append(e.event_ts)
-        c["received_ts"].append(e.received_ts)
-        self._materialized = None  # invalidate read cache (tail changed)
-        if len(c["value"]) >= self.CHUNK:
-            self._seal()
-
-    def append_batch(self, b) -> None:
-        """Columnar bulk append from a MeasurementBatch: the batch's arrays
-        are parked as one pending chunk — O(1) per batch, no per-row work
-        on the ingest hot path."""
-        n = b.n
-        if n == 0:
-            return
-
-        def col(a):
-            return a if a is not None else np.full((n,), "", object)
-
-        self._pending.append(
-            {
-                # ids stay LAZY (None + the BATCH's pinned prefix) until a
-                # seal or read forces them — id generation is pure overhead
-                # on the steady-state ingest path (~90 ns/row even
-                # vectorized), and sharing the batch's prefix keeps the
-                # persisted ids identical to any later edge
-                # materialization of the same batch (to_events, WS feed)
-                "event_id": b.event_ids,
-                "_idp": None if b.event_ids is not None else _pin_prefix(b),
-                "device_token": col(b.device_tokens),
-                "assignment_token": col(b.assignment_tokens),
-                "area_token": col(b.area_tokens),
-                "name": col(b.names),
-                "value": b.values,
-                "score": (
-                    b.scores
-                    if b.scores is not None
-                    else np.full((n,), np.nan, np.float32)
-                ),
-                "event_ts": b.event_ts.astype(np.int64),
-                "received_ts": b.received_ts.astype(np.int64),
-            }
-        )
-        self._pending_rows += n
-        self._materialized = None
-        if self._pending_rows + len(self._cur["value"]) >= self.CHUNK:
-            self._seal()
-
-    @staticmethod
-    def _ensure_ids(chunk: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """Materialize a chunk's lazy event ids in place (idempotent).
-        Lazy chunks carry ``event_id: None`` plus either ``_idp`` (one
-        prefix) or ``_idsegs`` ([(prefix, n), ...] after a lazy seal)."""
-        from sitewhere_tpu.core.batch import make_event_ids
-
-        if chunk.get("event_id") is not None:
-            chunk.pop("_idp", None)
-            chunk.pop("_idsegs", None)
-            return chunk
-        segs = chunk.pop("_idsegs", None)
-        if segs is None:
-            segs = [(chunk.pop("_idp"), len(chunk["value"]))]
-        else:
-            chunk.pop("_idp", None)
-        parts = [make_event_ids(p, n) for p, n in segs]
-        chunk["event_id"] = (
-            parts[0] if len(parts) == 1 else np.concatenate(parts)
-        )
-        return chunk
-
-    def _seal(self) -> None:
-        if not self._cur["value"] and not self._pending:
-            return
-        self._sealed_cache = None
-        parts: List[Dict[str, np.ndarray]] = list(self._pending)
-        if self._cur["value"]:
-            parts.append(self._cur_arrays())
-        if len(parts) == 1:
-            chunk = parts[0]
-        else:
-            # all-lazy parts seal LAZY: carry the (prefix, n) segments
-            # forward instead of paying id generation on the ingest path
-            lazy = all(p.get("event_id") is None for p in parts)
-            if lazy:
-                idsegs: List[tuple] = []
-                for p in parts:
-                    idsegs.extend(
-                        p.get("_idsegs") or [(p["_idp"], len(p["value"]))]
-                    )
-            else:
-                parts = [self._ensure_ids(p) for p in parts]
-            keys = [
-                k for k in parts[0]
-                if not k.startswith("_") and not (lazy and k == "event_id")
-            ]
-            chunk = {k: np.concatenate([p[k] for p in parts]) for k in keys}
-            if lazy:
-                chunk["event_id"] = None
-                chunk["_idsegs"] = idsegs
-        self._chunks.append(chunk)
-        self._pending = []
-        self._pending_rows = 0
-        self._cur = self._fresh()
-
-    OBJ = ("event_id", "device_token", "assignment_token", "area_token", "name")
-
-    DTYPES = {"value": np.float32, "score": np.float32,
-              "event_ts": np.int64, "received_ts": np.int64}
-
-    def _cur_arrays(self) -> Dict[str, np.ndarray]:
-        """Live per-row tail → typed arrays (the one _cur→array mapping)."""
-        return {
-            k: np.asarray(v, object if k in self.OBJ else self.DTYPES[k])
-            for k, v in self._cur.items()
-        }
-
-    def _tail_arrays(self) -> Dict[str, np.ndarray]:
-        cur = self._cur_arrays()
-        if not self._pending:
-            return cur
-        parts = [self._ensure_ids(p) for p in self._pending] + (
-            [cur] if len(cur["value"]) else []
-        )
-        if len(parts) == 1:
-            return parts[0]
-        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
-
-    def columns(self) -> Dict[str, np.ndarray]:
-        """Materialize all rows as one struct-of-arrays dict. Two-level
-        cache: sealed chunks concat once per seal (not per append), the
-        live tail concats on top per read — so a REST query racing live
-        ingest pays O(tail), not O(total rows)."""
-        if self._materialized is not None:
-            return self._materialized
-        if self._sealed_cache is None and self._chunks:
-            chunks = [self._ensure_ids(ch) for ch in self._chunks]
-            self._sealed_cache = {
-                k: np.concatenate([ch[k] for ch in chunks])
-                for k in chunks[0]
-            }
-        tail = self._tail_arrays()
-        if self._sealed_cache is None:
-            out = tail
-        elif len(tail["value"]) == 0:
-            out = self._sealed_cache
-        else:
-            out = {
-                k: np.concatenate([self._sealed_cache[k], tail[k]])
-                for k in tail
-            }
-        self._materialized = out
-        return out
-
-    def add_sealed_chunk(self, chunk: Dict[str, np.ndarray]) -> None:
-        """Adopt a pre-built column chunk (restore path): zero per-row
-        work. Caller guarantees the chunk's columns are parallel arrays
-        in this store's schema."""
-        self._sealed_cache = None
-        self._materialized = None
-        self._chunks.append(chunk)
-
-    def sealed_chunks(self) -> List[Dict[str, np.ndarray]]:
-        """The immutable sealed chunks (checkpoint segment contract).
-        Lazy ids materialize here: checkpoint segments are self-contained."""
-        return [self._ensure_ids(ch) for ch in self._chunks]
-
-    def __len__(self) -> int:
-        return (
-            sum(len(ch["value"]) for ch in self._chunks)
-            + self._pending_rows
-            + len(self._cur["value"])
-        )
-
-
 class EventStore:
     """Per-tenant event persistence (the IDeviceEventManagement surface)."""
 
-    def __init__(self, tenant: str = "default") -> None:
-        import uuid
-
+    def __init__(
+        self,
+        tenant: str = "default",
+        data_dir: Optional[str | Path] = None,
+        rows_per_segment: int = SegmentColumns.CHUNK,
+        retention_ms: float = 0.0,
+    ) -> None:
         self.tenant = tenant
-        # lineage id: identifies THIS store's data history across
-        # checkpoint/restore cycles — a checkpoint dir written by a
-        # different lineage must never be incrementally extended (row
-        # counts alone can't distinguish lineages)
-        self.lineage = uuid.uuid4().hex
-        self.measurements = _MeasurementColumns()
+        # measurements live in the columnar segment store; a data_dir
+        # makes every seal durable (file + fsync + manifest commit point)
+        self.measurements = SegmentColumns(
+            tenant,
+            directory=data_dir,
+            rows_per_segment=rows_per_segment,
+            retention_ms=retention_ms,
+        )
         # non-measurement events are object-shaped (low volume)
         self._other: Dict[EventType, List[DeviceEvent]] = {
             t: [] for t in EventType if t is not EventType.MEASUREMENT
         }
         self._by_id: Dict[str, DeviceEvent] = {}
+
+    @property
+    def lineage(self) -> str:
+        """Store data-history identity (see SegmentColumns.lineage)."""
+        return self.measurements.lineage
+
+    @lineage.setter
+    def lineage(self, value: str) -> None:
+        self.measurements.lineage = value
 
     # -- writes ----------------------------------------------------------
     def add_event(self, e: DeviceEvent) -> DeviceEvent:
@@ -301,31 +109,49 @@ class EventStore:
         self.measurements.append_batch(batch)
         return batch.n
 
+    def maintain(self, max_units: Optional[int] = None) -> Dict[str, int]:
+        """One storage maintenance pass (retention + compaction) — driven
+        by the instance's background tick; cheap no-op when idle.
+        ``max_units`` bounds re-encode work per pass (see
+        ``SegmentColumns.maintain``)."""
+        return self.measurements.maintain(max_units=max_units)
+
     # -- reads -----------------------------------------------------------
     def get_event(self, event_id: str) -> Optional[DeviceEvent]:
         hit = self._by_id.get(event_id)
         if hit is not None:
             return hit
-        cols = self.measurements.columns()
-        idx = np.nonzero(cols["event_id"] == event_id)[0]
-        if idx.size == 0:
+        # O(1) as the store grows: sealed rows resolve through the
+        # seal-time id index, only the bounded tail is scanned
+        row = self.measurements.find_row(event_id)
+        if row is None:
             return None
-        return self._row_to_event(cols, int(idx[0]))
+        return self._scalar_row_to_event(row)
+
+    def _scalar_row_to_event(
+        self, row: Dict[str, object]
+    ) -> DeviceMeasurement:
+        """The ONE scalar-row → DeviceMeasurement mapping (NaN score →
+        None): id lookups and paged queries must stay shape-identical."""
+        score = float(row["score"])
+        return DeviceMeasurement(
+            id=str(row["event_id"]),
+            device_token=str(row["device_token"]),
+            assignment_token=str(row["assignment_token"]),
+            area_token=str(row["area_token"]),
+            tenant=self.tenant,
+            name=str(row["name"]),
+            value=float(row["value"]),
+            score=None if np.isnan(score) else score,
+            event_ts=int(row["event_ts"]),
+            received_ts=int(row["received_ts"]),
+        )
 
     def _row_to_event(self, cols: Dict[str, np.ndarray], i: int) -> DeviceMeasurement:
-        score = float(cols["score"][i])
-        return DeviceMeasurement(
-            id=str(cols["event_id"][i]),
-            device_token=str(cols["device_token"][i]),
-            assignment_token=str(cols["assignment_token"][i]),
-            area_token=str(cols["area_token"][i]),
-            tenant=self.tenant,
-            name=str(cols["name"][i]),
-            value=float(cols["value"][i]),
-            score=None if np.isnan(score) else score,
-            event_ts=int(cols["event_ts"][i]),
-            received_ts=int(cols["received_ts"][i]),
-        )
+        return self._scalar_row_to_event({k: cols[k][i] for k in (
+            "event_id", "device_token", "assignment_token", "area_token",
+            "name", "value", "score", "event_ts", "received_ts",
+        )})
 
     def _matching_measurement_rows(self, q: EventQuery) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
         """All matching measurement row indices, event-time ordered (unpaged)."""
@@ -435,7 +261,7 @@ class EventStore:
             for lo in range(0, len(vals) - window + 1, stride):
                 yield dev, nm, vals[lo : lo + window]
 
-    # -- parquet spill ---------------------------------------------------
+    # -- parquet export/import (NOT the hot path) ------------------------
     def save_parquet(self, directory: str | Path) -> Path:
         import pyarrow as pa
         import pyarrow.parquet as pq

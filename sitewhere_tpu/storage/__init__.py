@@ -1,0 +1,19 @@
+"""Wire-speed storage subsystem: columnar segment files with zone-map
+indexes, a manifest with commit points, mmap zero-copy reads, and tiered
+retention with compaction (docs/STORAGE.md)."""
+
+from sitewhere_tpu.storage.segstore import (
+    Segment,
+    SegmentColumns,
+    SegmentFormatError,
+    encode_segment,
+    slice_columns,
+)
+
+__all__ = [
+    "Segment",
+    "SegmentColumns",
+    "SegmentFormatError",
+    "encode_segment",
+    "slice_columns",
+]

@@ -299,6 +299,49 @@ def test_segment_lineage_mismatch_forces_full_rewrite(tmp_path):
     assert snap2["segments"] == []  # sealed segment reused across restore
 
 
+def test_dirty_segment_rewrite_recheckpoints_despite_matching_counts(
+    tmp_path,
+):
+    """maintain() re-encoding a score-written segment in place keeps the
+    row count — the next checkpoint must still rewrite it (reuse is
+    keyed on segment identity, not counts), or the rescore silently
+    reverts to NaN on restore and the dedupe re-replays it."""
+    from sitewhere_tpu.core.batch import MeasurementBatch
+    from sitewhere_tpu.services.device_management import DeviceManagement
+    from sitewhere_tpu.services.event_store import EventStore
+
+    ck = CheckpointManager(tmp_path)
+    dm = DeviceManagement("seg")
+    store = EventStore("seg")
+    store.add_measurement_batch(MeasurementBatch.from_column_chunks(
+        "seg",
+        [("d1", "t", np.arange(100).astype(np.float32),
+          np.arange(100).astype(np.float64) + 1)],
+    ))
+    store.measurements._seal()
+    ck.write_tenant_stores("seg", ck.snapshot_tenant_stores(dm, store))
+
+    ids = store.measurements.segments[0].event_ids()
+    fresh = np.linspace(0.0, 1.0, 100).astype(np.float32)
+    assert store.measurements.write_back_scores(ids, fresh) == 100
+    acts = store.measurements.maintain()
+    assert acts["rewritten"] == 1  # same count, new bytes
+    snap = ck.snapshot_tenant_stores(dm, store)
+    assert len(snap["segments"]) == 1  # re-encoded, NOT count-reused
+    ck.write_tenant_stores("seg", snap)
+
+    got = ck.load_event_store("seg")
+    np.testing.assert_allclose(
+        got.measurements.columns()["score"], fresh, rtol=1e-6
+    )
+    assert sum(
+        sl.n for sl in got.measurements.scan(only_unscored=True)
+    ) == 0  # nothing re-replays after restore
+    # steady state: the rewritten file reuses again on the next cycle
+    snap2 = ck.snapshot_tenant_stores(dm, got)
+    assert snap2["segments"] == []
+
+
 def test_cleanup_never_touches_prefix_sibling_tenant(tmp_path):
     """ADVICE r4 (medium): checkpointing tenant 'prod' must NOT delete
     tenant 'prod-eu's committed segment files — cleanup is anchored to

@@ -1,0 +1,505 @@
+"""Replay-to-rescore engine: the real-scoring-path drive, mid-replay
+crash/resume with zero duplicates and zero loss, overload arbitration
+(live traffic always wins), zone-map windowed jobs, dedupe accounting,
+targets, REST surface, and the hot-path lint registrations
+(docs/STORAGE.md "Replay")."""
+
+import asyncio
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.batch import MeasurementBatch
+from sitewhere_tpu.pipeline.replay import REPLAY_TARGETS, ReplayEngine
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.services.event_store import EventStore
+
+_spec = importlib.util.spec_from_file_location(
+    "check_hotpath",
+    Path(__file__).resolve().parent.parent / "tools" / "check_hotpath.py",
+)
+check_hotpath = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_hotpath)
+
+
+def _batch(n, t0=1000.0, tenant="t1", scores=None, n_devices=4):
+    rng = np.random.RandomState(int(t0) % 65536)
+    return MeasurementBatch(
+        tenant=tenant,
+        stream_ids=np.zeros((n,), np.int32),
+        values=rng.rand(n).astype(np.float32),
+        event_ts=t0 + np.arange(n, dtype=np.float64),
+        received_ts=t0 + np.arange(n, dtype=np.float64) + 5.0,
+        valid=np.ones((n,), bool),
+        device_tokens=np.array(
+            [f"dev-{i % n_devices}" for i in range(n)], object
+        ),
+        names=np.full((n,), "temp", object),
+        scores=scores,
+    )
+
+
+def _store(tenant="t1", rows_per_segment=256):
+    return EventStore(tenant, rows_per_segment=rows_per_segment)
+
+
+class _FakeOverload:
+    def __init__(self):
+        self.credits = {}
+        self.levels = {}
+
+    def credit(self, tenant):
+        return self.credits.get(tenant, 1.0)
+
+    def level(self, tenant):
+        return self.levels.get(tenant, 0)
+
+
+async def _drain(bus, topic, group="replay-test"):
+    out = []
+    while True:
+        items = await bus.consume(topic, group, 256, timeout_s=0.05)
+        if not items:
+            return out
+        out.extend(items)
+
+
+async def _wait_for(cond, secs=20.0):
+    for _ in range(int(secs / 0.02)):
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return cond()
+
+
+# ------------------------------------------------------------- engine core
+async def test_rescore_job_replays_everything_once():
+    bus = EventBus(TopicNaming("rp"))
+    store = _store()
+    for k in range(4):
+        store.add_measurement_batch(_batch(256, t0=1000 + 256 * k))
+    store.measurements._seal()
+    topic = bus.naming.inbound_events("t1")
+    bus.subscribe(topic, "replay-test")
+    m = MetricsRegistry()
+    eng = ReplayEngine(bus, m, batch_rows=100)
+    job = eng.start_job("t1", store, target="rescore")
+    assert await _wait_for(lambda: job.status == "done")
+    got = await _drain(bus, topic)
+    assert sum(b.n for b in got) == 1024
+    ids = [i for b in got for i in b.ensure_event_ids()]
+    assert len(ids) == len(set(ids)) == 1024
+    # replayed batches carry the persistence-skip mark + inherited
+    # group indexes (no downstream string sort) and NO stale scores
+    for b in got:
+        assert "replay" in b.trace
+        assert b.tok_index is not None and b.scores is None
+    assert job.replayed == 1024 and job.skipped_dedupe == 0
+    assert m.counter("replay_events_total", tenant="t1",
+                     target="rescore").value == 1024
+    assert m.counter("replay_bytes_total", tenant="t1").value > 0
+
+
+async def test_dedupe_skips_scored_rows_force_overrides():
+    bus = EventBus(TopicNaming("rp"))
+    store = _store()
+    scores = np.full((256,), np.nan, np.float32)
+    scores[::2] = 0.7  # half the history already scored
+    store.add_measurement_batch(_batch(256, scores=scores))
+    store.measurements._seal()
+    topic = bus.naming.inbound_events("t1")
+    bus.subscribe(topic, "replay-test")
+    eng = ReplayEngine(bus, MetricsRegistry(), batch_rows=64)
+    job = eng.start_job("t1", store)
+    assert await _wait_for(lambda: job.status == "done")
+    assert job.replayed == 128 and job.skipped_dedupe == 128
+    assert sum(b.n for b in await _drain(bus, topic)) == 128
+    # force: every row replays, nothing skips
+    job2 = eng.start_job("t1", store, force=True)
+    assert await _wait_for(lambda: job2.status == "done")
+    assert job2.replayed == 256 and job2.skipped_dedupe == 0
+
+
+async def test_windowed_job_reads_only_matching_segments():
+    """Zone-map pruning at the job level: a time-windowed replay touches
+    ONLY the segments whose zone maps intersect the window."""
+    bus = EventBus(TopicNaming("rp"))
+    store = _store(rows_per_segment=100)
+    for k in range(4):  # disjoint event-time ranges
+        store.add_measurement_batch(_batch(100, t0=1000 + 100_000 * k))
+    store.measurements._seal()
+    topic = bus.naming.inbound_events("t1")
+    bus.subscribe(topic, "replay-test")
+    m = MetricsRegistry()
+    eng = ReplayEngine(bus, m, batch_rows=64)
+    job = eng.start_job("t1", store, ts0=201_000, ts1=201_049)
+    assert job.segments_planned == 1 and job.segments_pruned == 3
+    assert await _wait_for(lambda: job.status == "done")
+    got = await _drain(bus, topic)
+    assert job.replayed == sum(b.n for b in got) == 50
+    for b in got:
+        assert b.event_ts.min() >= 201_000 and b.event_ts.max() <= 201_049
+    assert m.counter("replay_segments_pruned_total",
+                     tenant="t1").value == 3
+
+
+async def test_rules_and_train_targets_reemit_stored_scores():
+    bus = EventBus(TopicNaming("rp"))
+    store = _store()
+    scores = np.linspace(0, 1, 128, dtype=np.float32)
+    store.add_measurement_batch(_batch(128, scores=scores))
+    store.measurements._seal()
+    eng = ReplayEngine(bus, MetricsRegistry(), batch_rows=64)
+    for target, naming in (
+        ("rules", bus.naming.persisted_events),
+        ("train", bus.naming.train_feed),
+    ):
+        topic = naming("t1")
+        bus.subscribe(topic, "replay-test")
+        job = eng.start_job("t1", store, target=target)
+        assert await _wait_for(lambda: job.status == "done")
+        got = await _drain(bus, topic)
+        assert sum(b.n for b in got) == 128
+        # scored history rides with its STORED scores (not recomputed)
+        all_scores = np.concatenate([b.scores for b in got])
+        np.testing.assert_allclose(np.sort(all_scores), scores, rtol=1e-6)
+    with pytest.raises(ValueError):
+        eng.start_job("t1", store, target="nope")
+    assert set(REPLAY_TARGETS) == {"rescore", "rules", "train"}
+
+
+# -------------------------------------------------------- crash and resume
+async def test_mid_replay_crash_resume_zero_dup_zero_loss(tmp_path):
+    """Kill the engine mid-replay, resume from the persisted cursor in a
+    FRESH engine: every stored row is published exactly once across the
+    two lives, and replayed ∪ skipped accounting stays exact."""
+    bus = EventBus(TopicNaming("rp"))
+    store = _store(rows_per_segment=256)
+    scores = np.full((256,), np.nan, np.float32)
+    scores[:64] = 0.5  # some pre-scored rows so skip accounting resumes too
+    store.add_measurement_batch(_batch(256, scores=scores))
+    for k in range(1, 6):
+        store.add_measurement_batch(_batch(256, t0=1000 + 256 * k))
+    store.measurements._seal()
+    total_unscored = 6 * 256 - 64
+    topic = bus.naming.inbound_events("t1")
+    bus.subscribe(topic, "replay-test")
+
+    eng1 = ReplayEngine(bus, MetricsRegistry(), state_dir=tmp_path,
+                        batch_rows=32)
+    job1 = eng1.start_job("t1", store)
+    assert job1.segments_planned == 6 and job1.segments_pruned == 0
+    # let at least one whole segment complete, so the resume re-plan
+    # WOULD prune it (seq_max < cursor) if accounting were naive
+    assert await _wait_for(lambda: job1.replayed >= 300)
+    await eng1.stop()  # crash: cancels scanner+pump mid-flight
+    assert job1.status in ("paused", "running")
+    got1 = await _drain(bus, topic)
+    # the committed cursor equals what was actually published: nothing
+    # published-but-uncommitted, nothing committed-but-unpublished
+    state = json.loads((tmp_path / f"{job1.job_id}.json").read_text())
+    assert state["replayed"] == sum(b.n for b in got1)
+    assert state["status"] == "paused"
+
+    m2 = MetricsRegistry()
+    eng2 = ReplayEngine(bus, m2, state_dir=tmp_path, batch_rows=32)
+    assert eng2.resume_jobs({"t1": store}) == 1
+    job2 = eng2.jobs[job1.job_id]
+    # the resumed job keeps its ORIGINAL plan accounting: segments it
+    # already replayed pre-crash must not be re-counted as zone-pruned
+    assert job2.segments_planned == 6 and job2.segments_pruned == 0
+    assert m2.counter("replay_segments_pruned_total",
+                      tenant="t1").value == 0
+    assert await _wait_for(lambda: job2.status == "done")
+    got2 = await _drain(bus, topic)
+    ids = [i for b in got1 + got2 for i in b.ensure_event_ids()]
+    assert len(ids) == total_unscored          # zero lost
+    assert len(set(ids)) == len(ids)           # zero double-scored
+    assert job2.replayed == total_unscored
+    assert job2.skipped_dedupe == 64           # exact across the crash
+    # finished jobs do not resume again
+    eng3 = ReplayEngine(bus, MetricsRegistry(), state_dir=tmp_path)
+    assert eng3.resume_jobs({"t1": store}) == 0
+
+
+async def test_finished_jobs_retire_state_files_and_bound_history(tmp_path):
+    """Terminal jobs never resume, so their cursor files are deleted and
+    the in-memory report history is bounded — a year of nightly jobs
+    must not grow state_dir or the jobs dict without bound."""
+    bus = EventBus(TopicNaming("rp"))
+    store = _store()
+    store.add_measurement_batch(_batch(64))
+    store.measurements._seal()
+    topic = bus.naming.inbound_events("t1")
+    bus.subscribe(topic, "replay-test")
+    eng = ReplayEngine(bus, MetricsRegistry(), state_dir=tmp_path,
+                       batch_rows=64, max_finished=3)
+    done = []
+    for _ in range(5):
+        job = eng.start_job("t1", store, force=True)
+        assert await _wait_for(lambda: job.status == "done")
+        done.append(job.job_id)
+        await _drain(bus, topic)
+    assert list(tmp_path.glob("rj-*.json")) == []  # no terminal files
+    assert set(eng.jobs) == set(done[-3:])  # bounded, most recent kept
+    # a fresh engine resumes nothing and resurrects nothing
+    eng2 = ReplayEngine(bus, MetricsRegistry(), state_dir=tmp_path)
+    assert eng2.resume_jobs({"t1": store}) == 0 and eng2.jobs == {}
+
+
+async def test_scan_fault_marks_job_failed_not_done():
+    """A scan fault mid-job must surface as status=failed — the pump's
+    clean-drain path must not overwrite it with done (a partial replay
+    presented as a successful DR recovery)."""
+    bus = EventBus(TopicNaming("rp"))
+    store = _store()
+    store.add_measurement_batch(_batch(256))
+    store.measurements._seal()
+    bus.subscribe(bus.naming.inbound_events("t1"), "replay-test")
+    real_scan = store.measurements.scan
+
+    def broken_scan(*a, **kw):
+        it = real_scan(*a, **kw)
+        yield next(it)
+        raise OSError("disk fault mid-scan")
+
+    store.measurements.scan = broken_scan
+    eng = ReplayEngine(bus, MetricsRegistry(), batch_rows=64)
+    job = eng.start_job("t1", store)
+    assert await _wait_for(
+        lambda: job.status in ("failed", "done") and not eng._tasks
+    )
+    assert job.status == "failed" and "disk fault" in job.error
+    assert job.replayed == 64  # the one good window still committed
+
+
+async def test_second_rescore_job_skips_rescored_rows_and_concurrent_guard():
+    """Within one store lifetime the no-double-scoring contract spans
+    JOBS: write-back overlays teach the dedupe, and a concurrent rescore
+    per tenant is refused outright."""
+    bus = EventBus(TopicNaming("rp"))
+    store = _store()
+    store.add_measurement_batch(_batch(256))
+    store.measurements._seal()
+    topic = bus.naming.inbound_events("t1")
+    bus.subscribe(topic, "replay-test")
+    eng = ReplayEngine(bus, MetricsRegistry(), batch_rows=64)
+    job1 = eng.start_job("t1", store)
+    # a second concurrent rescore for the SAME tenant is refused
+    with pytest.raises(ValueError, match="already has a running rescore"):
+        eng.start_job("t1", store)
+    assert await _wait_for(lambda: job1.status == "done")
+    got = await _drain(bus, topic)
+    assert sum(b.n for b in got) == 256
+    # the persistence stage's write-back (simulated here: the scored
+    # round trip landed) teaches the store
+    ids = np.concatenate([b.ensure_event_ids() for b in got])
+    store.measurements.write_back_scores(
+        ids, np.full((len(ids),), 0.5, np.float32)
+    )
+    job2 = eng.start_job("t1", store)
+    assert await _wait_for(lambda: job2.status == "done")
+    assert job2.replayed == 0 and job2.skipped_dedupe == 256
+    assert await _drain(bus, topic) == []  # nothing re-published
+
+
+# ----------------------------------------------------- overload arbitration
+async def test_saturated_tenant_throttles_replay_idle_runs_full_rate():
+    """Live traffic always wins: a tenant under pressure (credit < 1)
+    parks its own replay at ~0 while an idle tenant's replay runs at full
+    rate — and the parked job completes exactly once pressure clears."""
+    bus = EventBus(TopicNaming("rp"))
+    stores = {}
+    for t in ("busy", "idle"):
+        s = _store(tenant=t)
+        s.add_measurement_batch(_batch(512, tenant=t))
+        s.measurements._seal()
+        stores[t] = s
+        bus.subscribe(bus.naming.inbound_events(t), "replay-test")
+    ov = _FakeOverload()
+    ov.credits["busy"] = 0.4  # saturated: live lag holds the credit
+    m = MetricsRegistry()
+    eng = ReplayEngine(bus, m, overload=ov, batch_rows=64,
+                       throttle_tick_s=0.005)
+    jb = eng.start_job("busy", stores["busy"])
+    ji = eng.start_job("idle", stores["idle"])
+    assert await _wait_for(lambda: ji.status == "done")
+    assert ji.replayed == 512 and ji.throttled == 0
+    # the busy tenant's pump is parked: nothing published, ticks counted
+    assert await _wait_for(lambda: jb.throttled > 0)
+    assert jb.replayed == 0 and jb.status == "running"
+    assert m.counter("replay_throttled_total", tenant="busy").value > 0
+    assert m.gauge("replay_lag_ratio", tenant="busy").value > 0.9
+    busy_topic = bus.naming.inbound_events("busy")
+    assert await _drain(bus, busy_topic) == []
+    # an engaged degradation rung parks exactly the same way
+    ov.credits["busy"] = 1.0
+    ov.levels["busy"] = 1
+    await asyncio.sleep(0.05)
+    assert jb.replayed == 0
+    # pressure clears → the parked job drains completely, exact accounting
+    ov.levels["busy"] = 0
+    assert await _wait_for(lambda: jb.status == "done")
+    assert jb.replayed == 512 and jb.skipped_dedupe == 0
+    assert sum(b.n for b in await _drain(bus, busy_topic)) == 512
+    assert m.gauge("replay_lag_ratio", tenant="busy").value == 0.0
+
+
+# --------------------------------------------- the real-scoring-path drive
+async def test_replay_to_rescore_rides_the_real_feed_path(tmp_path):
+    """End to end on a live instance: unscored history streams from the
+    segment store through the ACTUAL scoring path — lane rings → h2d
+    prefetch → device gather → async-D2H reaper — lands scored on the
+    scored-events topic exactly once, and the persistence stage skips
+    re-appending (the rows ARE the store)."""
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig, MicroBatchConfig
+
+    inst = SiteWhereInstance(InstanceConfig(instance_id="rp-e2e"))
+    await inst.start()
+    try:
+        mb = MicroBatchConfig(max_batch=1024, deadline_ms=5.0,
+                              buckets=(256, 1024), window=8)
+        await inst.tenant_management.create_tenant(
+            "acme", template="iot-temperature", microbatch=mb,
+            decoder="binary", max_streams=64, model_config={"hidden": 16},
+        )
+        await inst.drain_tenant_updates()
+        assert await _wait_for(lambda: "acme" in inst.tenants)
+        store = inst.tenants["acme"].event_store
+        n = 2048
+        import time as _time
+
+        now = _time.time() * 1000.0
+        for off in range(0, n, 512):
+            store.add_measurement_batch(
+                _batch(512, t0=now - 10_000 + off, tenant="acme")
+            )
+        store.measurements._seal()
+        rows_before = len(store.measurements)
+        scored_topic = inst.bus.naming.scored_events("acme")
+        inst.bus.subscribe(scored_topic, "replay-test")
+        await asyncio.get_running_loop().run_in_executor(
+            None, inst.inference.prewarm
+        )
+        flushes0 = inst.metrics.counter("tpu_inference.flushes").value
+        lat0 = inst.metrics.histogram("tpu_inference.latency", unit="s")._n
+        job = inst.replay.start_job("acme", store, target="rescore")
+        assert await _wait_for(lambda: job.status == "done", secs=60)
+        assert job.replayed == n
+        # every replayed row came back SCORED on the scored topic, once
+        rescored = inst.metrics.counter(
+            "replay_rescored_total", tenant="acme"
+        )
+        assert await _wait_for(lambda: rescored.value >= n, secs=60)
+        got = [
+            b for b in await _drain(inst.bus, scored_topic)
+            if isinstance(b, MeasurementBatch)
+        ]
+        ids = [i for b in got for i in b.ensure_event_ids()]
+        assert len(ids) == n and len(set(ids)) == n
+        for b in got:
+            assert b.scores is not None
+            assert np.isfinite(b.scores).all()
+            assert "replay" in b.trace  # provenance survived scoring
+        # it rode the REAL flush path (device dispatches happened) ...
+        assert inst.metrics.counter("tpu_inference.flushes").value > flushes0
+        # ... WITHOUT polluting the live latency series: replayed history
+        # carries original received_ts — hours-old samples would flood
+        # the p99/SLO series for the whole replay
+        assert inst.metrics.histogram(
+            "tpu_inference.latency", unit="s"
+        )._n == lat0
+        # ... and the store was NOT re-appended (zero duplicate history)
+        assert len(store.measurements) == rows_before
+        assert rescored.value == n
+        # flight recorder carries the replay flush records
+        fr = inst.flightrec.describe()
+        replay_recs = (
+            fr["rings"].get("replay", {}).get("acme", {}).get("records", [])
+        )
+        assert replay_recs
+        assert sum(r.get("rows", 0) for r in replay_recs) == n
+        assert all(r["job"] == job.job_id for r in replay_recs)
+    finally:
+        await inst.terminate()
+
+
+# ----------------------------------------------------------- REST surface
+async def test_replay_rest_surface():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from sitewhere_tpu.api.rest import make_app
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import InstanceConfig
+
+    inst = SiteWhereInstance(InstanceConfig(instance_id="rp-rest"))
+    await inst.start()
+    try:
+        await inst.bootstrap(default_tenant="default", dataset_devices=3)
+        assert await _wait_for(lambda: "default" in inst.tenants)
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/api/authapi/jwt",
+                json={"username": "admin", "password": "password"},
+            )
+            token = (await resp.json())["token"]
+            client._session.headers["Authorization"] = f"Bearer {token}"
+            # storage shape endpoint
+            resp = await client.get("/api/tenants/default/storage")
+            assert resp.status == 200
+            shape = await resp.json()
+            assert {"segments", "rows", "next_seq", "zone_maps"} <= set(shape)
+            # an empty-window job completes immediately, reports pruning
+            resp = await client.post("/api/tenants/default/replay",
+                                     json={"target": "rescore"})
+            assert resp.status == 200
+            body = await resp.json()
+            job_id = body["job"]
+            assert body["status"] in ("running", "done")
+            resp = await client.get(
+                f"/api/tenants/default/replay/{job_id}"
+            )
+            assert resp.status == 200
+            rep = await resp.json()
+            assert {"replayed", "skipped_dedupe", "ev_s", "lag_ratio",
+                    "segments_planned", "segments_pruned"} <= set(rep)
+            resp = await client.get("/api/tenants/default/replay")
+            assert resp.status == 200
+            assert any(
+                j["job_id"] == job_id for j in (await resp.json())["jobs"]
+            )
+            # a JSON null device filter means NO filter, not the
+            # literal token "None" (which bloom-prunes everything)
+            resp = await client.post("/api/tenants/default/replay",
+                                     json={"target": "rescore",
+                                           "device": None})
+            assert resp.status == 200
+            assert (await resp.json())["device"] == ""
+            # error surfaces
+            resp = await client.post("/api/tenants/default/replay",
+                                     json={"target": "bogus"})
+            assert resp.status == 400
+            resp = await client.post("/api/tenants/ghost/replay", json={})
+            assert resp.status == 404
+            resp = await client.get(
+                "/api/tenants/default/replay/rj-missing"
+            )
+            assert resp.status == 404
+        finally:
+            await client.close()
+    finally:
+        await inst.terminate()
+
+
+# ------------------------------------------------------------- lint wiring
+def test_hotpath_lint_registers_storage_and_replay():
+    assert "storage/segstore.py" in check_hotpath.HOT_PATHS
+    assert "pipeline/replay.py" in check_hotpath.HOT_PATHS
+    assert check_hotpath.lint_hotpaths() == []

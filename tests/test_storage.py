@@ -1,0 +1,539 @@
+"""Segment store: wire roundtrip, manifest commit-point recovery (torn
+writes at every byte boundary), zone-map pruning, retention + compaction,
+the O(1) event-id index, and scan/resume dedupe accounting
+(docs/STORAGE.md)."""
+
+import importlib.util
+import json
+import shutil
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.batch import MeasurementBatch
+from sitewhere_tpu.services.event_store import EventStore
+from sitewhere_tpu.storage.segstore import (
+    Segment,
+    SegmentColumns,
+    SegmentFormatError,
+    encode_segment,
+    slice_columns,
+)
+
+_spec = importlib.util.spec_from_file_location(
+    "check_queues",
+    Path(__file__).resolve().parent.parent / "tools" / "check_queues.py",
+)
+check_queues = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_queues)
+
+
+def _batch(n, dev_prefix="dev", t0=1000.0, tenant="t1", scores=None,
+           n_devices=4):
+    rng = np.random.RandomState(int(t0) % 65536)
+    return MeasurementBatch(
+        tenant=tenant,
+        stream_ids=np.zeros((n,), np.int32),
+        values=rng.rand(n).astype(np.float32),
+        event_ts=t0 + np.arange(n, dtype=np.float64),
+        received_ts=t0 + np.arange(n, dtype=np.float64) + 5.0,
+        valid=np.ones((n,), bool),
+        device_tokens=np.array(
+            [f"{dev_prefix}-{i % n_devices}" for i in range(n)], object
+        ),
+        names=np.full((n,), "temp", object),
+        scores=scores,
+    )
+
+
+def _chunk(n, t0=1000):
+    rng = np.random.RandomState(3)
+    return {
+        "event_id": np.array([f"ev-{t0}-{i}" for i in range(n)], object),
+        "device_token": np.array([f"d{i % 3}" for i in range(n)], object),
+        "assignment_token": np.full((n,), "asn", object),
+        "area_token": np.full((n,), "", object),
+        "name": np.full((n,), "temp", object),
+        "value": rng.rand(n).astype(np.float32),
+        "score": np.full((n,), np.nan, np.float32),
+        "event_ts": (t0 + np.arange(n)).astype(np.int64),
+        "received_ts": (t0 + np.arange(n) + 5).astype(np.int64),
+    }
+
+
+# ------------------------------------------------------------ wire format
+def test_segment_roundtrip_all_columns():
+    data = encode_segment(_chunk(257), seq0=42, tenant="t1")
+    seg = Segment.from_bytes(data)
+    assert seg.n == 257 and seg.seq0 == 42 and seg.tenant == "t1"
+    ch = _chunk(257)
+    np.testing.assert_array_equal(seg.numeric("value"), ch["value"])
+    np.testing.assert_array_equal(seg.numeric("event_ts"), ch["event_ts"])
+    np.testing.assert_array_equal(seg.obj_column("device_token"),
+                                  ch["device_token"])
+    np.testing.assert_array_equal(seg.event_ids(), ch["event_id"])
+    # zone map covers the real ranges
+    assert seg.zone["ts_min"] == 1000 and seg.zone["ts_max"] == 1256
+    assert seg.zone["seq_min"] == 42 and seg.zone["seq_max"] == 42 + 256
+    assert seg.zone["n_devices"] == 3
+
+
+def test_segment_decode_is_zero_copy_views():
+    data = encode_segment(_chunk(64), seq0=0)
+    seg = Segment.from_bytes(data)
+    v = seg.numeric("value")
+    # a frombuffer view over the segment buffer, not a copy
+    assert v.base is not None
+    assert not v.flags.owndata
+
+
+def test_segment_rejects_tampering():
+    data = bytearray(encode_segment(_chunk(32), seq0=0))
+    with pytest.raises(SegmentFormatError):
+        Segment.from_bytes(data[: len(data) - 3])  # short column region
+    with pytest.raises(SegmentFormatError):
+        Segment.from_bytes(b"XXX" + bytes(data[3:]))  # bad magic
+    # hostile vocab index: corrupt a tok_inverse byte beyond vocab range
+    chunk = _chunk(8)
+    good = encode_segment(chunk, seq0=0)
+    seg = Segment.from_bytes(good)
+    off = len(good) - seg.numeric("area_inverse").nbytes * 2  # asg_inverse
+    bad = bytearray(good)
+    bad[off:off + 4] = (9999).to_bytes(4, "big")
+    with pytest.raises(SegmentFormatError):
+        Segment.from_bytes(bytes(bad))
+
+
+# ---------------------------------------------------- append/seal semantics
+def test_append_batch_seals_at_row_budget_and_reads_back():
+    sc = SegmentColumns("t1", rows_per_segment=1000)
+    for k in range(4):
+        sc.append_batch(_batch(300, t0=1000 + 300 * k))
+    assert len(sc) == 1200
+    assert len(sc.segments) == 1  # sealed at >=1000, tail 200 pending
+    cols = sc.columns()
+    assert len(cols["value"]) == 1200
+    # batch group indexes rode into the segment vocab (no string sort)
+    seg = sc.segments[0]
+    u, inv = seg.vocab("device_token")
+    assert set(u.tolist()) == {f"dev-{i}" for i in range(4)}
+    assert inv.dtype == np.int32
+
+
+def test_lazy_event_ids_shared_with_batch_prefix():
+    sc = SegmentColumns("t1", rows_per_segment=100)
+    b = _batch(100)
+    sc.append_batch(b)
+    assert len(sc.segments) == 1
+    ids = sc.segments[0].event_ids()
+    # the store's persisted ids == the batch's own later materialization
+    np.testing.assert_array_equal(ids, b.ensure_event_ids())
+
+
+# ------------------------------------------------- durability + torn writes
+def _mk_store(path, n_segs=3, rows=40):
+    sc = SegmentColumns("t1", directory=path, rows_per_segment=rows)
+    for k in range(n_segs):
+        sc.append_batch(_batch(rows, t0=1000 + rows * k))
+    return sc
+
+
+def test_dir_store_recovers_from_manifest(tmp_path):
+    sc = _mk_store(tmp_path, n_segs=3)
+    want = sc.columns()
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=40)
+    assert len(rd) == 120 and len(rd.segments) == 3
+    got = rd.columns()
+    np.testing.assert_array_equal(got["value"], want["value"])
+    np.testing.assert_array_equal(got["event_id"], want["event_id"])
+    assert rd.next_seq == sc.next_seq
+    # mmap-backed: column views do not own their data
+    assert not rd.segments[0].numeric("value").flags.owndata
+
+
+def test_stray_uncommitted_segment_file_is_deleted(tmp_path):
+    _mk_store(tmp_path, n_segs=2)
+    stray = tmp_path / "seg-999999999999-g999999.sws"
+    stray.write_bytes(b"garbage that never committed")
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=40)
+    assert len(rd.segments) == 2
+    assert not stray.exists()
+
+
+def test_torn_write_recovery_at_every_byte_boundary(tmp_path):
+    """A committed segment file truncated at EVERY byte boundary (disk
+    corruption after the manifest commit) must be detected whole-file and
+    dropped with everything after it — never half-read — and the dropped
+    rows' seqs are never reused."""
+    src = tmp_path / "src"
+    sc = _mk_store(src, n_segs=2, rows=30)
+    keep_rows = sc.segments[0].n
+    victim = sc.segments[-1]
+    data = victim.path.read_bytes()
+    next_seq = sc.next_seq
+    # sweep a stride of cuts across the whole file (every boundary in the
+    # header/meta region, strided through the column region for speed)
+    cuts = list(range(0, 64)) + list(range(64, len(data), 97)) + [
+        len(data) - 1
+    ]
+    for cut in cuts:
+        trial = tmp_path / f"trial-{cut}"
+        shutil.copytree(src, trial)
+        tseg = trial / victim.path.name
+        tseg.write_bytes(data[:cut])
+        rd = SegmentColumns("t1", directory=trial, rows_per_segment=30)
+        # (a) exactly the intact prefix survives
+        assert [s.n for s in rd.segments] == [keep_rows], f"cut={cut}"
+        assert rd.torn_dropped == 1
+        # (b) dropped seqs are not reused
+        assert rd.next_seq == next_seq, f"cut={cut}"
+        # (c) the repair was committed and the store appends cleanly
+        rd.append_batch(_batch(30, t0=9000))
+        assert rd.segments[-1].seq0 == next_seq
+        rd2 = SegmentColumns("t1", directory=trial, rows_per_segment=30)
+        assert len(rd2) == keep_rows + 30
+        shutil.rmtree(trial)
+
+
+def test_corrupt_committed_segment_same_size_drops_as_torn(tmp_path):
+    """Bit rot INSIDE a committed file (size unchanged, so the
+    manifest's size/row checks alone can't catch it) must read as
+    undecodable and drop like a torn tail — never crash recovery
+    (safepickle surfaces corrupt bytes as UnpicklingError, which is NOT
+    a ValueError)."""
+    sc = _mk_store(tmp_path, n_segs=2, rows=30)
+    victim = sc.segments[-1]
+    next_seq = sc.next_seq
+    data = bytearray(victim.path.read_bytes())
+    data[8] ^= 0xFF  # first byte of the pickled meta region
+    victim.path.write_bytes(bytes(data))
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=30)
+    assert [s.n for s in rd.segments] == [30]
+    assert rd.torn_dropped == 1
+    assert rd.next_seq == next_seq  # dropped seqs never reused
+    rd.append_batch(_batch(30, t0=9000))
+    assert rd.segments[-1].seq0 == next_seq
+
+
+def test_missing_committed_file_drops_tail_not_head(tmp_path):
+    sc = _mk_store(tmp_path, n_segs=3, rows=20)
+    sc.segments[1].path.unlink()  # middle segment vanishes
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=20)
+    # the torn tail starts AT the missing segment: only seg 0 survives
+    assert [s.seq0 for s in rd.segments] == [0]
+    assert rd.next_seq == 60
+
+
+# ------------------------------------------------------- zone-map planning
+def test_zone_map_pruning_time_seq_device():
+    sc = SegmentColumns("t1", rows_per_segment=100)
+    for k in range(4):  # disjoint event-time ranges per segment
+        sc.append_batch(_batch(100, t0=1000 + 10000 * k,
+                               dev_prefix=f"z{k}"))
+    assert len(sc.segments) == 4
+    sel, pruned = sc.plan(ts0=21000, ts1=21099, include_tail=False)
+    assert len(sel) == 1 and pruned == 3
+    assert sel[0].zone["ts_min"] == 21000
+    sel, pruned = sc.plan(seq_lo=250, seq_hi=260, include_tail=False)
+    assert len(sel) == 1 and sel[0].seq0 == 200
+    sel, pruned = sc.plan(device="z2-1", include_tail=False)
+    assert len(sel) == 1 and pruned == 3
+    # a window covering nothing prunes everything
+    sel, pruned = sc.plan(ts0=999999, include_tail=False)
+    assert sel == [] and pruned == 4
+
+
+def test_scan_filters_inside_matching_segment():
+    sc = SegmentColumns("t1", rows_per_segment=1000)
+    sc.append_batch(_batch(100, t0=5000))
+    rows = 0
+    for sl in sc.scan(ts0=5010, ts1=5019, device="dev-1"):
+        rows += sl.n
+        cols = slice_columns(sl)
+        assert np.all(cols["event_ts"] >= 5010)
+        assert np.all(cols["event_ts"] <= 5019)
+        u, inv = cols["tok"]
+        assert set(u[inv].tolist()) <= {"dev-1"}
+    # dev-1 appears at i % 4 == 1 → ts 5013, 5017 inside [5010, 5019]
+    assert rows == 2
+
+
+def test_scan_resume_and_dedupe_accounting():
+    """only_unscored + seq cursor: replayed ∪ skipped covers every raw
+    row exactly once, including across a simulated crash/resume."""
+    sc = SegmentColumns("t1", rows_per_segment=200)
+    scores = np.full((200,), np.nan, np.float32)
+    scores[::2] = 0.5  # half already scored
+    sc.append_batch(_batch(200, scores=scores))
+    # full pass
+    replayed = skipped = 0
+    for sl in sc.scan(only_unscored=True, batch_rows=64):
+        replayed += sl.n
+        skipped += sl.skipped
+    assert replayed == 100 and skipped == 100
+    # crash after the first window (cursor = seq_end+1), then resume
+    it = sc.scan(only_unscored=True, batch_rows=64)
+    first = next(it)
+    cursor = first.seq_end + 1
+    r2, s2 = first.n, first.skipped
+    for sl in sc.scan(seq_lo=cursor, only_unscored=True, batch_rows=64):
+        r2 += sl.n
+        s2 += sl.skipped
+    assert r2 == 100 and s2 == 100  # exact, no dup, no loss
+
+
+# --------------------------------------------------- retention + compaction
+def test_retention_drops_whole_segments(tmp_path):
+    sc = SegmentColumns("t1", directory=tmp_path, rows_per_segment=50)
+    now = time.time() * 1000.0
+    sc.append_batch(_batch(50, t0=now - 60_000.0))  # will expire
+    sc.append_batch(_batch(50, t0=now - 1_000.0))   # fresh
+    old_path = sc.segments[0].path
+    sc.retention_ms = 10_000.0  # tighten the horizon, then one tick
+    acts = sc.maintain()
+    assert acts["dropped"] == 1
+    assert sc.dropped_segments == 1 and sc.dropped_rows == 50
+    assert len(sc.segments) == 1 and not old_path.exists()
+    assert sc.segments[0].zone["ts_min"] >= now - 2_000.0
+    # recovery agrees with the post-drop manifest
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=50)
+    assert len(rd.segments) == 1 and rd.next_seq == 100
+
+
+def test_compaction_merges_small_adjacent_runs(tmp_path):
+    sc = SegmentColumns("t1", directory=tmp_path, rows_per_segment=1000)
+    want = []
+    for k in range(6):  # six tiny sealed segments (generational tails)
+        b = _batch(40, t0=1000 + 40 * k)
+        sc.append_batch(b)
+        sc._seal()
+        want.append(b)
+    # sealing never compacts (ingest stays O(chunk)) — the background
+    # tick does
+    assert sc.compactions == 0 and len(sc.segments) == 6
+    acts = sc.maintain()
+    assert acts["merged"] == 6 and sc.compactions >= 1
+    assert len(sc.segments) == 1 and sc.segments[0].n == 240
+    got = sc.columns()
+    np.testing.assert_array_equal(
+        got["value"], np.concatenate([b.values for b in want])
+    )
+    # merged ids match each source batch's own materialization
+    np.testing.assert_array_equal(
+        got["event_id"],
+        np.concatenate([b.ensure_event_ids() for b in want]),
+    )
+    # old files gone, merged file recovers
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=1000)
+    assert len(rd.segments) == 1 and len(rd) == 240
+
+
+# ------------------------------------------------------- O(1) id index
+def test_find_row_via_seal_time_index():
+    store = EventStore("t1", rows_per_segment=100)
+    b = _batch(100, tenant="t1")
+    store.add_measurement_batch(b)  # seals lazily (prefix ids)
+    ids = b.ensure_event_ids()
+    assert store.measurements._id_map is None  # not activated yet
+    hit = store.get_event(ids[37])
+    assert hit is not None and hit.id == ids[37]
+    assert store.measurements._prefix_map  # lazy ids resolve via prefix
+    # explicit-id path + index maintained at the NEXT seal
+    b2 = _batch(100, t0=2000, tenant="t1")
+    b2.ensure_event_ids()
+    store.add_measurement_batch(b2)
+    hit2 = store.get_event(b2.event_ids[5])
+    assert hit2 is not None and hit2.value == pytest.approx(
+        float(b2.values[5])
+    )
+    # tail rows (unsealed) still resolve; unknown ids miss
+    store.add_measurement_batch(_batch(10, t0=3000, tenant="t1"))
+    assert store.get_event("nope-123") is None
+
+
+def test_find_row_rejects_hostile_prefix_suffix():
+    store = EventStore("t1", rows_per_segment=50)
+    b = _batch(50)
+    store.add_measurement_batch(b)
+    ids = b.ensure_event_ids()
+    prefix = ids[0][:17]
+    assert store.get_event(prefix + "999999") is None  # row out of span
+    assert store.get_event(prefix + "abc") is None     # non-numeric row
+
+
+# ------------------------------------------------------- score write-back
+def test_write_back_scores_feeds_dedupe_via_overlay():
+    sc = SegmentColumns("t1", rows_per_segment=100)
+    b = _batch(100)  # lazy prefix ids
+    sc.append_batch(b)
+    b2 = _batch(100, t0=5000)
+    b2.ensure_event_ids()  # explicit ids
+    sc.append_batch(b2)
+    assert len(sc.segments) == 2
+    ids = np.concatenate([b.ensure_event_ids(), b2.event_ids])
+    fresh = np.linspace(0, 1, 200, dtype=np.float32)
+    assert sc.write_back_scores(ids, fresh) == 200
+    # the overlay is what every reader sees ...
+    np.testing.assert_allclose(sc.columns()["score"], fresh, rtol=1e-6)
+    # ... including the only_unscored dedupe: nothing left to replay
+    replayed = skipped = 0
+    for sl in sc.scan(only_unscored=True):
+        replayed += sl.n
+        skipped += sl.skipped
+    assert replayed == 0 and skipped == 200
+    # the immutable wire bytes are untouched (encode-once identity) ...
+    raw = Segment.from_bytes(sc.segments[0].encoded)
+    assert np.isnan(raw._cols["score"]).all()
+    # ... and a write-back rebuilds ONLY the cached score column — the
+    # expensive object fan-outs / id materializations stay cached (REST
+    # queries during a replay must not re-pay O(total rows) per request)
+    ev_ref = sc._sealed_cache["event_id"]
+    assert sc.write_back_scores(ids[:1], np.zeros(1, np.float32)) == 1
+    assert sc._sealed_cache is not None
+    assert sc._sealed_cache["event_id"] is ev_ref
+    assert sc.columns()["score"][0] == 0.0
+    sc.write_back_scores(ids[:1], fresh[:1])  # restore for the merge check
+    # ... and compaction re-encodes the overlay durably
+    sc.maintain()
+    assert len(sc.segments) == 1
+    np.testing.assert_allclose(
+        sc.segments[0]._cols["score"], fresh, rtol=1e-6
+    )
+    # unknown/foreign ids are skipped, not an error
+    assert sc.write_back_scores(
+        np.array(["nope-1", "nope-2"], object), np.zeros(2, np.float32)
+    ) == 0
+
+
+def test_maintain_max_units_bounds_reencode_work_per_pass():
+    """The instance tick runs maintain() inline on the event loop: the
+    re-encode budget must bound one pass, with later passes finishing
+    the job (a fully-rescored store durable-izes incrementally)."""
+    sc = SegmentColumns("t1", rows_per_segment=100)
+    for k in range(4):  # four FULL segments, all dirty (2x cap -> pairs)
+        sc.append_batch(_batch(100, t0=1000 + 100 * k))
+    ids = np.concatenate([s.event_ids() for s in sc.segments])
+    sc.write_back_scores(ids, np.linspace(0, 1, 400, dtype=np.float32))
+    acts = sc.maintain(max_units=1)
+    assert acts["merged"] == 2 and acts["rewritten"] == 0
+    assert len(sc.segments) == 3  # one pair merged, budget spent
+    acts = sc.maintain(max_units=1)
+    assert acts["merged"] == 2 and len(sc.segments) == 2
+    # uncapped pass finishes whatever remains
+    acts = sc.maintain()
+    assert all(not s.is_dirty for s in sc.segments)
+
+
+def test_maintain_crash_before_manifest_commit_loses_nothing(
+    tmp_path, monkeypatch
+):
+    """A crash inside maintain() — merged file written, old files about
+    to be replaced, manifest NOT yet committed — must leave the old
+    manifest + files a complete recoverable set: committed files are
+    deleted only AFTER the new manifest commits."""
+    sc = SegmentColumns("t1", directory=tmp_path, rows_per_segment=1000)
+    for k in range(4):
+        sc.append_batch(_batch(40, t0=1000 + 40 * k))
+        sc._seal()
+    old_files = [s.path for s in sc.segments]
+    want = sc.columns()["value"].copy()
+
+    def boom():
+        raise RuntimeError("crash before commit")
+
+    monkeypatch.setattr(sc, "_commit_manifest", boom)
+    with pytest.raises(RuntimeError):
+        sc.maintain()
+    assert all(p.exists() for p in old_files)  # nothing deleted yet
+    rd = SegmentColumns("t1", directory=tmp_path, rows_per_segment=1000)
+    assert len(rd) == 160 and rd.torn_dropped == 0
+    np.testing.assert_array_equal(rd.columns()["value"], want)
+    # the reopened store completes the pass cleanly
+    acts = rd.maintain()
+    assert acts["merged"] == 4 and len(rd.segments) == 1
+    assert not any(p.exists() for p in old_files)
+    rd2 = SegmentColumns("t1", directory=tmp_path, rows_per_segment=1000)
+    np.testing.assert_array_equal(rd2.columns()["value"], want)
+
+
+def test_reads_never_delazy_pending_tail_in_place():
+    """A REST read racing ingest materializes tail ids on COPIES — the
+    pending chunks stay lazy, so the next seal still ships (prefix,
+    count) spans instead of paying a per-row str() loop and pickling
+    the full id list into the segment meta."""
+    sc = SegmentColumns("t1", rows_per_segment=1000)
+    sc.append_batch(_batch(100))
+    assert sc._pending[0]["event_id"] is None  # lazy
+    assert sc.columns()["event_id"].shape == (100,)  # read works...
+    assert sc.find_row("missing-id") is None
+    assert sc._pending[0]["event_id"] is None  # ...chunk STAYS lazy
+    sc._seal()
+    ids, idsegs = sc.segments[0].id_entries()
+    assert ids is None and idsegs  # sealed lazy: spans, not 100 strings
+
+
+def test_write_back_scores_reaches_unsealed_tail():
+    """Replay plans include the tail, so rescored tail rows must teach
+    the only_unscored dedupe too — and seal durable — instead of being
+    silently skipped (double-score on the next job)."""
+    sc = SegmentColumns("t1", rows_per_segment=1000)
+    b1 = _batch(100)                      # pending chunk, lazy ids
+    sc.append_batch(b1)
+    b2 = _batch(50, t0=5000)
+    b2.ensure_event_ids()                 # pending chunk, explicit ids
+    sc.append_batch(b2)
+    carried = np.full((30,), np.nan, np.float32)
+    b3 = _batch(30, t0=9000, scores=carried)  # producer-owned score array
+    sc.append_batch(b3)
+    ids = np.concatenate(
+        [b1.ensure_event_ids(), b2.event_ids, b3.ensure_event_ids()]
+    )
+    fresh = np.linspace(0, 1, 180, dtype=np.float32)
+    assert sc.write_back_scores(ids, fresh) == 180
+    np.testing.assert_allclose(sc.columns()["score"], fresh, rtol=1e-6)
+    replayed = skipped = 0
+    for sl in sc.scan(only_unscored=True):
+        replayed += sl.n
+        skipped += sl.skipped
+    assert replayed == 0 and skipped == 180
+    # copy-on-write: the producer's own array was never mutated
+    assert np.isnan(carried).all()
+    # sealing makes the tail write-back durable
+    sc._seal()
+    np.testing.assert_allclose(
+        sc.segments[0]._cols["score"], fresh, rtol=1e-6
+    )
+
+
+def test_memory_mode_maintain_never_unlinks_foreign_files(tmp_path):
+    """A restored store is memory-mode but its segments are mmap'd
+    CHECKPOINT files — compaction/retention must never delete them (the
+    checkpoint meta still names them for the next restore)."""
+    src = SegmentColumns("t1", directory=tmp_path, rows_per_segment=1000)
+    for k in range(3):
+        src.append_batch(_batch(40, t0=1000 + 40 * k))
+        src._seal()
+    paths = [s.path for s in src.segments]
+    assert all(p.exists() for p in paths)
+    # adopt the files into a DIRECTORY-LESS store (the restore path)
+    mem = SegmentColumns("t1")
+    for p in paths:
+        mem.add_segment(Segment.open(p))
+    acts = mem.maintain()
+    assert acts["merged"] == 3 and len(mem.segments) == 1
+    assert all(p.exists() for p in paths)  # checkpoint files untouched
+    # retention in memory mode: same rule
+    mem.retention_ms = 1.0
+    mem.maintain(now_ms=10_000_000_000.0)
+    assert len(mem.segments) == 0
+    assert all(p.exists() for p in paths)
+
+
+# ------------------------------------------------------------- lint wiring
+def test_check_queues_covers_replay_ring():
+    assert check_queues.lint_queues() == []
+    assert any(
+        rel == "pipeline/replay.py" for (rel, _p) in check_queues.REGISTRY
+    )

@@ -71,6 +71,19 @@ HOT_PATHS: Dict[str, List[str]] = {
         "make_event_ids",
         "encode_batch_wire",
     ],
+    # the storage/replay axis runs at feed-path rates (docs/STORAGE.md):
+    # segment scans and replay staging must move rows as vectorized
+    # column picks, never as per-event Python objects
+    "storage/segstore.py": [
+        "SegmentColumns.append_batch",
+        "SegmentColumns.scan",
+        "slice_columns",
+    ],
+    "pipeline/replay.py": [
+        "_slice_to_batch",
+        "ReplayEngine._scan_loop",
+        "ReplayEngine._pump_loop",
+    ],
 }
 
 _NP_CONVERTERS = {"asarray", "array", "stack", "concatenate", "fromiter"}

@@ -63,6 +63,14 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         # into the bus (where lag is a gauge and drives overload credit)
         "backpressure_counter": "tpu_inference.lane_backpressure",
     },
+    ("pipeline/replay.py", r"_ReplayRing\("): {
+        "queue": "replay intake ring (prepared scan slices between the "
+                 "segment scanner and the publish pump)",
+        "depth_gauge": "replay_ring_depth",
+        # replay never sheds: a throttled pump backpressures the disk
+        # scanner through the ring instead of buffering the store
+        "backpressure_counter": "replay.ring_backpressure",
+    },
     ("pipeline/inference.py", r"_ReapQueue\("): {
         "queue": "deliver reap queues (in-flight flush completions per "
                  "family; bounded by the max_inflight semaphore)",
@@ -79,7 +87,7 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
 
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
-    r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\()"
+    r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\(|= _ReplayRing\()"
 )
 
 
