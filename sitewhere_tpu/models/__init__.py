@@ -44,6 +44,11 @@ class ModelSpec:
     config_cls: type
     init: Callable
     score: Optional[Callable] = None      # scorer contract (windows, n_valid)
+    # fused megabatch contract (models.common; parallel.sharded fused
+    # step): (stacked_params, cfg, windows[S,B,W], n_valid[S,B], k=K)
+    # → f32[S,B,K] via ONE wide einsum per contraction over the stacked
+    # plane. None = family runs the legacy vmap-over-slots path only.
+    score_stacked: Optional[Callable] = None
     loss: Optional[Callable] = None
     forecast: Optional[Callable] = None
     apply: Optional[Callable] = None      # classifier contract (images)
@@ -60,6 +65,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         config_cls=lstm_ad.LstmAdConfig,
         init=lstm_ad.init,
         score=lstm_ad.score,
+        score_stacked=lstm_ad.score_stacked,
         loss=lstm_ad.loss,
         train_step=lstm_ad.train_step,
         flops_per_row=lstm_ad_flops_per_row,
@@ -69,6 +75,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         config_cls=deepar.DeepArConfig,
         init=deepar.init,
         score=deepar.score,
+        score_stacked=deepar.score_stacked,
         loss=deepar.loss,
         forecast=deepar.forecast,
         train_step=deepar.train_step,
@@ -79,6 +86,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         config_cls=transformer.TransformerForecasterConfig,
         init=transformer.init,
         score=transformer.score,
+        score_stacked=transformer.score_stacked,
         loss=transformer.loss,
         forecast=transformer.forecast,
         train_step=transformer.train_step,

@@ -156,6 +156,191 @@ def param_count(params: Params) -> int:
     return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
 
 
+# -- fused megabatch (weight-stacked) scoring ------------------------------
+#
+# The stacked scoring contract (``parallel.sharded`` fused step;
+# docs/PERFORMANCE.md "Fused tenant kernels"): each scorer family exposes
+#
+#     spec.score_stacked(stacked_params, cfg, windows[S, B, W],
+#                        n_valid[S, B], k=K) -> f32[S, B, K]
+#
+# where every param leaf carries a leading stacked-slot dim ``S`` and each
+# time-step contraction runs as ONE wide einsum over the whole [S·B]
+# tenant plane (``sbh,sho->sbo`` — a single batched MXU dot) instead of S
+# independent [B, H] matmuls. ``scores[..., j]`` is the score at window
+# position ``W-K+j`` (j = K-1 ⇔ the newest position == the legacy
+# single-step score). tools/check_fusion.py lints that these entry points
+# actually lower to ≤2 dot_generals per scan step.
+
+PARAM_DTYPES = ("f32", "bf16", "int8")
+
+# Real MAC width of quantized weight matmuls against the bf16 peak the
+# MFU denominator uses (runtime.metrics.PEAK_FLOPS_BF16): the MXU retires
+# int8 MACs at ~2× the bf16 rate, so an int8 MAC counts as HALF a
+# bf16-equivalent FLOP pair — counting it full-width would flatter
+# tpu_mfu_pct{family} for quantized stacks. Activation·activation matmuls
+# (attention QK^T/AV) never quantize and always count full width.
+QUANT_MAC_WIDTH = {"f32": 1.0, "bf16": 1.0, "int8": 0.5}
+
+
+def quant_mac_width(param_dtype: Optional[str]) -> float:
+    return QUANT_MAC_WIDTH.get(param_dtype or "f32", 1.0)
+
+
+def quantize_dense(p: Params, param_dtype: str) -> Params:
+    """One dense param dict → its kernel-side representation.
+
+    - ``f32``: unchanged (the master params serve directly);
+    - ``bf16``: weight cast once at derive time;
+    - ``int8``: symmetric per-output-channel scales over the contraction
+      dim (axis -2) — for stacked ``[S, I, O]`` weights that is per-slot
+      AND per-channel, so one tenant's weight range never clips another's.
+    Biases stay f32 (they add once per row — no MAC savings to chase).
+    """
+    if param_dtype == "f32":
+        return p
+    if param_dtype == "bf16":
+        return {"w": p["w"].astype(jnp.bfloat16), "b": p["b"]}
+    if param_dtype != "int8":
+        raise ValueError(f"param_dtype must be one of {PARAM_DTYPES}")
+    w = p["w"]
+    scale = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, jnp.asarray(1e-12, w.dtype))
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"qw": q, "scale": scale.astype(jnp.float32), "b": p["b"]}
+
+
+def quantize_params(params: Params, param_dtype: str) -> Params:
+    """Derive the kernel-side param tree: every dense ``{"w", "b"}`` node
+    whose weight has a contraction dim (ndim ≥ 2) re-represents per
+    ``quantize_dense``; everything else (layernorm scales, positional
+    embeddings) passes through. Structure-compatible with the master
+    tree, so model code reads weights through ``kernel_weight`` and never
+    branches on the storage format."""
+    if param_dtype == "f32":
+        return params
+
+    def walk(node):
+        if isinstance(node, dict):
+            w = node.get("w")
+            if (
+                w is not None
+                and "b" in node
+                and getattr(w, "ndim", 0) >= 2
+            ):
+                return quantize_dense(node, param_dtype)
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return node
+
+    return walk(params)
+
+
+def kernel_shape(p: Params) -> tuple:
+    """Shape of a dense node's kernel, whatever its storage form
+    (``w`` master / ``qw`` int8)."""
+    arr = p.get("qw")
+    if arr is None:
+        arr = p["w"]
+    return arr.shape
+
+
+def kernel_weight(p: Params, dtype) -> jnp.ndarray:
+    """Read a (possibly quantized) dense kernel at compute dtype. For
+    int8 storage this IS the dequant — an elementwise
+    ``qw.astype(dtype) * scale`` the fused scan steps inline so XLA fuses
+    it against the wide dot (weights live in HBM at 1 byte/element; the
+    dequant rides the VPU while the MXU does the matmul)."""
+    qw = p.get("qw")
+    if qw is not None:
+        return qw.astype(dtype) * p["scale"].astype(dtype)
+    return p["w"].astype(dtype)
+
+
+def stacked_bias(p: Params, x_ndim: int, dtype) -> jnp.ndarray:
+    """Bias ``[S, O]`` broadcast-shaped against a stacked activation of
+    ``x_ndim`` dims (``[S, ..., O]``)."""
+    b = p["b"].astype(dtype)
+    return b.reshape(b.shape[0], *([1] * (x_ndim - 2)), b.shape[-1])
+
+
+def dense_stacked(p: Params, x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Weight-stacked dense: x [S, ..., I] × w [S, I, O] → [S, ..., O] as
+    ONE einsum over the whole stacked plane (the megabatch analog of
+    ``dense``)."""
+    w = kernel_weight(p, dtype)
+    return (
+        jnp.einsum("s...i,sio->s...o", x.astype(dtype), w)
+        + stacked_bias(p, x.ndim, dtype)
+    )
+
+
+def layernorm_stacked(p: Params, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Per-row LN with stacked [S, D] scale/bias — same math (f32
+    reduction over the last dim) as ``layernorm``."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+    return (
+        y * p["scale"].reshape(shape) + p["bias"].reshape(shape)
+    ).astype(x.dtype)
+
+
+def mha_stacked(
+    p: Params,
+    x: jnp.ndarray,          # [S, ..., T, D]
+    heads: int,
+    causal: bool = False,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Weight-stacked multi-head attention — ``attn_core`` already
+    batches over arbitrary leading dims, so only the projections change."""
+    d = x.shape[-1]
+    hd = d // heads
+
+    def split(a):
+        return a.reshape(*a.shape[:-1], heads, hd)
+
+    q = split(dense_stacked(p["wq"], x, dtype))
+    k = split(dense_stacked(p["wk"], x, dtype))
+    v = split(dense_stacked(p["wv"], x, dtype))
+    return dense_stacked(p["wo"], attn_core(q, k, v, causal, dtype), dtype)
+
+
+def transformer_block_stacked(
+    p: Params, x: jnp.ndarray, heads: int, causal: bool = False,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    x = x + mha_stacked(
+        p["attn"], layernorm_stacked(p["ln1"], x), heads, causal=causal,
+        dtype=dtype,
+    )
+    h = layernorm_stacked(p["ln2"], x)
+    return x + dense_stacked(
+        p["mlp"]["fc2"],
+        jax.nn.gelu(dense_stacked(p["mlp"]["fc1"], h, dtype)),
+        dtype,
+    )
+
+
+def kstep_mask(n_valid: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Cold-start mask per K-step score column: position W-K+j had seen
+    ``n_valid - (K-1-j)`` samples when it was the newest — rows below 4
+    samples AT THAT TIME score 0 (same gate the legacy single-step path
+    applies to its one position). Returns bool[..., K] for n_valid[...]."""
+    ages = jnp.arange(k, dtype=jnp.int32)            # j = 0 .. K-1
+    return (n_valid[..., None] - (k - 1 - ages)) >= 4
+
+
+def clamp_fuse_k(k: int, window: int) -> int:
+    """K is bounded by the predictable positions: a length-W window has
+    W-1 one-step-ahead predictions."""
+    return max(1, min(int(k), int(window) - 1))
+
+
 # -- analytic FLOP accounting (device-time / MFU attribution) --------------
 #
 # Each model family declares ``flops_per_row(cfg, window)``: the matmul
@@ -201,28 +386,66 @@ def transformer_block_flops(dim: int, seq: int, mlp_ratio: int = 4) -> float:
     return proj + attn + mlp
 
 
-def lstm_ad_flops_per_row(cfg, window: int) -> float:
-    """lstm_ad.score: LSTM over window-1 steps + per-step head."""
+# The ``k``/``param_dtype`` kwargs describe the FUSED megabatch variant
+# (parallel.sharded fused step): ``k=None`` means the legacy vmap path —
+# per-step head over every position, full-width master weights — so the
+# default call is numerically identical to the pre-fusion accounting.
+# With ``k`` set, the fused kernel runs the same scan but applies its
+# heads only to the last K positions, and quantized weight matmuls count
+# at their real MAC width (``QUANT_MAC_WIDTH`` — int8 at 0.5× against
+# the bf16 peak). This is what keeps ``tpu_flops_total{family}`` /
+# ``tpu_mfu_pct{family}`` honest for K-step and quantized stacks.
+
+def lstm_ad_flops_per_row(
+    cfg, window: int, k: Optional[int] = None, param_dtype: str = "f32",
+) -> float:
+    """lstm_ad.score: LSTM over window-1 steps + head (per-step on the
+    legacy path; last-K-only on the fused path)."""
     t = max(1, int(window) - 1)
-    return lstm_scan_flops(cfg.hidden, t) + dense_flops(cfg.hidden, 1) * t
-
-
-def deepar_flops_per_row(cfg, window: int) -> float:
-    """deepar.score: GRU encode over window-1 steps + per-step
-    (mu, sigma) heads."""
-    t = max(1, int(window) - 1)
-    return gru_scan_flops(cfg.hidden, t) + 2 * dense_flops(cfg.hidden, 1) * t
-
-
-def transformer_flops_per_row(cfg, window: int) -> float:
-    """transformer.score: embed + causal backbone over window-1 tokens +
-    the (mu, raw_sigma) head."""
-    t = max(1, int(window) - 1)
+    wq = quant_mac_width(param_dtype) if k is not None else 1.0
+    head_steps = t if k is None else max(1, min(int(k), t))
     return (
-        dense_flops(1, cfg.dim) * t
-        + cfg.depth * transformer_block_flops(cfg.dim, t)
-        + dense_flops(cfg.dim, 2) * t
+        lstm_scan_flops(cfg.hidden, t)
+        + dense_flops(cfg.hidden, 1) * head_steps
+    ) * wq
+
+
+def deepar_flops_per_row(
+    cfg, window: int, k: Optional[int] = None, param_dtype: str = "f32",
+) -> float:
+    """deepar.score: GRU encode over window-1 steps + (mu, sigma) heads
+    (per-step legacy; last-K-only fused)."""
+    t = max(1, int(window) - 1)
+    wq = quant_mac_width(param_dtype) if k is not None else 1.0
+    head_steps = t if k is None else max(1, min(int(k), t))
+    return (
+        gru_scan_flops(cfg.hidden, t)
+        + 2 * dense_flops(cfg.hidden, 1) * head_steps
+    ) * wq
+
+
+def transformer_flops_per_row(
+    cfg, window: int, k: Optional[int] = None, param_dtype: str = "f32",
+) -> float:
+    """transformer.score: embed + causal backbone over window-1 tokens +
+    the (mu, raw_sigma) head. Quantization scales only the WEIGHT
+    matmuls — the attention QK^T/AV products are activation·activation
+    and run full width regardless of param_dtype."""
+    t = max(1, int(window) - 1)
+    wq = quant_mac_width(param_dtype) if k is not None else 1.0
+    head_steps = t if k is None else max(1, min(int(k), t))
+    attn = cfg.depth * 2 * (2.0 * t * t * cfg.dim)        # QK^T and AV
+    mlp_ratio = 4
+    weight_mm = (
+        dense_flops(1, cfg.dim) * t                        # embed
+        + cfg.depth * (
+            4 * dense_flops(cfg.dim, cfg.dim) * t          # wq/wk/wv/wo
+            + (dense_flops(cfg.dim, mlp_ratio * cfg.dim)
+               + dense_flops(mlp_ratio * cfg.dim, cfg.dim)) * t
+        )
+        + dense_flops(cfg.dim, 2) * head_steps             # (mu, sigma)
     )
+    return weight_mm * wq + attn
 
 
 def vit_flops_per_image(cfg, window: int = 0) -> float:

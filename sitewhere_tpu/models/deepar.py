@@ -22,7 +22,11 @@ import jax.numpy as jnp
 from sitewhere_tpu.models.common import (
     Params,
     carry_zeros,
+    clamp_fuse_k,
     dense_init,
+    kernel_shape,
+    kernel_weight,
+    kstep_mask,
     normalize_windows,
 )
 
@@ -88,6 +92,74 @@ def _encode(params: Params, normed: jnp.ndarray, dtype):
     h0 = carry_zeros((b, params["wh"]["w"].shape[0]), normed, dtype)
     h_last, (mus, sigmas) = jax.lax.scan(step, h0, normed.T.astype(dtype))
     return h_last, mus.T, sigmas.T  # [B, T]
+
+
+def _stacked_gru_scan(params: Params, xs: jnp.ndarray, dtype) -> jnp.ndarray:
+    """xs: [S, B, T] normalized → per-step hidden states [T, S, B, H].
+
+    Fused megabatch GRU: one wide ``sbh,sho->sbo`` einsum per step over
+    the whole stacked plane; the in_dim-1 input projection is a
+    broadcast outer product (zero dot_generals) — the scan body lowers
+    to a single dot_general (tools/check_fusion.py)."""
+    s, b, t = xs.shape
+    h_dim = kernel_shape(params["wh"])[-2]
+
+    def step(h, x_t):  # x_t [S, B]
+        wx = kernel_weight(params["wx"], dtype)    # [S, 1, 3H]
+        wh = kernel_weight(params["wh"], dtype)    # [S, H, 3H]
+        bx = params["wx"]["b"].astype(dtype)       # [S, 3H]
+        bh = params["wh"]["b"].astype(dtype)
+        gx = x_t[:, :, None] * wx[:, 0][:, None, :] + bx[:, None, :]
+        gh = jnp.einsum("sbh,sho->sbo", h, wh) + bh[:, None, :]
+        rx, zx, nx = gx[..., :h_dim], gx[..., h_dim:2 * h_dim], gx[..., 2 * h_dim:]
+        rh, zh, nh = gh[..., :h_dim], gh[..., h_dim:2 * h_dim], gh[..., 2 * h_dim:]
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        h2 = (1 - z) * n + z * h
+        return h2, h2
+
+    zc = (xs[..., :1] * 0).astype(dtype)           # vma-typed zero carry
+    h0 = jnp.zeros((s, b, h_dim), dtype) + zc
+    _, hs = jax.lax.scan(step, h0, jnp.moveaxis(xs, -1, 0).astype(dtype))
+    return hs  # [T, S, B, H]
+
+
+def score_stacked(
+    params: Params,
+    cfg: DeepArConfig,
+    windows: jnp.ndarray,   # f32[S, B, W]
+    n_valid: jnp.ndarray,   # i32[S, B]
+    k: int = 1,
+) -> jnp.ndarray:
+    """Fused megabatch NLL scoring (``score_stacked`` contract): returns
+    f32[S, B, K] — ``[..., j]`` is the Gaussian NLL at window position
+    W-K+j; j = K-1 matches the legacy ``score``. One GRU scan serves all
+    K positions; (mu, sigma) heads apply only to the last K hiddens."""
+    dtype = cfg.compute_dtype
+    k = clamp_fuse_k(k, windows.shape[-1])
+    normed, _, _ = normalize_windows(windows)
+    hs = _stacked_gru_scan(params, normed[..., :-1], dtype)
+    hk = hs[-k:]                                           # [K, S, B, H]
+    w_mu = kernel_weight(params["mu"], dtype)              # [S, H, 1]
+    w_sg = kernel_weight(params["sigma"], dtype)
+    mus = (
+        jnp.einsum("ksbh,sho->ksbo", hk, w_mu)[..., 0]
+        + params["mu"]["b"].astype(dtype)[..., 0][None, :, None]
+    ).astype(jnp.float32)                                  # [K, S, B]
+    raw = (
+        jnp.einsum("ksbh,sho->ksbo", hk, w_sg)[..., 0]
+        + params["sigma"]["b"].astype(dtype)[..., 0][None, :, None]
+    ).astype(jnp.float32)
+    sigmas = jax.nn.softplus(raw) + 1e-4
+    targets = jnp.moveaxis(normed[..., -k:], -1, 0)        # [K, S, B]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigmas**2) + (
+        targets - mus
+    ) ** 2 / (2 * sigmas**2)
+    scores = jnp.moveaxis(nll, 0, -1)                      # [S, B, K]
+    return jnp.where(
+        kstep_mask(n_valid, k), scores, 0.0
+    ).astype(jnp.float32)
 
 
 def loss(params: Params, cfg: DeepArConfig, windows: jnp.ndarray) -> jnp.ndarray:

@@ -27,7 +27,11 @@ import jax.numpy as jnp
 from sitewhere_tpu.models.common import (
     Params,
     carry_zeros,
+    clamp_fuse_k,
     dense_init,
+    kernel_shape,
+    kernel_weight,
+    kstep_mask,
     normalize_windows,
 )
 
@@ -108,6 +112,84 @@ def score(
     preds = predict_next(params, cfg, windows)
     err = jnp.abs(normed[:, -1] - preds[:, -1])
     return jnp.where(n_valid >= 4, err, 0.0).astype(jnp.float32)
+
+
+def _stacked_lstm_scan(params: Params, xs: jnp.ndarray, dtype) -> jnp.ndarray:
+    """xs: [S, B, T] normalized values → hidden states [T, S, B, H].
+
+    THE fused megabatch kernel: the stacked-slot axis rides INSIDE the
+    contraction, so each scan step runs ONE wide einsum over the whole
+    [S·B] tenant plane instead of S independent [B, H] matmuls. The
+    input projection has in_dim = 1, so it collapses to a broadcast
+    outer product on the VPU — the scan body lowers to a single
+    dot_general (tools/check_fusion.py asserts this stays true)."""
+    s, b, t = xs.shape
+    h_dim = kernel_shape(params["wh"])[-2]
+
+    def step(carry, x_t):  # x_t [S, B]
+        h, c = carry
+        # dequant (int8 param_dtype) fuses here: kernel_weight inlines
+        # qw.astype * scale against the dot; loop-invariant, XLA hoists
+        wx = kernel_weight(params["wx"], dtype)    # [S, 1, 4H]
+        wh = kernel_weight(params["wh"], dtype)    # [S, H, 4H]
+        bias = (
+            params["wx"]["b"] + params["wh"]["b"]
+        ).astype(dtype)                            # [S, 4H]
+        gates = (
+            x_t[:, :, None] * wx[:, 0][:, None, :]
+            + jnp.einsum("sbh,sho->sbo", h, wh)
+            + bias[:, None, :]
+        )  # [S, B, 4H]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    # vma-typed zero carry (the carry_zeros trick for a [S, B, H] carry):
+    # + 0·xs[..., :1] transfers the data's varying-axis type so the scan
+    # accepts a data-derived carry under shard_map without naming axes
+    z = (xs[..., :1] * 0).astype(dtype)                    # [S, B, 1]
+    zero = jnp.zeros((s, b, h_dim), dtype) + z
+    _, hs = jax.lax.scan(
+        step, (zero, zero), jnp.moveaxis(xs, -1, 0).astype(dtype)
+    )
+    return hs  # [T, S, B, H]
+
+
+def score_stacked(
+    params: Params,
+    cfg: LstmAdConfig,
+    windows: jnp.ndarray,   # f32[S, B, W] — S stacked tenant slots
+    n_valid: jnp.ndarray,   # i32[S, B]
+    k: int = 1,
+) -> jnp.ndarray:
+    """Fused megabatch scoring over a stacked tenant plane (the
+    ``score_stacked`` contract — models.common).
+
+    Returns f32[S, B, K]: ``[..., j]`` is the anomaly score at window
+    position W-K+j (j = K-1 ⇔ the newest sample == the legacy
+    ``score``). All K scores come from the SAME scan — the per-flush
+    h2d'd plane amortizes K timesteps of output. Normalization is over
+    the CURRENT full window (per-position re-normalization would cost a
+    scan per position); per-position cold-start masking still applies.
+    """
+    dtype = cfg.compute_dtype
+    k = clamp_fuse_k(k, windows.shape[-1])
+    normed, _, _ = normalize_windows(windows)              # f32[S, B, W]
+    hs = _stacked_lstm_scan(params, normed[..., :-1], dtype)
+    hk = hs[-k:]                                           # [K, S, B, H]
+    w_head = kernel_weight(params["head"], dtype)          # [S, H, 1]
+    b_head = params["head"]["b"].astype(dtype)             # [S, 1]
+    preds = (
+        jnp.einsum("ksbh,sho->ksbo", hk, w_head)[..., 0]
+        + b_head[..., 0][None, :, None]
+    ).astype(jnp.float32)                                  # [K, S, B]
+    targets = jnp.moveaxis(normed[..., -k:], -1, 0)        # [K, S, B]
+    err = jnp.abs(targets - preds)
+    scores = jnp.moveaxis(err, 0, -1)                      # [S, B, K]
+    return jnp.where(
+        kstep_mask(n_valid, k), scores, 0.0
+    ).astype(jnp.float32)
 
 
 def loss(params: Params, cfg: LstmAdConfig, windows: jnp.ndarray) -> jnp.ndarray:

@@ -24,13 +24,18 @@ import jax.numpy as jnp
 
 from sitewhere_tpu.models.common import (
     Params,
+    clamp_fuse_k,
     dense,
     dense_init,
+    dense_stacked,
+    kstep_mask,
     layernorm,
     layernorm_init,
+    layernorm_stacked,
     normalize_windows,
     transformer_block,
     transformer_block_init,
+    transformer_block_stacked,
 )
 
 
@@ -219,6 +224,49 @@ def forecast(
     samples = samples.T * sigma_n + mu_n   # [B, H] raw
     means = means.T * sigma_n + mu_n
     return samples.astype(jnp.float32), means.astype(jnp.float32)
+
+
+def _backbone_stacked(params: Params, normed: jnp.ndarray, cfg) -> jnp.ndarray:
+    """normed: f32[S, B, T] → features [S, B, T, D] with weight-stacked
+    params (leading S on every leaf). Same math as ``_backbone``; every
+    projection is one einsum over the whole stacked plane."""
+    dtype = cfg.compute_dtype
+    t = normed.shape[-1]
+    x = dense_stacked(params["embed"], normed[..., None].astype(dtype), dtype)
+    # pos is a raw [S, context, D] table (no dense dict — never quantized)
+    x = x + params["pos"][:, :t].astype(dtype)[:, None]
+    for blk in params["blocks"]:
+        x = transformer_block_stacked(blk, x, cfg.heads, causal=True, dtype=dtype)
+    return layernorm_stacked(params["ln_f"], x)
+
+
+def score_stacked(
+    params: Params,
+    cfg: TransformerForecasterConfig,
+    windows: jnp.ndarray,   # f32[S, B, W]
+    n_valid: jnp.ndarray,   # i32[S, B]
+    k: int = 1,
+) -> jnp.ndarray:
+    """Fused megabatch scoring (``score_stacked`` contract): last-K-step
+    Gaussian NLL per row, f32[S, B, K] — j = K-1 matches the legacy
+    ``score``. The causal backbone computes features for every position
+    anyway; K-step scoring reads K head outputs from one forward pass."""
+    dtype = cfg.compute_dtype
+    k = clamp_fuse_k(k, windows.shape[-1])
+    normed, _, _ = normalize_windows(windows)
+    feats = _backbone_stacked(params, normed[..., :-1], cfg)   # [S,B,T,D]
+    out = dense_stacked(params["head"], feats[..., -k:, :], dtype).astype(
+        jnp.float32
+    )                                                          # [S,B,K,2]
+    mu = out[..., 0]
+    sigma = jax.nn.softplus(out[..., 1]) + 1e-4
+    target = normed[..., -k:]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigma**2) + (
+        target - mu
+    ) ** 2 / (2 * sigma**2)
+    return jnp.where(
+        kstep_mask(n_valid, k), nll, 0.0
+    ).astype(jnp.float32)
 
 
 def score(params, cfg: TransformerForecasterConfig, windows, n_valid):

@@ -91,16 +91,19 @@ def _segment_ranks(stream_ids: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return ranks_sorted[inv].astype(jnp.int32), totals_sorted[inv].astype(jnp.int32)
 
 
-def update_windows(
+def _apply_update(
     state: WindowState,
-    stream_ids: jnp.ndarray,  # i32[B]
-    values: jnp.ndarray,      # f32[B]
-    valid: jnp.ndarray,       # bool[B]
+    stream_ids: jnp.ndarray,
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+    ranks: jnp.ndarray,
+    totals: jnp.ndarray,
 ) -> WindowState:
-    """Append a micro-batch into the ring buffers (order-preserving within
-    a stream). Pure, jit-friendly, static-shaped."""
+    """Scatter a ranked micro-batch into the rings (the body of
+    ``update_windows``, split out so the K-step fused path can reuse one
+    ``_segment_ranks`` sort for both the scatter and the per-row
+    timestep resolution)."""
     s, w = state.values.shape
-    ranks, totals = _segment_ranks(jnp.where(valid, stream_ids, -1))
     write_slot = (state.pos[stream_ids] + ranks) % w
     flat_idx = stream_ids * w + write_slot
     # invalid rows → out-of-range index → dropped by scatter mode='drop'.
@@ -123,6 +126,18 @@ def update_windows(
         pos=(state.pos + per_stream) % w,
         count=state.count + per_stream,
     )
+
+
+def update_windows(
+    state: WindowState,
+    stream_ids: jnp.ndarray,  # i32[B]
+    values: jnp.ndarray,      # f32[B]
+    valid: jnp.ndarray,       # bool[B]
+) -> WindowState:
+    """Append a micro-batch into the ring buffers (order-preserving within
+    a stream). Pure, jit-friendly, static-shaped."""
+    ranks, totals = _segment_ranks(jnp.where(valid, stream_ids, -1))
+    return _apply_update(state, stream_ids, values, valid, ranks, totals)
 
 
 def gather_windows(
@@ -163,3 +178,23 @@ def update_and_gather(
     new_state = update_windows(state, stream_ids, values, valid)
     windows, n = gather_windows(new_state, stream_ids)
     return new_state, windows, n
+
+
+def update_gather_ranked(
+    state: WindowState,
+    stream_ids: jnp.ndarray,
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> Tuple[WindowState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``update_and_gather`` plus per-row recency: also returns ``later``
+    i32[B] — how many valid same-stream rows come AFTER row b in this
+    batch (0 = the stream's newest sample). A K-step fused scorer uses
+    it to resolve each row at its OWN window position: a row with
+    ``later = j`` sits at position W-1-j of the post-batch window, so it
+    takes the K-step score at index K-1-j instead of the newest one.
+    One ``_segment_ranks`` sort serves both the ring scatter and this."""
+    ranks, totals = _segment_ranks(jnp.where(valid, stream_ids, -1))
+    new_state = _apply_update(state, stream_ids, values, valid, ranks, totals)
+    windows, n = gather_windows(new_state, stream_ids)
+    later = jnp.where(valid, totals - 1 - ranks, 0).astype(jnp.int32)
+    return new_state, windows, n, later

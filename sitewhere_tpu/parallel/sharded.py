@@ -32,15 +32,28 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from sitewhere_tpu.models import ModelSpec
+from sitewhere_tpu.models.common import (
+    PARAM_DTYPES,
+    clamp_fuse_k,
+    quantize_params,
+)
 from sitewhere_tpu.ops.windows import (
     WindowState,
     gather_windows,
     init_window_state,
     update_and_gather,
+    update_gather_ranked,
 )
 from sitewhere_tpu.parallel.mesh import AXIS_DATA, AXIS_TENANT, MeshManager
 
 Params = Any
+
+# Fused megabatch kernels kill switch (mirrors core.batch.WIRE_CODEC_ENABLED):
+# flip to False BEFORE scorer construction to build the legacy
+# vmap-over-slots step — bit for bit the pre-fusion path (fuse_k/param_dtype
+# are ignored there: single-step scores, full-width f32 master weights).
+# The rollback knob for a numerics incident in production.
+FUSED_STEP_ENABLED = True
 
 
 def stack_params(params_list: List[Params]) -> Params:
@@ -89,12 +102,35 @@ class ShardedScorer:
         window: int = 32,
         seed: int = 0,
         wire_dtype: str = "f32",
+        fuse_k: int = 1,
+        param_dtype: str = "f32",
     ) -> None:
         if spec.score is None:
             raise ValueError(f"model '{spec.name}' has no scorer contract")
         self.mm = mm
         self.spec = spec
         self.cfg = cfg
+        # -- fused megabatch kernels (docs/PERFORMANCE.md "Fused tenant
+        # kernels"): slot axis folded into the gate contractions via the
+        # family's score_stacked entry point. Captured at BUILD time so
+        # FUSED_STEP_ENABLED=False reconstructs the legacy path exactly.
+        if param_dtype not in PARAM_DTYPES:
+            raise ValueError(
+                f"param_dtype must be one of {PARAM_DTYPES}, got "
+                f"{param_dtype!r}"
+            )
+        if int(fuse_k) < 1:
+            raise ValueError(f"fuse_k must be >= 1, got {fuse_k}")
+        self.fused = bool(FUSED_STEP_ENABLED and spec.score_stacked is not None)
+        self.fuse_k = int(fuse_k)
+        # effective knobs: the legacy path ignores both (pre-fusion
+        # semantics — newest-position scores off f32 master weights)
+        self.k_steps = clamp_fuse_k(self.fuse_k, window) if self.fused else 1
+        self.requested_param_dtype = param_dtype  # family-pin conflict checks
+        self.param_dtype = param_dtype if self.fused else "f32"
+        self._kernel_params = None   # quantized sidecar (lazy; see below)
+        self._kernel_dirty = True
+        self._quantize_jit = None
         self.slots_per_shard = slots_per_shard
         self.n_slots = mm.n_tenant_shards * slots_per_shard
         if max_streams % mm.n_data_shards:
@@ -166,6 +202,34 @@ class ShardedScorer:
         # previous flush's dispatch
         self._wire_sharding = mm.sharding(AXIS_TENANT, AXIS_DATA)
 
+    # -- fused kernel param view -----------------------------------------
+    def _invalidate_kernel(self) -> None:
+        """Mark the quantized sidecar stale — call after ANY mutation of
+        ``self.params`` (activate/set_slot/reset/train/rebuild) so the
+        next flush scores against the tenant's current weights."""
+        self._kernel_dirty = True
+
+    def kernel_params(self) -> Params:
+        """The param tree the compiled step consumes. ``f32`` (or the
+        legacy path) reads the master stack directly; ``bf16``/``int8``
+        read a lazily re-derived quantized sidecar (per-slot per-channel
+        scales — models.common.quantize_params). Deriving is one jitted
+        elementwise tree-map dispatched asynchronously, so a post-train
+        refresh rides the device queue like any other dispatch; the
+        master f32 params stay the single source of truth for training,
+        checkpointing, and slot swaps."""
+        if not self.fused or self.param_dtype == "f32":
+            return self.params
+        if self._kernel_dirty or self._kernel_params is None:
+            if self._quantize_jit is None:
+                pd = self.param_dtype
+                self._quantize_jit = jax.jit(
+                    lambda p: quantize_params(p, pd)
+                )
+            self._kernel_params = self._quantize_jit(self.params)
+            self._kernel_dirty = False
+        return self._kernel_params
+
     # -- h2d staging (double-buffered feed path) -------------------------
     def stage_inputs(self, stream_ids, values, counts):
         """Asynchronously stage one flush's wire buffers onto the step's
@@ -193,6 +257,14 @@ class ShardedScorer:
         fn = getattr(self.spec, "flops_per_row", None)
         if fn is None:
             return 0.0
+        if self.fused:
+            # the fused kernel's honest count: heads apply to the last
+            # k_steps positions only, and quantized weight matmuls count
+            # at their real MAC width (models.common.QUANT_MAC_WIDTH)
+            return float(fn(
+                self.cfg, self.window,
+                k=self.k_steps, param_dtype=self.param_dtype,
+            ))
         return float(fn(self.cfg, self.window))
 
     def flops_per_flush(self, b_lane: int) -> float:
@@ -309,6 +381,7 @@ class ShardedScorer:
         """
         mesh = self.mm.mesh
         spec, cfg = self.spec, self.cfg
+        fused, k_steps = self.fused, self.k_steps
         score_dtype = (
             {"f32": jnp.float32, "bf16": jnp.bfloat16, "f16": jnp.float16}[
                 self.wire_dtype
@@ -321,18 +394,48 @@ class ShardedScorer:
             # local shapes: params [T_loc, ...], state [T_loc, S_loc, W],
             # ids/vals [T_loc, B_loc]; validity is bool[T_loc, B_loc]
             # (mask mode) or i32[T_loc, 1] lane counts (counts mode)
-            def one(p, st, act, i, v, m_or_c):
+            if not fused:
+                def one(p, st, act, i, v, m_or_c):
+                    if counts_mode:
+                        m = jnp.arange(i.shape[0], dtype=jnp.int32) < m_or_c[0]
+                    else:
+                        m = m_or_c
+                    i = i.astype(jnp.int32)
+                    v = v.astype(jnp.float32)
+                    st2, w, n = update_and_gather(st, i, v, m)
+                    s = spec.score(p, cfg, w, n)
+                    return st2, jnp.where(act & m, s, 0.0).astype(score_dtype)
+
+                return jax.vmap(one)(params, state, active, ids, vals, validity)
+
+            # fused megabatch path: the window scatter/gather (memory
+            # ops, no matmuls) stays vmapped per slot, but scoring runs
+            # ONE weight-stacked kernel over the whole [T_loc, B_loc]
+            # tenant plane (spec.score_stacked — a single wide einsum
+            # per gate contraction instead of T_loc small matmuls)
+            def upd(st, i, v, m_or_c):
                 if counts_mode:
                     m = jnp.arange(i.shape[0], dtype=jnp.int32) < m_or_c[0]
                 else:
                     m = m_or_c
                 i = i.astype(jnp.int32)
                 v = v.astype(jnp.float32)
-                st2, w, n = update_and_gather(st, i, v, m)
-                s = spec.score(p, cfg, w, n)
-                return st2, jnp.where(act & m, s, 0.0).astype(score_dtype)
+                st2, w, n, later = update_gather_ranked(st, i, v, m)
+                return st2, w, n, later, m
 
-            return jax.vmap(one)(params, state, active, ids, vals, validity)
+            st2, w, n, later, m = jax.vmap(upd)(state, ids, vals, validity)
+            sk = spec.score_stacked(params, cfg, w, n, k=k_steps)
+            if k_steps > 1:
+                # per-row timestep resolution: a row with ``later`` valid
+                # same-stream rows after it in this flush sits at window
+                # position W-1-later, i.e. K-step column K-1-later; rows
+                # older than the K window take the oldest column
+                idx = jnp.clip(k_steps - 1 - later, 0, k_steps - 1)
+                s = jnp.take_along_axis(sk, idx[..., None], axis=-1)[..., 0]
+            else:
+                s = sk[..., 0]
+            s = jnp.where(active[:, None] & m, s, 0.0).astype(score_dtype)
+            return st2, s
 
         smapped = shard_map(
             local_step,
@@ -399,7 +502,8 @@ class ShardedScorer:
             self.fault_steps -= 1
             raise RuntimeError("injected scorer fault (chaos)")
         self.state, scores = self._step(
-            self.params, self.state, self.active, stream_ids, values, valid
+            self.kernel_params(), self.state, self.active,
+            stream_ids, values, valid,
         )
         return scores
 
@@ -417,7 +521,8 @@ class ShardedScorer:
             self.fault_steps -= 1
             raise RuntimeError("injected scorer fault (chaos)")
         self.state, scores = self._step_counts(
-            self.params, self.state, self.active, stream_ids, values, counts
+            self.kernel_params(), self.state, self.active,
+            stream_ids, values, counts,
         )
         return scores
 
@@ -433,6 +538,7 @@ class ShardedScorer:
             self.params = jax.jit(set_slot, static_argnums=1, donate_argnums=0)(
                 self.params, global_slot, params
             )
+            self._invalidate_kernel()
         self.active = self.active.at[global_slot].set(True)
         self.train_mask = self.train_mask.at[global_slot].set(trainable)
         if lr is not None:
@@ -449,6 +555,7 @@ class ShardedScorer:
         self.deactivate(global_slot)
         self.slot_lr = self.slot_lr.at[global_slot].set(1.0)
         self.params = set_slot(self.params, global_slot, self._base_params)
+        self._invalidate_kernel()
         self.state = WindowState(
             values=self.state.values.at[global_slot].set(0.0),
             pos=self.state.pos.at[global_slot].set(0),
@@ -517,6 +624,9 @@ class ShardedScorer:
         )
         self._step = self._build_step()
         self._step_counts = self._build_step(counts_mode=True)
+        self._kernel_params = None   # may reference dead buffers
+        self._kernel_dirty = True
+        self._quantize_jit = None
         self._gather = None  # fresh jit cache for the result-path gather
         self._wire_sharding = self.mm.sharding(AXIS_TENANT, AXIS_DATA)
         if getattr(self, "_optimizer", None) is not None:
@@ -638,4 +748,7 @@ class ShardedScorer:
             self.state.values, self.state.pos, self.state.count,
             mask, self.slot_lr,
         )
+        # live weights changed: the next flush's fused step must score
+        # against a re-quantized sidecar (hot-swap between flushes)
+        self._invalidate_kernel()
         return losses

@@ -606,6 +606,35 @@ class TpuInferenceService(MultitenantService):
                 ),
             )
             self.metrics.counter("tpu_inference.wire_dtype_conflicts").inc()
+        from sitewhere_tpu.models.common import clamp_fuse_k
+
+        # compare CLAMPED asks (fuse_k saturates at window-1): two
+        # tenants whose requests compile to the identical kernel must
+        # not be reported as a conflict
+        _w = getattr(scorer, "window", cfg.microbatch.window) or 1
+        if scorer is not None and (
+            clamp_fuse_k(getattr(scorer, "fuse_k", 1), _w)
+            != clamp_fuse_k(getattr(cfg, "fuse_k", 1), _w)
+            or getattr(scorer, "requested_param_dtype", "f32")
+            != getattr(cfg, "param_dtype", "f32")
+        ):
+            # like wire_dtype, the fused-kernel knobs are a property of
+            # the FAMILY stack (one compiled step per family) — a later
+            # tenant asking for different ones would silently score at
+            # the stack's settings, so surface it
+            self._record_error(
+                "fused-knobs",
+                ValueError(
+                    f"tenant '{cfg.tenant}' asked fuse_k="
+                    f"{getattr(cfg, 'fuse_k', 1)}/param_dtype="
+                    f"'{getattr(cfg, 'param_dtype', 'f32')}' but family "
+                    f"'{family}' runs fuse_k={getattr(scorer, 'fuse_k', 1)}"
+                    f"/param_dtype="
+                    f"'{getattr(scorer, 'requested_param_dtype', 'f32')}' "
+                    f"(first tenant pinned them)"
+                ),
+            )
+            self.metrics.counter("tpu_inference.fused_knob_conflicts").inc()
         if scorer is None:
             spec = get_model(family)
             mcfg = make_config(family, {
@@ -619,6 +648,8 @@ class TpuInferenceService(MultitenantService):
                 max_streams=cfg.max_streams,
                 window=cfg.microbatch.window,
                 wire_dtype=cfg.wire_dtype,
+                fuse_k=getattr(cfg, "fuse_k", 1),
+                param_dtype=getattr(cfg, "param_dtype", "f32"),
             )
             self.scorers[family] = scorer
             self._lanes[family] = {}
@@ -1169,6 +1200,11 @@ class TpuInferenceService(MultitenantService):
                     dispatch_s=round(dispatch_s, 6),
                     h2d_overlapped=bool(overlapped),
                     compiled=compiling,
+                    # kernel variant attribution: which fused-step shape
+                    # produced this flush's timings (incident snapshots
+                    # must name the variant, not just the family)
+                    k_steps=getattr(scorer, "k_steps", 1),
+                    param_dtype=getattr(scorer, "param_dtype", "f32"),
                     trace_id=self._flush_trace_id(seqs_cat),
                     status="inflight",
                 )
@@ -1235,6 +1271,8 @@ class TpuInferenceService(MultitenantService):
                             if dispatch_s is not None else None
                         ),
                         compiled=compiling,
+                        k_steps=getattr(scorer, "k_steps", 1),
+                        param_dtype=getattr(scorer, "param_dtype", "f32"),
                         trace_id=self._flush_trace_id(seqs_cat),
                         status="error", error=repr(exc),
                     )

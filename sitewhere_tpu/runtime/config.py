@@ -168,6 +168,21 @@ class TenantEngineConfig:
     # "f16"): bf16 halves transfer bytes at ~3 significant digits — the
     # right trade for anomaly scoring over a bandwidth-bound link
     wire_dtype: str = "f32"
+    # fused megabatch kernel knobs (parallel.sharded; docs/PERFORMANCE.md
+    # "Fused tenant kernels"). Like wire_dtype, the FIRST tenant of a
+    # model family pins them for the whole stack (conflicts surface via
+    # tpu_inference.fused_knob_conflicts). Both are no-ops while the
+    # FUSED_STEP_ENABLED kill switch is off.
+    #   fuse_k: score the last K window positions per flush in ONE scan —
+    #   burst rows of a stream resolve at their own timestep instead of
+    #   all taking the newest score (rows deeper than K clamp to the
+    #   oldest of the K columns — size K >= expected burst depth), and
+    #   each h2d'd plane amortizes K timesteps of output
+    fuse_k: int = 1
+    #   param_dtype: stacked weight precision "f32" | "bf16" | "int8"
+    #   (int8 = per-slot per-channel scales, dequant fused in the scan
+    #   step — see docs/PERFORMANCE.md for when int8 is safe)
+    param_dtype: str = "f32"
     # streaming-media classification leg (chunks → ViT → events); tiny
     # uses the test-sized ViT so CI exercises the full flow cheaply
     media_pipeline: bool = False

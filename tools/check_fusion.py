@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Fused-kernel lowering lint (jaxpr).
+
+The fused megabatch contract (docs/PERFORMANCE.md "Fused tenant
+kernels") says each registered ``score_stacked`` entry point folds the
+stacked-slot axis INSIDE its contractions: one wide einsum over the
+whole [S·B] tenant plane per gate, never S independent per-slot matmuls.
+That invariant is easy to lose silently — a refactor that maps a Python
+loop (or a per-slot ``vmap`` of the scalar model) over the stack still
+produces correct numbers while resurrecting the exact kernel shape this
+PR removed. This lint keeps it structural:
+
+- **scan-body dot budget**: every ``lax.scan`` in the traced jaxpr of a
+  registered step fn must contain ≤ ``MAX_DOTS_PER_SCAN_STEP`` (2)
+  ``dot_general`` equations — the fused LSTM/GRU steps lower to ONE
+  (the in_dim-1 input projection is a broadcast product, not a dot; the
+  budget of 2 leaves room for a real input matmul);
+- **no degenerate contractions in scan bodies**: a scan-body
+  ``dot_general`` whose contracting dimension has size 1 is an outer
+  product wearing a matmul costume — a full MXU pass at 1/256
+  utilization per step. This is also what catches the SUBTLE per-slot
+  resurrection: ``vmap``-of-the-scalar-model batches its per-slot dots
+  into single eqns (so the count checks pass), but it drags the
+  ``[B, 1]×[1, 4H]`` input projection back in as a batched size-1
+  contraction, which this rule flags;
+- **slot-count invariance**: the TOTAL ``dot_general`` count must be
+  identical when traced at S=2 and S=4 stacked slots. Any per-slot
+  Python loop doubles it; a single batched einsum doesn't.
+
+An entry point may opt out with a ``# fusion: ok`` comment anywhere in
+its source (e.g. a family whose math legitimately needs per-step
+multi-dot structure). A registered family that disappeared — or lost
+its ``score_stacked`` — is itself a finding: stale registries rot lints.
+
+Used two ways, exactly like ``check_hotpath.py``: standalone
+(``python tools/check_fusion.py`` → exit 1 on findings) and imported by
+the tier-1 suite (``lint_fusion()`` in tests/test_fused_step.py).
+Tracing is shape-only (``jax.make_jaxpr``): no mesh, no device work.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+# standalone invocation (python tools/check_fusion.py) needs the repo
+# root importable; harmless when imported by the tier-1 suite
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+MAX_DOTS_PER_SCAN_STEP = 2
+
+# family → config overrides small enough to trace instantly; every entry
+# must exist in MODEL_REGISTRY with a score_stacked contract
+REGISTRY: Dict[str, dict] = {
+    "lstm_ad": {"window": 8, "hidden": 8},
+    "deepar": {"hidden": 8},
+    "transformer": {"context": 8, "dim": 16, "depth": 1, "heads": 2},
+}
+
+_W, _B, _K = 8, 4, 2  # traced window/batch/K-step shape
+
+
+def _subjaxprs(jaxpr):
+    """All jaxprs reachable from ``jaxpr``'s eqn params (pjit bodies,
+    custom_jvp calls, scan bodies, ...)."""
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for j in _as_jaxprs(v):
+                yield eqn, j
+
+
+def _as_jaxprs(v):
+    out = []
+    if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # ClosedJaxpr
+        out.append(v.jaxpr)
+    elif hasattr(v, "eqns"):                              # raw Jaxpr
+        out.append(v)
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            out.extend(_as_jaxprs(item))
+    return out
+
+
+def _count_dots(jaxpr) -> int:
+    """Total dot_general equations in ``jaxpr``, recursing into nested
+    call/scan bodies."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            n += 1
+    for _eqn, sub in _subjaxprs(jaxpr):
+        n += _count_dots(sub)
+    return n
+
+
+def _degenerate_contractions(jaxpr) -> int:
+    """dot_general eqns in ``jaxpr`` (recursing into nested call
+    bodies) whose contracting dims include a size-1 axis — the
+    outer-product-as-matmul shape."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            (lc, rc), _batch = eqn.params["dimension_numbers"]
+            lhs, rhs = eqn.invars[0].aval.shape, eqn.invars[1].aval.shape
+            sizes = [lhs[d] for d in lc] + [rhs[d] for d in rc]
+            if sizes and min(sizes) <= 1:
+                n += 1
+    for _eqn, sub in _subjaxprs(jaxpr):
+        n += _degenerate_contractions(sub)
+    return n
+
+
+def _scan_bodies(jaxpr, out: Optional[list] = None) -> list:
+    """All ``lax.scan`` body jaxprs reachable from ``jaxpr``."""
+    if out is None:
+        out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            out.extend(_as_jaxprs(eqn.params["jaxpr"]))
+        else:
+            for sub in _as_jaxprs_from_eqn(eqn):
+                _scan_bodies(sub, out)
+    return out
+
+
+def _as_jaxprs_from_eqn(eqn):
+    subs = []
+    for v in eqn.params.values():
+        subs.extend(_as_jaxprs(v))
+    return subs
+
+
+def _opted_out(fn: Callable) -> bool:
+    try:
+        return "# fusion: ok" in inspect.getsource(fn)
+    except (OSError, TypeError):
+        return False
+
+
+def _trace_counts(
+    family: str, overrides: dict, n_slots: int
+) -> Tuple[int, List[Tuple[int, int]]]:
+    """(total dot_generals, per-scan-body (dots, degenerate-contraction
+    dots)) for one family's ``score_stacked`` traced at ``n_slots``
+    stacked slots."""
+    import jax
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.models import get_model, make_config
+
+    spec = get_model(family)
+    cfg = make_config(family, {**overrides, "window": _W})
+    params = spec.init(jax.random.PRNGKey(0), cfg)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (n_slots,) + x.shape), params
+    )
+    wins = jnp.zeros((n_slots, _B, _W), jnp.float32)
+    nv = jnp.full((n_slots, _B), _W, jnp.int32)
+    closed = jax.make_jaxpr(
+        lambda p, w, n: spec.score_stacked(p, cfg, w, n, k=_K)
+    )(stacked, wins, nv)
+    jaxpr = closed.jaxpr
+    return _count_dots(jaxpr), [
+        (_count_dots(b), _degenerate_contractions(b))
+        for b in _scan_bodies(jaxpr)
+    ]
+
+
+def lint_fusion(registry: Optional[Dict[str, dict]] = None) -> List[str]:
+    """Trace every registered fused entry point; returns findings
+    (empty = clean)."""
+    from sitewhere_tpu.models import MODEL_REGISTRY
+
+    findings: List[str] = []
+    for family, overrides in (registry or REGISTRY).items():
+        spec = MODEL_REGISTRY.get(family)
+        if spec is None:
+            findings.append(
+                f"{family}: registered family not in MODEL_REGISTRY — "
+                "stale check_fusion registry"
+            )
+            continue
+        if spec.score_stacked is None:
+            findings.append(
+                f"{family}: no score_stacked contract — stale "
+                "check_fusion registry (or the fused entry point was "
+                "dropped without updating the lint)"
+            )
+            continue
+        if _opted_out(spec.score_stacked):
+            continue
+        try:
+            total2, bodies2 = _trace_counts(family, overrides, 2)
+            total4, _bodies4 = _trace_counts(family, overrides, 4)
+        except Exception as exc:  # noqa: BLE001 - a trace failure is a finding
+            findings.append(f"{family}: score_stacked failed to trace: {exc!r}")
+            continue
+        for i, (n, deg) in enumerate(bodies2):
+            if n > MAX_DOTS_PER_SCAN_STEP:
+                findings.append(
+                    f"{family}: scan body {i} lowers to {n} dot_generals "
+                    f"per step (> {MAX_DOTS_PER_SCAN_STEP}) — the slot "
+                    "axis leaked out of the contraction (per-slot loop "
+                    "resurrection); fold it back into one wide einsum"
+                )
+            if deg:
+                findings.append(
+                    f"{family}: scan body {i} has {deg} dot_general(s) "
+                    "with a size-1 contracting dim — an outer product "
+                    "dressed as a matmul (the degenerate input-projection "
+                    "shape a vmapped scalar model drags back in); use a "
+                    "broadcast product instead"
+                )
+        if total2 != total4:
+            findings.append(
+                f"{family}: dot_general count scales with stacked slots "
+                f"({total2} at S=2 vs {total4} at S=4) — a per-slot "
+                "Python loop is unrolling the stack; use a single "
+                "batched einsum over the slot axis"
+            )
+    return findings
+
+
+def main() -> int:
+    findings = lint_fusion()
+    for f in findings:
+        print(f"check_fusion: {f}", file=sys.stderr)
+    print(
+        f"check_fusion: {len(REGISTRY)} fused entry point(s), "
+        f"{len(findings)} finding(s)"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
