@@ -210,6 +210,79 @@ def bench_engine(
         rec["device_s"] = 4e-3
         rec["status"] = "ok"
     per_rec_s = (time.perf_counter() - t_fr) / n_rec
+    # score-sketch overhead (ISSUE-9 <2% bar, headline key
+    # scorehealth_pct): (a) device side — an identical twin built with
+    # the SCORE_SKETCH_ENABLED kill switch off, timed back-to-back with
+    # a re-timed sketch run so common-mode drift cancels; (b) host side —
+    # the per-flush ScoreHealth.ingest_sketch fold, measured directly
+    # like the flight-recorder record cost. CPU-rig note: the device
+    # delta sits inside this rig's ±10% step noise; the chip-recorded
+    # baseline is what the bar gates (clamped at 0 so noise can't report
+    # a negative cost).
+    q_steps = max(10, steps // 2)
+    prev_sk = sharded.SCORE_SKETCH_ENABLED
+    sharded.FUSED_STEP_ENABLED = fused
+    sharded.SCORE_SKETCH_ENABLED = False
+    try:
+        plain = ShardedScorer(
+            mm, spec, cfg, slots_per_shard=n_slots,
+            max_streams=max_streams, window=window,
+            fuse_k=fuse_k, param_dtype=param_dtype,
+        )
+    finally:
+        sharded.FUSED_STEP_ENABLED = prev_fused
+        sharded.SCORE_SKETCH_ENABLED = prev_sk
+    for i in range(n_slots):
+        plain.activate(i)
+    np.asarray(plain.step(*inputs[0]))
+    t0 = time.perf_counter()
+    for i in range(q_steps):
+        s_p = plain.step(*inputs[i % n_rot])
+    np.asarray(s_p)
+    dt_plain = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(q_steps):
+        s_k = scorer.step(*inputs[i % n_rot])
+    np.asarray(s_k)
+    dt_sketch = time.perf_counter() - t0
+    sketch_delta_pct = 100.0 * (dt_sketch - dt_plain) / dt_plain
+    from sitewhere_tpu.models.common import SKETCH_NBINS
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+    from sitewhere_tpu.runtime.scorehealth import ScoreHealth
+
+    sh = ScoreHealth(MetricsRegistry(), window_rows=4096)
+    for i in range(n_slots):
+        sh.register(f"bench-t{i}", "lstm_ad", i, scorer.sketch_edges)
+    hist = rng.randint(0, 50, size=(n_slots, SKETCH_NBINS)).astype(np.int64)
+    n_ing = 2000
+    t_ing = time.perf_counter()
+    for _ in range(n_ing):
+        sh.ingest_sketch("lstm_ad", hist)
+    per_ing_s = (time.perf_counter() - t_ing) / n_ing
+    ingest_pct = 100.0 * per_ing_s / (dt / steps)
+    scorehealth_pct = round(max(0.0, sketch_delta_pct) + ingest_pct, 3)
+    # canary divergence: shadow-score one plane with the legacy f32 step
+    # (the previous variant) against the serving step — the config-4
+    # fused-vs-legacy twin's divergence column. Shadow runs FIRST (it
+    # reads the state the primary step donates).
+    canary_delta = canary_topk = None
+    if getattr(scorer, "fused", False):
+        from sitewhere_tpu.runtime.scorehealth import canary_divergence
+
+        shadow_fn = scorer._build_step(counts_mode=False, shadow=True)
+        _st, shadow_s = shadow_fn(
+            scorer.params, scorer.state, scorer.active, *inputs[0]
+        )
+        prim_s = scorer.step(*inputs[0])
+        # THE shared verdict definition (also the service's resolve-path
+        # comparison) — the bench columns mirror score_canary_* exactly
+        verdict = canary_divergence(
+            np.asarray(prim_s).astype(np.float32).ravel(),
+            np.asarray(shadow_s).astype(np.float32).ravel(),
+        )
+        if verdict is not None:
+            canary_delta = round(verdict[0], 6)
+            canary_topk = round(verdict[1], 4)
     step_ms = dt / steps * 1e3
     mfu = mfu_fields(flops_model, steps, dt)
     # ISSUE-8 acceptance column: device events/s per unit of step time.
@@ -244,6 +317,14 @@ def bench_engine(
         "flightrec_overhead_pct": round(
             100.0 * per_rec_s / (dt / steps), 4
         ),
+        # score-quality layer cost + divergence columns (ISSUE 9):
+        # sketch_step_delta_pct is the raw device twin delta (noisy on
+        # CPU rigs — may be negative), scorehealth_pct the gated figure
+        "sketch_step_delta_pct": round(sketch_delta_pct, 3),
+        "scorehealth_ingest_us": round(per_ing_s * 1e6, 2),
+        "scorehealth_pct": scorehealth_pct,
+        "canary_mean_abs_delta": canary_delta,
+        "canary_topk_agreement": canary_topk,
     }
 
 
@@ -1198,7 +1279,10 @@ def main() -> None:
         details["fused_speedup_32t"] = round(fus / leg, 2) if leg else None
         log(f"  -> legacy twin {details['tenants32_engine_legacy']['step_ms']:.1f} "
             f"ms/step; fused step-time speedup = "
-            f"{details['fused_speedup_32t']}x")
+            f"{details['fused_speedup_32t']}x; scorehealth "
+            f"{details['tenants32_engine']['scorehealth_pct']}% of step, "
+            f"canary |d| = "
+            f"{details['tenants32_engine']['canary_mean_abs_delta']}")
 
     if "deepar" in which:
         log("config 3: DeepAR replay forecasting ...")
@@ -1367,6 +1451,12 @@ def main() -> None:
             details, "e2e_pipeline_32t", "mfu_avg_pct", nd=2),
         "flightrec_pct": pick(
             details, "tenants32_engine", "flightrec_overhead_pct", nd=3),
+        # score-quality layer (ISSUE 9): sketch+ingest cost vs step time
+        # (<2% bar, info-class) and the fused-vs-legacy canary divergence
+        "scorehealth_pct": pick(
+            details, "tenants32_engine", "scorehealth_pct", nd=3),
+        "canary_delta_32t": pick(
+            details, "tenants32_engine", "canary_mean_abs_delta", nd=6),
         "lstm_ev_s": pick(details, "lstm_engine", "events_per_sec"),
         "e2e_ev_s": pick(details, "e2e_pipeline", "events_per_sec"),
         "e2e_drained": pick(

@@ -193,6 +193,8 @@ class RestApi:
         )
         r.add_get("/api/tenants/{token}/slo", self.tenant_slo)
         r.add_get("/api/tenants/{token}/overload", self.tenant_overload)
+        r.add_get("/api/tenants/{token}/health", self.tenant_health)
+        r.add_get("/api/tenants/{token}/scores/dist", self.tenant_scores_dist)
         r.add_post("/api/tenants/{token}/replay", self.replay_start)
         r.add_get("/api/tenants/{token}/replay", self.replay_list)
         r.add_get("/api/tenants/{token}/replay/{job}", self.replay_status)
@@ -481,6 +483,27 @@ class RestApi:
         expired/late/shed accounting (docs/ROBUSTNESS.md)."""
         token = request.match_info["token"]
         rep = self.instance.tenant_overload_report(token)
+        if rep is None:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        return web.json_response(rep)
+
+    async def tenant_health(self, request) -> web.Response:
+        """Per-tenant model-health report: drift verdict (PSI/KS vs the
+        frozen reference), score quantiles, NaN/unscored/expired delivery
+        rates, active kernel variant, and the family's shadow-canary
+        status (docs/OBSERVABILITY.md "Score health & canaries")."""
+        token = request.match_info["token"]
+        rep = self.instance.tenant_health_report(token)
+        if rep is None:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        return web.json_response(rep)
+
+    async def tenant_scores_dist(self, request) -> web.Response:
+        """The tenant's score distribution: log-spaced bin edges plus the
+        current rolling window and the frozen reference histograms (the
+        raw material behind the drift verdict)."""
+        token = request.match_info["token"]
+        rep = self.instance.tenant_scores_dist(token)
         if rep is None:
             return web.json_response({"error": "unknown tenant"}, status=404)
         return web.json_response(rep)

@@ -198,10 +198,18 @@ class SiteWhereInstance(LifecycleComponent):
             allowlist=allowlist,
             resolution_s=cfg.history_resolution_s,
         )
+        # score-quality health (runtime.scorehealth): ONE account shared
+        # by the scoring service (which feeds it device-side sketches)
+        # and the watchdog (whose score rules stamp the drifting tenant's
+        # active kernel variant into incident snapshots)
+        from sitewhere_tpu.runtime.scorehealth import ScoreHealth
+
+        self.scorehealth = ScoreHealth(self.metrics)
         self.watchdog = (
             Watchdog(
                 self.metrics, self.history,
                 flightrec=self.flightrec, tracer=self.tracer,
+                scorehealth=self.scorehealth,
             )
             if cfg.watchdog_enabled
             else None
@@ -214,6 +222,7 @@ class SiteWhereInstance(LifecycleComponent):
             tracer=self.tracer,
             overload=self.overload,
             flightrec=self.flightrec,
+            scorehealth=self.scorehealth,
         )
         # replay-to-rescore engine (pipeline/replay.py): streams the
         # segment store back through the live feed path as a low-priority
@@ -1016,6 +1025,33 @@ class SiteWhereInstance(LifecycleComponent):
             }
         rep["expired_topic"] = self.bus.naming.expired_events(tenant)
         return rep
+
+    def tenant_health_report(self, tenant: str) -> Optional[dict]:
+        """Per-tenant model-health verdict: drift statistics vs the
+        frozen reference, score quantiles, delivery-quality rates, the
+        active kernel variant, and the family's canary status — the
+        GET /api/tenants/{t}/health payload (docs/OBSERVABILITY.md
+        "Score health & canaries")."""
+        rep = self.scorehealth.health_report(tenant)
+        if rep is None:
+            return None
+        # fold the deadline gates' expired-delivery accounting in: rows
+        # that never reached a scorer are quality loss a score-only view
+        # would miss
+        expired = 0.0
+        for key, c in list(
+            self.metrics._labeled.get("pipeline_expired_total", {}).items()
+        ):
+            if dict(key).get("tenant") == tenant:
+                expired += c.value
+        rep["expired_total"] = expired
+        return rep
+
+    def tenant_scores_dist(self, tenant: str) -> Optional[dict]:
+        """The tenant's score distribution (current rolling window vs the
+        frozen reference, log-spaced bin edges) — the
+        GET /api/tenants/{t}/scores/dist payload."""
+        return self.scorehealth.dist_report(tenant)
 
     # -- introspection ---------------------------------------------------
     def topology(self) -> dict:

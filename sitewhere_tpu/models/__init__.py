@@ -14,10 +14,11 @@ as long as they share a model *family* (SURVEY.md §7 "tenants-on-mesh").
 from __future__ import annotations
 
 from dataclasses import dataclass, fields
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from sitewhere_tpu.models import deepar, lstm_ad, transformer, vit
 from sitewhere_tpu.models.common import (
+    DEFAULT_SCORE_RANGE,
     deepar_flops_per_row,
     lstm_ad_flops_per_row,
     param_count,
@@ -57,6 +58,11 @@ class ModelSpec:
     # given series-window length — the device-time/MFU attribution
     # contract (models.common; docs/PERFORMANCE.md "MFU accounting")
     flops_per_row: Optional[Callable] = None
+    # (lo, hi) score range for the device-side score sketch's log-spaced
+    # bin edges (models.common.sketch_edges; docs/OBSERVABILITY.md "Score
+    # health & canaries") — the zoo's |error|-in-sigma scorers share the
+    # default; a family with different score units overrides it here
+    score_range: Tuple[float, float] = DEFAULT_SCORE_RANGE
 
 
 MODEL_REGISTRY: Dict[str, ModelSpec] = {

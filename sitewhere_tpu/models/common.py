@@ -341,6 +341,44 @@ def clamp_fuse_k(k: int, window: int) -> int:
     return max(1, min(int(k), int(window) - 1))
 
 
+# -- device-side score sketches (score-quality observability) --------------
+#
+# Each scoring flush emits a fixed-bin score histogram per stacked tenant
+# slot, accumulated ON DEVICE inside the jitted step (parallel.sharded —
+# one segment_sum over the masked score plane) and ridden home on the
+# existing async d2h reaper path. Bin edges are log-spaced over the
+# family's declared score range (``ModelSpec.score_range``): anomaly
+# scores are sigma-ish units spanning decades, so log bins keep both the
+# nominal bulk (~0.1–1) and the anomaly tail (10–100+) resolvable with 64
+# bins. ``runtime.scorehealth`` merges these sketches into per-tenant
+# drift statistics (PSI/KS vs a frozen reference) and quantile gauges.
+
+SKETCH_NBINS = 64
+
+# default per-family score range (lo, hi) for the log-spaced sketch edges;
+# scores below lo land in bin 0, above hi in the top bin. The window-scan
+# scorers all emit |error|-in-sigma-style scores, so one default covers
+# the zoo; a family with different score units overrides on its ModelSpec.
+DEFAULT_SCORE_RANGE = (1e-3, 1e2)
+
+
+def sketch_edges(
+    lo: float = DEFAULT_SCORE_RANGE[0],
+    hi: float = DEFAULT_SCORE_RANGE[1],
+    nbins: int = SKETCH_NBINS,
+):
+    """The ``nbins - 1`` interior bin edges, log-spaced over (lo, hi):
+    bin 0 is [0, lo), bin nbins-1 is [hi', inf) — np.histogram semantics
+    (left-closed bins; device binning uses searchsorted side='right' to
+    match exactly). Returns float32 numpy; the jitted step closes over
+    it as a constant."""
+    import numpy as np
+
+    return np.logspace(
+        math.log10(lo), math.log10(hi), nbins - 1, dtype=np.float32
+    )
+
+
 # -- analytic FLOP accounting (device-time / MFU attribution) --------------
 #
 # Each model family declares ``flops_per_row(cfg, window)``: the matmul

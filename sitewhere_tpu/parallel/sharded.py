@@ -33,9 +33,12 @@ from jax.sharding import PartitionSpec as P
 
 from sitewhere_tpu.models import ModelSpec
 from sitewhere_tpu.models.common import (
+    DEFAULT_SCORE_RANGE,
     PARAM_DTYPES,
+    SKETCH_NBINS,
     clamp_fuse_k,
     quantize_params,
+    sketch_edges,
 )
 from sitewhere_tpu.ops.windows import (
     WindowState,
@@ -54,6 +57,19 @@ Params = Any
 # are ignored there: single-step scores, full-width f32 master weights).
 # The rollback knob for a numerics incident in production.
 FUSED_STEP_ENABLED = True
+
+# Device-side score sketch kill switch (same pattern): flip to False
+# BEFORE scorer construction to build steps that emit no per-slot score
+# histogram — the rollback knob if the sketch's segment_sum ever shows up
+# in a device profile, and the bench's control twin for measuring the
+# sketch's step-time overhead (``scorehealth_pct``).
+SCORE_SKETCH_ENABLED = True
+
+# After a param hot-swap (``activate(params=...)``) an armed canary
+# shadow-scores its configured fraction of the next this-many flushes, so
+# freshly swapped weights get immediate divergence coverage (see
+# ``canary_take`` / docs/OBSERVABILITY.md "Score health & canaries").
+CANARY_SWAP_FLUSHES = 64
 
 
 def stack_params(params_list: List[Params]) -> Params:
@@ -131,6 +147,24 @@ class ShardedScorer:
         self._kernel_params = None   # quantized sidecar (lazy; see below)
         self._kernel_dirty = True
         self._quantize_jit = None
+        # -- device-side score sketch (score-quality observability) ------
+        # per-slot fixed-bin score histogram emitted by the jitted step
+        # (both fused and legacy branches) and materialized by the result
+        # reaper; edges are log-spaced over the family's declared score
+        # range. Captured at BUILD time like the fused kill switch.
+        self.sketch = bool(SCORE_SKETCH_ENABLED)
+        self.nbins = SKETCH_NBINS
+        lo, hi = getattr(spec, "score_range", DEFAULT_SCORE_RANGE)
+        self.sketch_edges = sketch_edges(lo, hi, self.nbins)
+        self.last_sketch = None  # the latest dispatch's i32[T, D, NBINS]
+        # -- shadow-scoring canary (previous-variant divergence) ---------
+        # fraction of flushes shadow-scored with the legacy f32 step while
+        # a canary condition holds (non-f32 / K>1 variant, or a recent
+        # hot-swap); set by the service from TenantEngineConfig.canary_frac
+        self.canary_frac = 0.0
+        self._canary_tick = 0
+        self._canary_countdown = 0
+        self._shadow_step_fn = None  # built lazily / at prewarm
         self.slots_per_shard = slots_per_shard
         self.n_slots = mm.n_tenant_shards * slots_per_shard
         if max_streams % mm.n_data_shards:
@@ -369,8 +403,10 @@ class ShardedScorer:
         return self._gather_fn()(scores_dev, counts_dev, size)
 
     # -- compiled step ---------------------------------------------------
-    def _build_step(self, counts_mode: bool = False) -> Callable:
-        """The scoring jit. Two variants share this builder:
+    def _build_step(
+        self, counts_mode: bool = False, shadow: bool = False
+    ) -> Callable:
+        """The scoring jit. Variants sharing this builder:
 
         - mask mode (``step``): per-row bool valid mask, f32 wire — the
           fully general path (tests, arbitrary row patterns).
@@ -378,10 +414,29 @@ class ShardedScorer:
           (slot, data-shard) lane, so validity is ONE i32 count per lane,
           derived on device; ids/values arrive in the thin wire dtypes and
           scores return in the wire dtype. The service hot path uses this.
+        - ``shadow``: the canary's reference step — FORCES the legacy
+          vmap branch (f32 master weights, single-step scores: exactly
+          what the FUSED_STEP_ENABLED kill switch would restore), does
+          NOT donate the window state (its state output is discarded —
+          the primary step dispatched after it owns the commit), and
+          emits no sketch. Dispatch order guarantees the shadow reads
+          the pre-flush windows the primary is about to consume.
+
+        Unless ``shadow`` (or the SCORE_SKETCH_ENABLED kill switch is
+        off), the step also emits the per-slot score sketch: an
+        ``i32[T, D, NBINS]`` fixed-bin histogram of the masked scores,
+        accumulated with one ``segment_sum`` over the local score plane
+        per data shard — zero collectives; the host merges the D partials
+        (a 64-int add per slot). NaN scores are excluded on device (the
+        resolve path counts them separately).
         """
         mesh = self.mm.mesh
         spec, cfg = self.spec, self.cfg
-        fused, k_steps = self.fused, self.k_steps
+        fused = self.fused and not shadow
+        k_steps = self.k_steps if not shadow else 1
+        emit_sketch = self.sketch and not shadow
+        nbins = self.nbins
+        edges = jnp.asarray(self.sketch_edges)
         score_dtype = (
             {"f32": jnp.float32, "bf16": jnp.bfloat16, "f16": jnp.float16}[
                 self.wire_dtype
@@ -390,53 +445,88 @@ class ShardedScorer:
             else jnp.float32
         )
 
+        def sketch_of(s, valid):
+            # s [T_loc, B_loc] scores, valid bool[T_loc, B_loc]: per-slot
+            # histogram via ONE segment_sum over the masked plane. Bin =
+            # searchsorted side='right' (np.histogram's left-closed bins);
+            # invalid/NaN rows map to the dropped overflow segment.
+            t = s.shape[0]
+            sf = s.astype(jnp.float32)
+            b = jnp.searchsorted(edges, sf, side="right").astype(jnp.int32)
+            b = jnp.where(valid & ~jnp.isnan(sf), b, nbins)
+            flat = (
+                jnp.arange(t, dtype=jnp.int32)[:, None] * (nbins + 1) + b
+            ).reshape(-1)
+            hist = jax.ops.segment_sum(
+                jnp.ones_like(flat), flat, num_segments=t * (nbins + 1)
+            )
+            return hist.reshape(t, nbins + 1)[:, :nbins]
+
         def local_step(params, state, active, ids, vals, validity):
             # local shapes: params [T_loc, ...], state [T_loc, S_loc, W],
             # ids/vals [T_loc, B_loc]; validity is bool[T_loc, B_loc]
             # (mask mode) or i32[T_loc, 1] lane counts (counts mode)
+            if counts_mode:
+                m = (
+                    jnp.arange(ids.shape[1], dtype=jnp.int32)[None, :]
+                    < validity
+                )
+            else:
+                m = validity
             if not fused:
-                def one(p, st, act, i, v, m_or_c):
-                    if counts_mode:
-                        m = jnp.arange(i.shape[0], dtype=jnp.int32) < m_or_c[0]
-                    else:
-                        m = m_or_c
+                def one(p, st, act, i, v, m1):
                     i = i.astype(jnp.int32)
                     v = v.astype(jnp.float32)
-                    st2, w, n = update_and_gather(st, i, v, m)
-                    s = spec.score(p, cfg, w, n)
-                    return st2, jnp.where(act & m, s, 0.0).astype(score_dtype)
+                    st2, w, n = update_and_gather(st, i, v, m1)
+                    s1 = spec.score(p, cfg, w, n)
+                    return st2, jnp.where(act & m1, s1, 0.0).astype(
+                        score_dtype
+                    )
 
-                return jax.vmap(one)(params, state, active, ids, vals, validity)
-
-            # fused megabatch path: the window scatter/gather (memory
-            # ops, no matmuls) stays vmapped per slot, but scoring runs
-            # ONE weight-stacked kernel over the whole [T_loc, B_loc]
-            # tenant plane (spec.score_stacked — a single wide einsum
-            # per gate contraction instead of T_loc small matmuls)
-            def upd(st, i, v, m_or_c):
-                if counts_mode:
-                    m = jnp.arange(i.shape[0], dtype=jnp.int32) < m_or_c[0]
-                else:
-                    m = m_or_c
-                i = i.astype(jnp.int32)
-                v = v.astype(jnp.float32)
-                st2, w, n, later = update_gather_ranked(st, i, v, m)
-                return st2, w, n, later, m
-
-            st2, w, n, later, m = jax.vmap(upd)(state, ids, vals, validity)
-            sk = spec.score_stacked(params, cfg, w, n, k=k_steps)
-            if k_steps > 1:
-                # per-row timestep resolution: a row with ``later`` valid
-                # same-stream rows after it in this flush sits at window
-                # position W-1-later, i.e. K-step column K-1-later; rows
-                # older than the K window take the oldest column
-                idx = jnp.clip(k_steps - 1 - later, 0, k_steps - 1)
-                s = jnp.take_along_axis(sk, idx[..., None], axis=-1)[..., 0]
+                st2, s = jax.vmap(one)(params, state, active, ids, vals, m)
             else:
-                s = sk[..., 0]
-            s = jnp.where(active[:, None] & m, s, 0.0).astype(score_dtype)
+                # fused megabatch path: the window scatter/gather (memory
+                # ops, no matmuls) stays vmapped per slot, but scoring
+                # runs ONE weight-stacked kernel over the whole
+                # [T_loc, B_loc] tenant plane (spec.score_stacked — a
+                # single wide einsum per gate contraction instead of
+                # T_loc small matmuls)
+                def upd(st, i, v, m1):
+                    i = i.astype(jnp.int32)
+                    v = v.astype(jnp.float32)
+                    st2, w, n, later = update_gather_ranked(st, i, v, m1)
+                    return st2, w, n, later
+
+                st2, w, n, later = jax.vmap(upd)(state, ids, vals, m)
+                sk = spec.score_stacked(params, cfg, w, n, k=k_steps)
+                if k_steps > 1:
+                    # per-row timestep resolution: a row with ``later``
+                    # valid same-stream rows after it in this flush sits
+                    # at window position W-1-later, i.e. K-step column
+                    # K-1-later; rows older than the K window take the
+                    # oldest column
+                    idx = jnp.clip(k_steps - 1 - later, 0, k_steps - 1)
+                    s = jnp.take_along_axis(sk, idx[..., None], axis=-1)[
+                        ..., 0
+                    ]
+                else:
+                    s = sk[..., 0]
+                s = jnp.where(active[:, None] & m, s, 0.0).astype(
+                    score_dtype
+                )
+            if emit_sketch:
+                hist = sketch_of(s, active[:, None] & m)
+                return st2, s, hist[:, None, :]
             return st2, s
 
+        out_specs = [
+            P(AXIS_TENANT, AXIS_DATA),       # new state
+            P(AXIS_TENANT, AXIS_DATA),       # scores
+        ]
+        if emit_sketch:
+            # each data shard contributes its local partial histogram
+            # along axis 1 — no cross-shard reduction on device
+            out_specs.append(P(AXIS_TENANT, AXIS_DATA, None))
         smapped = shard_map(
             local_step,
             mesh=mesh,
@@ -448,11 +538,10 @@ class ShardedScorer:
                 P(AXIS_TENANT, AXIS_DATA),   # values
                 P(AXIS_TENANT, AXIS_DATA),   # valid mask / lane counts
             ),
-            out_specs=(
-                P(AXIS_TENANT, AXIS_DATA),   # new state
-                P(AXIS_TENANT, AXIS_DATA),   # scores
-            ),
+            out_specs=tuple(out_specs),
         )
+        if shadow:
+            return jax.jit(smapped)  # NO donation: state stays live
         return jax.jit(smapped, donate_argnums=(1,))
 
     def prewarm(self, lane_sizes) -> None:
@@ -479,6 +568,19 @@ class ShardedScorer:
             # would stall the pipeline exactly like a step compile
             for g in self.gather_ladder(b):
                 _np.asarray(self.gather_rows(s, counts, g))
+            if self.last_sketch is not None:
+                # the sketch rides the same executable; settle its output
+                # so nothing compiles lazily later
+                _np.asarray(self.last_sketch)
+            if self.fused and self.canary_frac > 0:
+                # canary-capable scorer: compile the shadow (legacy) step
+                # + its gather sizes too — a hot-swap can arm the canary
+                # at any time, and its first shadow flush must not pay a
+                # mid-traffic compile
+                sh = self.shadow_step_counts(ids, vals, counts)
+                _np.asarray(sh)
+                for g in self.gather_ladder(b):
+                    _np.asarray(self.gather_rows(sh, counts, g))
             if t > 1:
                 # the single-used-slot d2h slice the flush path takes
                 # (see TpuInferenceService._flush_family) — same rule:
@@ -501,10 +603,14 @@ class ShardedScorer:
         if self.fault_steps > 0:
             self.fault_steps -= 1
             raise RuntimeError("injected scorer fault (chaos)")
-        self.state, scores = self._step(
+        out = self._step(
             self.kernel_params(), self.state, self.active,
             stream_ids, values, valid,
         )
+        if self.sketch:
+            self.state, scores, self.last_sketch = out
+        else:
+            self.state, scores = out
         return scores
 
     def step_counts(
@@ -520,11 +626,77 @@ class ShardedScorer:
         if self.fault_steps > 0:
             self.fault_steps -= 1
             raise RuntimeError("injected scorer fault (chaos)")
-        self.state, scores = self._step_counts(
+        out = self._step_counts(
             self.kernel_params(), self.state, self.active,
             stream_ids, values, counts,
         )
+        if self.sketch:
+            self.state, scores, self.last_sketch = out
+        else:
+            self.state, scores = out
         return scores
+
+    # -- shadow-scoring canary -------------------------------------------
+    def arm_canary(self) -> None:
+        """A param hot-swap landed: shadow-score the configured fraction
+        of the next CANARY_SWAP_FLUSHES flushes (no-op while
+        ``canary_frac`` is 0 or the scorer runs the legacy path)."""
+        self._canary_countdown = CANARY_SWAP_FLUSHES
+
+    def canary_active(self) -> bool:
+        """A canary condition holds: the stack scores through a variant
+        the legacy step would not produce (quantized weights / K-step
+        fusion) or a hot-swap recently landed."""
+        if not self.fused or self.canary_frac <= 0 or self.spec.score is None:
+            return False
+        return (
+            self.param_dtype != "f32"
+            or self.k_steps > 1
+            or self._canary_countdown > 0
+        )
+
+    def canary_take(self) -> bool:
+        """Per-flush decision: True ⇔ this flush also shadow-scores.
+        Deterministic stride at ``canary_frac`` (1.0 = every flush);
+        the post-swap countdown burns down per flush while armed."""
+        if not self.canary_active():
+            return False
+        if self._canary_countdown > 0:
+            self._canary_countdown -= 1
+        self._canary_tick += 1
+        stride = max(1, int(round(1.0 / min(1.0, self.canary_frac))))
+        return self._canary_tick % stride == 0
+
+    def shadow_step_counts(self, stream_ids, values, counts):
+        """Score one staged flush with the PREVIOUS variant: the legacy
+        vmap step over the f32 MASTER params (exactly the program the
+        FUSED_STEP_ENABLED kill switch would restore). Reads — never
+        donates or commits — the window state, so it must dispatch
+        BEFORE the primary ``step_counts`` consumes the same state
+        buffer (dispatch order on one device queue guarantees the read
+        sees the pre-flush windows). Returns the wire-dtype score plane;
+        the caller gathers it with the same counts for pick-aligned
+        comparison."""
+        if self._shadow_step_fn is None:
+            self._shadow_step_fn = self._build_step(
+                counts_mode=True, shadow=True
+            )
+        _st, scores = self._shadow_step_fn(
+            self.params, self.state, self.active,
+            stream_ids, values, counts,
+        )
+        return scores
+
+    def shadow_flops_per_flush(self, b_lane: int) -> float:
+        """FLOPs one SHADOW flush executes (legacy full-width count over
+        the padded plane). Attributed to ``tpu_shadow_flops_total`` —
+        never to ``tpu_flops_total``/``tpu_mfu_pct``, which must reflect
+        serving work only."""
+        fn = getattr(self.spec, "flops_per_row", None)
+        if fn is None:
+            return 0.0
+        plane = self.n_slots * self.mm.n_data_shards * int(b_lane)
+        return plane * float(fn(self.cfg, self.window))
 
     # -- slot management -------------------------------------------------
     def activate(
@@ -539,6 +711,9 @@ class ShardedScorer:
                 self.params, global_slot, params
             )
             self._invalidate_kernel()
+            # a hot-swap landed: the canary (if configured) shadow-scores
+            # the next window of flushes against the swapped weights
+            self.arm_canary()
         self.active = self.active.at[global_slot].set(True)
         self.train_mask = self.train_mask.at[global_slot].set(trainable)
         if lr is not None:
@@ -628,6 +803,8 @@ class ShardedScorer:
         self._kernel_dirty = True
         self._quantize_jit = None
         self._gather = None  # fresh jit cache for the result-path gather
+        self._shadow_step_fn = None  # rebuilt lazily on next canary flush
+        self.last_sketch = None      # may reference dead buffers
         self._wire_sharding = self.mm.sharding(AXIS_TENANT, AXIS_DATA)
         if getattr(self, "_optimizer", None) is not None:
             opt_state = jax.vmap(self._optimizer.init)(self.params)

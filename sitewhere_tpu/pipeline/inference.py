@@ -324,13 +324,14 @@ class _PendingFlush:
     __slots__ = (
         "family", "scores", "taken", "moved", "gathered", "t_dispatch",
         "nbytes", "plane_nbytes", "host_future", "t_wait", "poisoned",
-        "flops", "rec",
+        "flops", "rec", "sketch", "shadow", "slot_override",
     )
 
     def __init__(
         self, family: str, scores, taken, moved: int, gathered: bool,
         nbytes: int, plane_nbytes: int, poisoned: bool = False,
         flops: float = 0.0, rec: Optional[dict] = None,
+        sketch=None, shadow=None,
     ) -> None:
         self.family = family
         self.scores = scores
@@ -351,6 +352,27 @@ class _PendingFlush:
         # record completed in place when the flush resolves
         self.flops = flops
         self.rec = rec
+        # score-quality payloads riding the same reaper slot: the step's
+        # per-slot score sketch (i32[T, D, NBINS] — runtime.scorehealth)
+        # and the canary's shadow-scored row vector (previous-variant
+        # divergence). Their async host copies start at dispatch like the
+        # scores'; by the time the scores land these few-KB transfers
+        # have long since followed — no extra round-trip.
+        self.sketch = sketch
+        self.shadow = shadow
+        # the single-used-slot fallback slice zeroes the pack-order slot
+        # indices (rows then index row 0 of the slice); this remembers
+        # the real slot so NaN attribution survives that path
+        self.slot_override: Optional[int] = None
+
+    def _materialize(self):
+        """Worker-thread materialization of every device output riding
+        this flush — one executor hop for scores + sketch + shadow."""
+        return (
+            np.asarray(self.scores),
+            None if self.sketch is None else np.asarray(self.sketch),
+            None if self.shadow is None else np.asarray(self.shadow),
+        )
 
     def landed(self) -> bool:
         """Probably-complete signal used to PRIORITIZE heads: a finished
@@ -370,10 +392,11 @@ class _PendingFlush:
 
     def ensure_host_future(self, loop, pool):
         """Lazily start (and cache) an executor materialization — used
-        when the reaper must wait on several families' heads at once."""
+        when the reaper must wait on several families' heads at once.
+        Resolves to the (scores, sketch, shadow) host triple."""
         if self.host_future is None:
             self.host_future = loop.run_in_executor(
-                pool, np.asarray, self.scores
+                pool, self._materialize
             )
         return self.host_future
 
@@ -426,6 +449,23 @@ class TpuInferenceEngine(TenantEngine):
             trainable=self.config.training.enabled,
             lr=self.config.training.lr,
         )
+        # score-health registration: bind this tenant to its stacked slot
+        # so the resolve path can attribute device sketches, and start a
+        # FRESH drift baseline — an engine (re)start activates params
+        # explicitly, so the reference must re-learn the current model's
+        # output distribution (docs/OBSERVABILITY.md "re-baseline")
+        svc.scorehealth.register(
+            self.tenant, self.config.model,
+            svc.router.global_slot(self.placement),
+            getattr(scorer, "sketch_edges", []),
+            variant={
+                "fused": bool(getattr(scorer, "fused", False)),
+                "k_steps": int(getattr(scorer, "k_steps", 1)),
+                "param_dtype": getattr(scorer, "param_dtype", "f32"),
+                "wire_dtype": getattr(scorer, "wire_dtype", "f32"),
+            },
+        )
+        svc.scorehealth.rebaseline(self.tenant)
         # a tenant lifecycle event is the unpark signal for its family —
         # and clears the family breaker's failure history with it
         svc._parked.discard(self.config.model)
@@ -469,12 +509,14 @@ class TpuInferenceEngine(TenantEngine):
                     if n:
                         _ids, _vals, seqs, rows = lane.pop(n)
                         await svc._resolve_rows(
-                            seqs, rows, None, publish_nowait=True
+                            seqs, rows, None, publish_nowait=True,
+                            family=self.config.model,
                         )
                         drained.inc(n)
             svc.router.remove(self.tenant)
             self.placement = None
         svc.fair.remove(self.tenant)
+        svc.scorehealth.remove(self.tenant)
         svc._gates.pop(self.tenant, None)
 
 
@@ -495,6 +537,7 @@ class TpuInferenceService(MultitenantService):
         fair_quantum: int = 4096,
         staging_slots: int = 2,
         flightrec=None,
+        scorehealth=None,
     ) -> None:
         super().__init__("tpu-inference", bus, self._make_engine)
         self.mm = mm or MeshManager()
@@ -519,6 +562,15 @@ class TpuInferenceService(MultitenantService):
         # blackbox records + dump-on-incident (breaker trip) snapshots;
         # None (direct service construction in tests) = fully guarded out
         self.flightrec = flightrec
+        # score-quality health (runtime.scorehealth): per-tenant drift
+        # windows fed by the device-side score sketches the reaper
+        # materializes, plus shadow-canary divergence — always on (the
+        # per-flush host cost is one 64-bin add per touched slot)
+        if scorehealth is None:
+            from sitewhere_tpu.runtime.scorehealth import ScoreHealth
+
+            scorehealth = ScoreHealth(self.metrics)
+        self.scorehealth = scorehealth
         # live device-time/MFU attribution per family (runtime.metrics
         # .MfuAccount; fed by resolved flushes, decayed by refresh_mfu)
         self._mfu: Dict[str, object] = {}
@@ -651,6 +703,9 @@ class TpuInferenceService(MultitenantService):
                 fuse_k=getattr(cfg, "fuse_k", 1),
                 param_dtype=getattr(cfg, "param_dtype", "f32"),
             )
+            # shadow-canary fraction: family-pinned like the fused knobs
+            # (first tenant wins; one shadow step per family stack)
+            scorer.canary_frac = float(getattr(cfg, "canary_frac", 0.0) or 0.0)
             self.scorers[family] = scorer
             self._lanes[family] = {}
             # the failover→park escalation is the scorer's first-line
@@ -734,18 +789,22 @@ class TpuInferenceService(MultitenantService):
             while q:
                 pf = q.popleft()
                 _s, _c, seqs, rows = pf.taken
-                await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+                await self._resolve_rows(
+                    seqs, rows, None, publish_nowait=True, family=pf.family
+                )
                 self._inflight.release()
         self._deliver_gauge()
         # final sweep: rows can land in lanes AFTER their engine's own
         # stop-drain (the scoring loop keeps consuming during the stop
         # cascade) — resolve them unscored so no consumed event is lost
-        for lanes in self._lanes.values():
+        for fam, lanes in self._lanes.items():
             for key in list(lanes):
                 lane = lanes.pop(key)
                 if lane.count:
                     _i, _v, seqs, rows = lane.pop(lane.count)
-                    await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+                    await self._resolve_rows(
+                        seqs, rows, None, publish_nowait=True, family=fam
+                    )
         self._last_scores.clear()  # drop any pinned device score memory
         if self.mm.n_devices > 1:
             # cardinality guard (the drop_labeled pattern): a stopped
@@ -833,6 +892,7 @@ class TpuInferenceService(MultitenantService):
         rows: np.ndarray,
         scores: Optional[np.ndarray],
         publish_nowait: bool = False,
+        family: str = "",
     ) -> int:
         """Columnar score write-back: scatter ``scores`` (or NaN for an
         unscored resolution) into their batches' score columns one
@@ -851,6 +911,13 @@ class TpuInferenceService(MultitenantService):
         n = len(seqs)
         if n == 0:
             return 0
+        if scores is None and family:
+            # the poisoned/parked/drain deliveries used to publish NaN
+            # rows with NO counter — an operator watching scored_total
+            # could not tell a degraded family from a healthy one
+            self.metrics.counter(
+                "tpu_scores_unscored_total", family=family
+            ).inc(n)
         cuts = np.flatnonzero(seqs[1:] != seqs[:-1]) + 1
         done = np.empty((len(cuts) + 1,), np.int64)
         k = 0
@@ -874,6 +941,10 @@ class TpuInferenceService(MultitenantService):
                     dst[int(run[0]) : int(run[-1]) + 1] = scores[a:b]
                 else:
                     dst[run] = scores[a:b]
+                if scores is None:
+                    # per-tenant delivery-quality accounting (one call
+                    # per run, never per row — runtime.scorehealth)
+                    self.scorehealth.note_unscored(entry[0].tenant, b - a)
                 entry[1] -= b - a
                 if entry[1] <= 0:
                     done[k] = s
@@ -1013,7 +1084,7 @@ class TpuInferenceService(MultitenantService):
                 lane = lanes.pop(key)
                 if lane.count:
                     _i, _v, seqs, rows = lane.pop(lane.count)
-                    await self._resolve_rows(seqs, rows, None)
+                    await self._resolve_rows(seqs, rows, None, family=family)
                     drained += len(seqs)
             self._first_pending_ts.pop(family, None)
             return drained
@@ -1031,7 +1102,7 @@ class TpuInferenceService(MultitenantService):
                 lane = lanes.pop(key)
                 if lane.count:
                     _i, _v, seqs, rows = lane.pop(lane.count)
-                    await self._resolve_rows(seqs, rows, None)
+                    await self._resolve_rows(seqs, rows, None, family=family)
                     drained += len(seqs)
             self._first_pending_ts.pop(family, None)
             self.metrics.counter("tpu_inference.breaker_short_circuits").inc()
@@ -1150,6 +1221,31 @@ class TpuInferenceService(MultitenantService):
                 )
             except Exception:  # noqa: BLE001 - observability only
                 pass
+            # shadow-scoring canary: when armed (non-f32/K>1 variant or a
+            # recent hot-swap, at the family's canary_frac stride), score
+            # this flush ALSO through the previous variant — the legacy
+            # f32 step. It must dispatch BEFORE the primary step: it
+            # reads the window state the primary is about to donate, and
+            # same-queue dispatch order guarantees that read. Shadow
+            # FLOPs land in tpu_shadow_flops_total — NEVER the MFU
+            # account — so tpu_mfu_pct keeps meaning "serving work".
+            shadow_dev = None
+            take = getattr(scorer, "canary_take", None)
+            if take is not None and take():
+                try:
+                    shadow_plane = scorer.shadow_step_counts(*staged)
+                    shadow_dev = scorer.gather_rows(
+                        shadow_plane, staged[2], moved
+                    )
+                    shadow_dev.copy_to_host_async()
+                    self.metrics.counter("tpu_inference.canary_flushes").inc()
+                    self.metrics.counter(
+                        "tpu_shadow_flops_total", family=family
+                    ).inc(float(scorer.shadow_flops_per_flush(b_lane)))
+                except Exception as exc:  # noqa: BLE001 - the canary is
+                    # advisory: it must never take scoring down with it
+                    self._record_error("canary", exc)
+                    shadow_dev = None
             t_disp = time.perf_counter()
             with _profiler_annotation(self.profile_annotations, family):
                 scores_dev = scorer.step_counts(*staged)  # async dispatch
@@ -1214,6 +1310,15 @@ class TpuInferenceService(MultitenantService):
             # independent of tenant count. Shapes come from the ladder
             # prewarm compiles (ShardedScorer.gather_ladder).
             plane_nbytes = int(getattr(scores_dev, "nbytes", 0))
+            # the step's device-side score sketch (i32[T, D, NBINS]) —
+            # a few hundred bytes riding the same async readback; its
+            # host copy starts here like the scores' below
+            sketch_dev = getattr(scorer, "last_sketch", None)
+            if sketch_dev is not None:
+                try:
+                    sketch_dev.copy_to_host_async()
+                except Exception:  # noqa: BLE001 - numpy/test doubles
+                    pass
             gathered = False
             gather = getattr(scorer, "gather_rows", None)
             if gather is not None and hasattr(scores_dev, "is_ready"):
@@ -1223,12 +1328,14 @@ class TpuInferenceService(MultitenantService):
                 except Exception as exc:  # noqa: BLE001 - fall back to
                     # the full-plane readback rather than lose the flush
                     self._record_error("gather", exc)
+            slot_override = None
             if not gathered and len(used_slots) == 1 and scorer.n_slots > 1:
                 # legacy d2h diet for gather-less scorers (monkeypatched
                 # doubles): one used slot → slice that row on device
                 only = next(iter(used_slots))
                 scores_dev = scores_dev[np.full((1,), only, np.int32)]
                 slots_cat[:] = 0  # rows now index row 0 of the slice
+                slot_override = only  # keep NaN attribution honest
             # overlap probe for the NEXT flush — now holds the gathered
             # rows (a few KB), not a full flush of plane memory; the
             # reaper drops it when the family goes idle
@@ -1310,8 +1417,9 @@ class TpuInferenceService(MultitenantService):
             family, scores_dev, taken, moved, gathered,
             int(getattr(scores_dev, "nbytes", 0)), plane_nbytes,
             flops=float(flops_fn(b_lane)) if flops_fn is not None else 0.0,
-            rec=rec,
+            rec=rec, sketch=sketch_dev, shadow=shadow_dev,
         )
+        pf.slot_override = slot_override
         if not hasattr(scores_dev, "copy_to_host_async"):
             # no async copy available (test doubles): materialize eagerly
             # on the pool so fallback flushes still overlap each other
@@ -1440,6 +1548,13 @@ class TpuInferenceService(MultitenantService):
             trainable=engine.config.training.enabled,
             lr=engine.config.training.lr,
         )
+        # slot re-map only: the model didn't change, so the drift
+        # reference survives the failover (register keeps same-family
+        # history — see ScoreHealth.register)
+        self.scorehealth.register(
+            tenant, family, new_slot,
+            getattr(scorer, "sketch_edges", []),
+        )
         # pending rows keyed by the old slot ride over to the new one
         lanes = self._lanes.get(family, {})
         for d in range(self.mm.n_data_shards):
@@ -1523,6 +1638,10 @@ class TpuInferenceService(MultitenantService):
         not its last busy value)."""
         for acc in self._mfu.values():
             acc.refresh()
+        # same tick drives the score-health time-based window rotation:
+        # a slow stream must still rotate its drift windows instead of
+        # waiting hours to fill window_rows
+        self.scorehealth.refresh()
 
     async def _reap_loop(self) -> None:
         """The completion reaper: resolve in-flight flushes as their d2h
@@ -1610,6 +1729,31 @@ class TpuInferenceService(MultitenantService):
     # runtime/metrics.py for the rationale)
     D2H_OVERLAP_EPS_S = _D2H_OVERLAP_EPS_S
 
+    # top-k size for the canary's rank-agreement verdict: the rows an
+    # alerting/thresholding consumer actually acts on are the highest
+    # scores, so rank stability there matters more than mean delta
+    CANARY_TOPK = 64
+
+    def _canary_compare(
+        self, pf: _PendingFlush, picks: np.ndarray, shadow_np: np.ndarray
+    ) -> None:
+        """Divergence of the serving scores vs the shadow (previous
+        variant) scores for one flush — one shared verdict definition
+        (``scorehealth.canary_divergence``, also the bench's canary
+        columns); results land in ``score_canary_*`` and the flush's
+        blackbox record."""
+        from sitewhere_tpu.runtime.scorehealth import canary_divergence
+
+        sp = shadow_np[: pf.moved].astype(np.float32, copy=False)
+        verdict = canary_divergence(picks, sp, self.CANARY_TOPK)
+        if verdict is None:
+            return
+        mean_abs, agree, n = verdict
+        self.scorehealth.canary_note(pf.family, mean_abs, agree, n)
+        if pf.rec is not None:
+            pf.rec["canary_mean_abs_delta"] = round(mean_abs, 6)
+            pf.rec["canary_topk_agreement"] = round(agree, 4)
+
     async def _resolve_flush(self, pf: _PendingFlush) -> None:
         """Materialize one flush's (gathered) scores and resolve its rows.
 
@@ -1630,15 +1774,12 @@ class TpuInferenceService(MultitenantService):
                 # resolve the rows unscored, but through this FIFO slot
                 # so they can't overtake an earlier in-flight flush
                 scattered = True
-                await self._resolve_rows(seqs, rows, None)
+                await self._resolve_rows(seqs, rows, None, family=pf.family)
                 return
             t0 = time.perf_counter()
-            if pf.host_future is not None:
-                scores_np = await pf.host_future
-            else:
-                scores_np = await asyncio.get_running_loop().run_in_executor(
-                    self._deliver_pool, np.asarray, pf.scores
-                )
+            scores_np, sketch_np, shadow_np = await pf.ensure_host_future(
+                asyncio.get_running_loop(), self._deliver_pool
+            )
             now = time.perf_counter()
             # cumulative wait: from the FIRST time the reaper waited on
             # this flush (race rounds included), not just the last await
@@ -1660,6 +1801,36 @@ class TpuInferenceService(MultitenantService):
                 picks = scores_np[: pf.moved].astype(np.float32, copy=False)
             else:
                 picks = scores_np[_slots, _cols].astype(np.float32, copy=False)
+            # score-quality accounting: per-flush NaN census + the
+            # device sketch folded into the tenant drift windows, all
+            # vectorized (runtime.scorehealth; nan attribution rides the
+            # pack-order slots — one bincount, never a per-row loop)
+            nan_mask = np.isnan(picks)
+            nan_rows = int(nan_mask.sum())
+            if nan_rows:
+                self.metrics.counter(
+                    "tpu_scores_nan_total", family=pf.family
+                ).inc(nan_rows)
+            if sketch_np is not None:
+                nan_by_slot = None
+                if nan_rows:
+                    # picks align with the pack-order slots on BOTH the
+                    # gathered and full-plane fallback paths; only the
+                    # single-slot slice zeroed them (override carries it)
+                    if pf.slot_override is not None:
+                        nan_by_slot = np.zeros(
+                            (sketch_np.shape[0],), np.int64
+                        )
+                        nan_by_slot[pf.slot_override] = nan_rows
+                    else:
+                        nan_by_slot = np.bincount(
+                            _slots[nan_mask], minlength=sketch_np.shape[0]
+                        )
+                self.scorehealth.ingest_sketch(
+                    pf.family, sketch_np.sum(axis=1), nan_by_slot
+                )
+            if shadow_np is not None:
+                self._canary_compare(pf, picks, shadow_np)
             # cancellation past this point observes only INSIDE
             # _resolve_rows' publish loop (the scatter is await-free), so
             # scores are written and counts decremented exactly once —
@@ -1695,6 +1866,14 @@ class TpuInferenceService(MultitenantService):
                 pf.rec["resolve_s"] = round(resolve_s, 6)
                 pf.rec["device_s"] = round(device_s, 6)
                 pf.rec["status"] = "ok"
+                # score-quality fields: incident snapshots can now see
+                # WHAT the flush scored, not just how long it took
+                pf.rec["nan_rows"] = nan_rows
+                finite = picks[~nan_mask]
+                pf.rec["score_p99"] = (
+                    round(float(np.quantile(finite, 0.99)), 6)
+                    if finite.size else None
+                )
             if pf.plane_nbytes:
                 # what the pre-gather path would have moved — the bench's
                 # d2h_plane_reduction column is this ratio
@@ -1713,7 +1892,9 @@ class TpuInferenceService(MultitenantService):
             # after it would decrement batch row counts a second time
             # (premature NaN publishes) and overwrite written scores
             if not scattered:
-                await self._resolve_rows(seqs, rows, None, publish_nowait=True)
+                await self._resolve_rows(
+                    seqs, rows, None, publish_nowait=True, family=pf.family
+                )
             raise
         except Exception as exc:  # noqa: BLE001 - a poisoned transfer
             # must not strand the batches: resolve rows unscored — but
@@ -1723,7 +1904,7 @@ class TpuInferenceService(MultitenantService):
             # completed batches inside _resolve_rows)
             self._record_error("deliver", exc)
             if not scattered:
-                await self._resolve_rows(seqs, rows, None)
+                await self._resolve_rows(seqs, rows, None, family=pf.family)
             if pf.rec is not None and not pf.poisoned:
                 pf.rec["status"] = "error"
                 pf.rec["error"] = repr(exc)

@@ -183,6 +183,13 @@ class TenantEngineConfig:
     #   (int8 = per-slot per-channel scales, dequant fused in the scan
     #   step — see docs/PERFORMANCE.md for when int8 is safe)
     param_dtype: str = "f32"
+    # shadow-scoring canary fraction (family-pinned like the knobs above;
+    # docs/OBSERVABILITY.md "Score health & canaries"): while a canary
+    # condition holds — the stack scores through a non-f32 / K>1 variant,
+    # or a param hot-swap recently landed — this fraction of flushes is
+    # ALSO scored through the legacy f32 step and the divergence reported
+    # as score_canary_* metrics. 0 (default) disables shadow scoring.
+    canary_frac: float = 0.0
     # streaming-media classification leg (chunks → ViT → events); tiny
     # uses the test-sized ViT so CI exercises the full flow cheaply
     media_pipeline: bool = False

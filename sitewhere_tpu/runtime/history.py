@@ -69,6 +69,12 @@ DEFAULT_ALLOWLIST: Tuple[str, ...] = (
     "overload_credit",
     "overload_degradation_level",
     "watchdog_alerts_total",
+    # score-quality layer (runtime.scorehealth): drift statistic, model
+    # output quality, and canary divergence per tenant/family
+    "score_quality_psi",
+    "score_quality_p99",
+    "score_quality_nan_rate",
+    "score_canary_mean_abs_delta",
 )
 
 # Families the Watchdog rules read from the history ring. A custom
@@ -84,7 +90,26 @@ WATCHDOG_REQUIRED: Tuple[str, ...] = (
     "tpu_inference.d2h_overlapped",
     "tpu_inference.d2h_wait",
     "overload_credit",
+    "score_quality_psi",
+    "score_quality_nan_rate",
 )
+
+# PSI verdict boundary the score_drift rule shares with the REST health
+# verdict (runtime.scorehealth.PSI_DRIFT_THRESHOLD — duplicated here so
+# the jax-free history module never imports the model stack)
+SCORE_PSI_THRESHOLD = 0.25
+
+# parse `family="x",tenant="y"` out of a labeled history-series key
+_CHILD_LABEL_RE = None
+
+
+def _child_labels(key: str) -> Dict[str, str]:
+    global _CHILD_LABEL_RE
+    if _CHILD_LABEL_RE is None:
+        import re
+
+        _CHILD_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+    return dict(_CHILD_LABEL_RE.findall(key))
 
 
 class MetricsHistory:
@@ -271,6 +296,7 @@ class Watchdog:
         history: MetricsHistory,
         flightrec=None,
         tracer=None,
+        scorehealth=None,
         *,
         window: float = 60.0,          # rule lookback, seconds
         warmup: float = 120.0,         # recompile-rule grace, seconds
@@ -282,6 +308,9 @@ class Watchdog:
         d2h_spike_ratio: float = 4.0,
         d2h_spike_floor_s: float = 0.05,
         d2h_spike_min_count: int = 10,
+        drift_window: float = 30.0,    # score-rule sustained hold, seconds
+        psi_threshold: float = SCORE_PSI_THRESHOLD,
+        nan_rate_threshold: float = 0.10,
         force_retain_s: float = 60.0,
         clock=time.monotonic,
     ) -> None:
@@ -289,6 +318,11 @@ class Watchdog:
         self.history = history
         self.flightrec = flightrec
         self.tracer = tracer
+        # score-quality context (runtime.scorehealth): lets the score
+        # rules stamp the drifting tenant's ACTIVE kernel variant into
+        # the incident snapshot meta — "lstm_ad int8/k=2 drifted" is
+        # actionable where "lstm_ad drifted" is not
+        self.scorehealth = scorehealth
         # windows are GIVEN in seconds but the history is indexed in
         # samples — convert through the ring's actual resolution (the
         # instance's history_resolution_s is configurable; rules sized
@@ -310,6 +344,12 @@ class Watchdog:
         self.credit_window = min(
             max(1, int(round(credit_window / res))), cap
         )
+        self.drift_window_s = float(drift_window)
+        self.drift_window = min(
+            max(1, int(round(drift_window / res))), cap
+        )
+        self.psi_threshold = float(psi_threshold)
+        self.nan_rate_threshold = float(nan_rate_threshold)
         self.cooldown_s = cooldown_s
         self.min_flushes = min_flushes
         self.overlap_healthy = overlap_healthy
@@ -437,12 +477,87 @@ class Watchdog:
             )
         return None
 
+    def _sustained_children(
+        self, family: str, threshold: float
+    ) -> Tuple[List[str], Optional[Dict[str, str]]]:
+        """Children of ``family`` whose last ``drift_window`` samples all
+        sat at/above ``threshold`` (NaN gaps disqualify — a tenant must
+        be continuously observed to alert). Returns (hit descriptions,
+        first hit's parsed labels)."""
+        hits: List[str] = []
+        first: Optional[Dict[str, str]] = None
+        for name in self.history.children(family):
+            v = self.history.values(name)
+            if v is None or len(v) < self.drift_window:
+                continue
+            tail = v[-self.drift_window:]
+            if np.isnan(tail).any():
+                continue
+            if (tail >= threshold).all():
+                labels = _child_labels(name)
+                hits.append(
+                    f"{labels.get('tenant', name)} (now {tail[-1]:.3f})"
+                )
+                if first is None:
+                    first = labels
+        return hits, first
+
+    def _score_meta(self, labels: Optional[Dict[str, str]]) -> Dict[str, object]:
+        """Snapshot meta naming the drifting tenant and its active kernel
+        variant (fused/K/param_dtype/wire)."""
+        if not labels:
+            return {}
+        meta: Dict[str, object] = {
+            "tenant": labels.get("tenant"),
+            "family": labels.get("family"),
+        }
+        if self.scorehealth is not None and labels.get("tenant"):
+            meta["variant"] = self.scorehealth.variant(labels["tenant"])
+        return meta
+
+    def _rule_score_drift(self):
+        """A tenant's score distribution sat over the PSI drift threshold
+        for the whole drift window — the model serves a different score
+        population than its frozen reference (data drift, a bad
+        hot-swap, or a quantization clipping its tail)."""
+        hits, first = self._sustained_children(
+            "score_quality_psi", self.psi_threshold
+        )
+        if not hits:
+            return None
+        return {
+            "detail": (
+                f"score PSI >= {self.psi_threshold:g} for "
+                f"{self.drift_window_s:g}s: " + ", ".join(hits)
+            ),
+            **self._score_meta(first),
+        }
+
+    def _rule_nan_rate_spike(self):
+        """A tenant's delivered-NaN rate held at/over threshold for the
+        drift window — the model emits garbage (numerics fault, poisoned
+        weights) even though every plumbing metric looks healthy."""
+        hits, first = self._sustained_children(
+            "score_quality_nan_rate", self.nan_rate_threshold
+        )
+        if not hits:
+            return None
+        return {
+            "detail": (
+                f"NaN score rate >= {self.nan_rate_threshold:g} for "
+                f"{self.drift_window_s:g}s: " + ", ".join(hits)
+            ),
+            **self._score_meta(first),
+        }
+
     RULES = (
         ("steady_state_recompile", "_rule_steady_state_recompile"),
         ("h2d_overlap_collapse", "_rule_h2d_overlap_collapse"),
         ("d2h_overlap_collapse", "_rule_d2h_overlap_collapse"),
         ("overload_credit", "_rule_overload_credit"),
         ("d2h_wait_spike", "_rule_d2h_wait_spike"),
+        ("score_drift", "_rule_score_drift"),
+        ("nan_rate_spike", "_rule_nan_rate_spike"),
     )
 
     # -- evaluation ------------------------------------------------------
@@ -464,6 +579,13 @@ class Watchdog:
                 continue
             if detail is None:
                 continue
+            # a rule may return a plain detail string, or a dict carrying
+            # snapshot meta beside it (the score rules name the drifting
+            # tenant and its active kernel variant)
+            meta: Dict[str, object] = {}
+            if isinstance(detail, dict):
+                meta = {k: v for k, v in detail.items() if k != "detail"}
+                detail = detail["detail"]
             last = self._last_fired.get(rule)
             if last is not None and now - last < self.cooldown_s:
                 continue
@@ -473,6 +595,7 @@ class Watchdog:
                 "rule": rule,
                 "detail": detail,
                 "ts_ms": time.time() * 1000.0,
+                **meta,
             }
             self.alerts.append(alert)
             fired.append(alert)
@@ -482,5 +605,7 @@ class Watchdog:
                 # would otherwise throw away
                 self.tracer.force_retain(self.force_retain_s * 1000.0)
             if self.flightrec is not None:
-                self.flightrec.snapshot(f"watchdog:{rule}", detail=detail)
+                self.flightrec.snapshot(
+                    f"watchdog:{rule}", detail=detail, **meta
+                )
         return fired

@@ -398,15 +398,24 @@ class MetricsRegistry:
             h = self._histos.setdefault(name, Histogram(name, unit))
         return h
 
-    def drop_labeled(self, **labels: str) -> int:
+    def drop_labeled(self, families=None, **labels: str) -> int:
         """Remove every labeled child whose labels include ALL the given
         pairs (tenant teardown: a removed tenant's children must not be
         exported forever — label cardinality is bounded by LIVE tenants).
-        Returns the number of children removed."""
+        ``families`` restricts the sweep to those family names — for
+        callers that own only a slice of a tenant's children (e.g. the
+        score-health layer on an engine stop) and must not reset other
+        subsystems' counters mid-run. Returns the number removed."""
         want = {k: str(v) for k, v in labels.items()}
         removed = 0
         with self._reg_lock:
-            for _name, fam in list(self._labeled.items()):
+            items = (
+                [(n, f) for n, f in self._labeled.items()
+                 if n in set(families)]
+                if families is not None
+                else list(self._labeled.items())
+            )
+            for _name, fam in items:
                 for key in [
                     k for k in fam
                     if all(dict(k).get(n) == v for n, v in want.items())

@@ -1,0 +1,570 @@
+"""Score-quality & model-health accounting (the model-OUTPUT observer).
+
+Everything observable so far watches the *plumbing* — latency, overlap,
+MFU, queue depths. Nothing watches what the models actually emit: a
+tenant whose LSTM silently degrades (data drift, a bad hot-swap, an int8
+quantization clipping its score tail) serves garbage at a perfect p99.
+This module closes that gap from the device-side score sketches the
+scoring step now emits (``parallel.sharded`` — one ``int32[T, D, NBINS]``
+histogram per flush, riding the async d2h reaper path):
+
+- **per-(tenant, family) rolling windows** of merged histograms, plus a
+  **frozen reference window** captured after warmup (``warmup_windows``
+  rotations) and re-baselined on explicit activate (param hot-swap / a
+  fresh registration);
+- **drift statistics** on the bin vectors: PSI (population stability
+  index) and KS (max CDF distance) of the current merged window vs the
+  reference, exposed as ``score_quality_psi`` / ``score_quality_ks``
+  gauges the watchdog's ``score_drift`` rule watches;
+- **quantile estimates** (p50/p95/p99 score) interpolated from the
+  log-spaced bins — ``score_quality_p50/p95/p99`` gauges;
+- **delivery-quality rates** folded in from the resolve path
+  (``pipeline.inference``): NaN scores the model emitted and rows that
+  resolved unscored (poisoned flushes, parked families, capacity skips)
+  as ``score_quality_nan_rate`` / ``score_quality_unscored_rate``;
+- **canary status** per family: divergence of shadow-scored flushes vs
+  the serving variant (``score_canary_*`` — see ``ShardedScorer.
+  shadow_step_counts``).
+
+Cardinality is bounded by LIVE tenants × families (registrations are
+explicit; ``remove`` drops the tenant's children via the registry's
+``drop_labeled`` pattern) and every ``score_quality_*`` family is a
+GAUGE (``tools/check_metrics.py`` lints both invariants).
+
+Event-loop-threaded like the flight recorder: the resolve path and the
+REST handlers share the loop; no locks.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sitewhere_tpu.models.common import SKETCH_NBINS
+
+# PSI verdict boundary: the industry-standard "significant shift" line.
+# Shared default for the REST verdict and the watchdog's score_drift rule
+# so an operator sees ONE consistent notion of "drifting".
+PSI_DRIFT_THRESHOLD = 0.25
+
+# PSI runs on a COARSENED histogram: 64 sketch bins are right for
+# quantiles, but PSI's per-bin log-ratio amplifies sampling noise — a
+# ~100-row window against a sparse 64-bin reference reads PSI > 2 from
+# noise alone (an occupied ref bin that drew zero current rows
+# contributes ~0.3 each). Merging adjacent log bins 4:1 (the standard
+# ~10-20-bucket PSI practice) plus Laplace smoothing keeps the healthy
+# noise floor well under the 0.25 threshold while a real shift — mass
+# moving decades across the log axis — still lands far above it.
+PSI_COARSE_BINS = 16
+_PSI_ALPHA = 0.5  # Laplace smoothing pseudo-count per coarse bin
+
+
+def _coarsen(h: np.ndarray, k: int = PSI_COARSE_BINS) -> np.ndarray:
+    n = len(h)
+    if k <= 0 or n % k:
+        return h
+    return h.reshape(k, n // k).sum(axis=1)
+
+
+def psi(ref: np.ndarray, cur: np.ndarray) -> float:
+    """Population stability index between two bin-count vectors
+    (coarsened + smoothed — see PSI_COARSE_BINS), DEBIASED for sample
+    size: under stationary traffic raw PSI's expectation is
+    ≈ (k-1)·(1/n_ref + 1/n_cur) of pure multinomial noise — at a
+    100-row window that alone approaches the 0.25 drift threshold. The
+    analytic bias is subtracted (floored at 0) so the gauge reads ~0 on
+    stationary traffic at ANY window size, while a real shift (score
+    mass moving across log-decades) still lands far above threshold."""
+    p = _coarsen(ref.astype(np.float64))
+    q = _coarsen(cur.astype(np.float64))
+    ps, qs = p.sum(), q.sum()
+    if ps <= 0 or qs <= 0:
+        return 0.0
+    k = len(p)
+    p = (p + _PSI_ALPHA) / (ps + _PSI_ALPHA * k)
+    q = (q + _PSI_ALPHA) / (qs + _PSI_ALPHA * k)
+    raw = float(((q - p) * np.log(q / p)).sum())
+    bias = (k - 1) * (1.0 / ps + 1.0 / qs)
+    return max(0.0, raw - bias)
+
+
+def ks_stat(ref: np.ndarray, cur: np.ndarray) -> float:
+    """Kolmogorov–Smirnov distance (max |ΔCDF|) between two bin-count
+    vectors over the same edges."""
+    p = ref.astype(np.float64)
+    q = cur.astype(np.float64)
+    ps, qs = p.sum(), q.sum()
+    if ps <= 0 or qs <= 0:
+        return 0.0
+    return float(np.abs(np.cumsum(p / ps) - np.cumsum(q / qs)).max())
+
+
+def hist_quantile(hist: np.ndarray, edges: np.ndarray, q: float) -> float:
+    """Quantile estimate from a fixed-bin histogram: linear interpolation
+    within the crossing bin. ``edges`` are the NBINS-1 interior edges
+    (bin 0 = [0, edges[0]), top bin open — capped at 2× its left edge
+    for the interpolation). Vectorized (cumsum + searchsorted): this
+    runs three times per rotating tenant on the resolve-path tick, and
+    at full flush rate every tenant can rotate every flush."""
+    n = int(hist.sum())
+    if n <= 0:
+        return 0.0
+    target = q * n
+    c = np.cumsum(hist)
+    i = int(np.searchsorted(c, target))
+    if i >= len(hist):
+        return float(edges[-1]) * 2.0
+    lo = float(edges[i - 1]) if i > 0 else 0.0
+    hi = float(edges[i]) if i < len(edges) else float(edges[-1]) * 2.0
+    prev = float(c[i - 1]) if i > 0 else 0.0
+    frac = (target - prev) / max(float(hist[i]), 1.0)
+    return lo + frac * max(hi - lo, 0.0)
+
+
+def canary_divergence(
+    serving: np.ndarray, shadow: np.ndarray, k: int = 64
+) -> Optional[Tuple[float, float, int]]:
+    """THE canary verdict math, shared by the resolve path and bench so
+    their divergence columns can never drift apart: over rows BOTH
+    variants scored finitely, the mean |serving − shadow| and the
+    fraction of the serving top-k rows the shadow also ranks top-k.
+    Returns (mean_abs_delta, topk_agreement, n_rows) or None when no
+    row is comparable."""
+    ok = np.isfinite(serving) & np.isfinite(shadow)
+    n = int(ok.sum())
+    if n == 0:
+        return None
+    a = serving[ok]
+    b = shadow[ok]
+    mean_abs = float(np.abs(a - b).mean())
+    kk = min(int(k), n)
+    top_a = np.argpartition(a, n - kk)[n - kk:]
+    top_b = np.argpartition(b, n - kk)[n - kk:]
+    agree = float(np.intersect1d(top_a, top_b).size) / kk
+    return mean_abs, agree, n
+
+
+class _TenantHealth:
+    """Rolling score-distribution state for one (tenant, family)."""
+
+    __slots__ = (
+        "tenant", "family", "slot", "variant", "cur", "cur_rows",
+        "windows", "ref", "ref_rows", "nan_window", "unscored_window",
+        "nan_rate", "unscored_rate", "psi", "ks", "quantiles",
+        "rows_total", "nan_total", "unscored_total", "last_rotate",
+        "skipped", "last_eval",
+    )
+
+    def __init__(self, tenant: str, family: str, slot: int,
+                 variant: Dict[str, object], nbins: int, now: float) -> None:
+        self.tenant = tenant
+        self.family = family
+        self.slot = slot
+        self.variant = dict(variant)
+        self.cur = np.zeros((nbins,), np.int64)
+        self.cur_rows = 0
+        self.windows: deque = deque()
+        self.ref: Optional[np.ndarray] = None
+        self.ref_rows = 0
+        self.nan_window = 0
+        self.unscored_window = 0
+        self.nan_rate = 0.0
+        self.unscored_rate = 0.0
+        self.psi: Optional[float] = None
+        self.ks: Optional[float] = None
+        self.quantiles: Dict[str, float] = {}
+        self.rows_total = 0
+        self.nan_total = 0
+        self.unscored_total = 0
+        self.last_rotate = now
+        self.skipped = 0  # cold-start windows discarded pre-reference
+        self.last_eval: Optional[float] = None  # stats rate limiter
+
+
+class ScoreHealth:
+    """Per-tenant score-distribution health over device-side sketches.
+
+    The resolve path feeds ``ingest_sketch`` one merged ``[T, NBINS]``
+    histogram per flush (slots map to tenants via ``register``); windows
+    rotate every ``window_rows`` scored rows (or ``window_s`` seconds via
+    :meth:`refresh` for slow streams), drift/quantile gauges update on
+    rotation, and the first ``warmup_windows`` rotations freeze into the
+    reference the drift statistics compare against.
+    """
+
+    def __init__(
+        self,
+        registry,
+        nbins: int = SKETCH_NBINS,
+        window_rows: int = 1024,
+        max_windows: int = 8,
+        warmup_windows: int = 2,
+        skip_windows: int = 1,
+        window_s: float = 10.0,
+        min_eval_interval_s: float = 0.25,
+        psi_threshold: float = PSI_DRIFT_THRESHOLD,
+        clock=time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self.nbins = int(nbins)
+        self.window_rows = int(window_rows)
+        self.max_windows = int(max_windows)
+        self.warmup_windows = int(warmup_windows)
+        # cold-start discard: the first window(s) after (re)baseline mix
+        # still-filling stream windows into the score distribution — a
+        # reference frozen over them would read healthy steady state as
+        # drift forever
+        self.skip_windows = int(skip_windows)
+        self.window_s = float(window_s)
+        # stats rate limiter: at saturation every tenant can rotate every
+        # flush, and per-rotation PSI/KS/quantiles + labeled-gauge
+        # lookups are ~150 µs of loop-thread work per tenant — bound it
+        # to 1/interval evaluations per tenant per second (windows still
+        # rotate; the FIRST rotation after (re)baseline always evaluates;
+        # 0 = evaluate every rotation, used by fast unit tests)
+        self.min_eval_interval_s = float(min_eval_interval_s)
+        self.psi_threshold = float(psi_threshold)
+        self._clock = clock
+        self._tenants: Dict[str, _TenantHealth] = {}
+        # (family, slot) → tenant key: the resolve path indexes sketches
+        # by stacked slot, never by name
+        self._slots: Dict[Tuple[str, int], str] = {}
+        self._edges: Dict[str, np.ndarray] = {}     # family → interior edges
+        self._canary: Dict[str, dict] = {}          # family → last canary
+        registry.describe(
+            "score_quality_psi",
+            "population stability index of the current score window vs "
+            "the frozen reference (drift when sustained over threshold)",
+        )
+        registry.describe(
+            "score_quality_ks",
+            "KS distance (max CDF delta) current score window vs reference",
+        )
+        registry.describe(
+            "score_quality_nan_rate",
+            "fraction of delivered rows whose score was NaN, per window",
+        )
+        registry.describe(
+            "score_quality_unscored_rate",
+            "fraction of delivered rows resolved unscored, per window",
+        )
+        registry.describe(
+            "score_canary_mean_abs_delta",
+            "mean |serving - shadow(previous variant)| score over "
+            "shadow-scored flushes",
+        )
+        registry.describe(
+            "score_canary_topk_agreement",
+            "fraction of the serving top-k rows the shadow variant also "
+            "ranks top-k",
+        )
+
+    # -- registration ----------------------------------------------------
+    def register(
+        self,
+        tenant: str,
+        family: str,
+        slot: int,
+        edges: np.ndarray,
+        variant: Optional[Dict[str, object]] = None,
+    ) -> None:
+        """(Re)bind a tenant to its stacked slot. A NEW registration (or a
+        re-register after remove — tenant restart / param hot-swap at
+        engine start) starts from a fresh, un-baselined state; a pure slot
+        move (failover) keeps the history — the model didn't change."""
+        self._edges[family] = np.asarray(edges, np.float32)
+        th = self._tenants.get(tenant)
+        if th is not None and th.family == family:
+            # slot re-map (failover): keep distributions and reference
+            self._slots.pop((family, th.slot), None)
+            th.slot = int(slot)
+            if variant is not None:
+                th.variant = dict(variant)
+        else:
+            if th is not None:
+                self._slots.pop((th.family, th.slot), None)
+            th = self._tenants[tenant] = _TenantHealth(
+                tenant, family, int(slot), variant or {}, self.nbins,
+                self._clock(),
+            )
+        self._slots[(family, int(slot))] = tenant
+
+    def rebaseline(self, tenant: str) -> bool:
+        """Drop the frozen reference and rolling windows — the warmup
+        restarts from live traffic. Called on explicit (re)activation of
+        a tenant's params so the drift statistics compare against the
+        CURRENT model, not its predecessor's output distribution."""
+        th = self._tenants.get(tenant)
+        if th is None:
+            return False
+        th.ref = None
+        th.ref_rows = 0
+        th.windows.clear()
+        th.cur[:] = 0
+        th.cur_rows = 0
+        th.nan_window = 0
+        th.unscored_window = 0
+        th.psi = None
+        th.ks = None
+        th.skipped = 0
+        th.last_eval = None
+        th.last_rotate = self._clock()
+        return True
+
+    # every per-tenant gauge family this module owns — the ONLY children
+    # remove() may drop. An engine stop also runs on hot reconfigure
+    # (stop → start with the tenant still live), so sweeping all
+    # tenant-labeled families here would reset other subsystems'
+    # cumulative counters (pipeline_expired_total, replay_*) mid-run;
+    # full-teardown cleanup stays with instance.remove_tenant.
+    TENANT_FAMILIES = (
+        "score_quality_psi", "score_quality_ks",
+        "score_quality_p50", "score_quality_p95", "score_quality_p99",
+        "score_quality_nan_rate", "score_quality_unscored_rate",
+    )
+
+    def remove(self, tenant: str) -> None:
+        th = self._tenants.pop(tenant, None)
+        if th is None:
+            return
+        self._slots.pop((th.family, th.slot), None)
+        # cardinality guard: a removed tenant's score-health gauges must
+        # not be exported forever — scoped to THIS module's families
+        self.registry.drop_labeled(
+            families=self.TENANT_FAMILIES, tenant=tenant
+        )
+
+    def variant(self, tenant: str) -> Dict[str, object]:
+        th = self._tenants.get(tenant)
+        return dict(th.variant) if th is not None else {}
+
+    # -- ingest (the resolve-path hot feed) ------------------------------
+    def ingest_sketch(
+        self,
+        family: str,
+        hist: np.ndarray,                    # i64/i32 [T, NBINS] merged over D
+        nan_by_slot: Optional[np.ndarray] = None,   # i64 [T] NaN rows
+    ) -> None:
+        """Fold one flush's device sketch into every registered tenant of
+        the family. Vectorized per SLOT (≤ stacked slots per flush, never
+        per row); slots with no rows and no NaNs are skipped."""
+        rows = hist.sum(axis=1)
+        if nan_by_slot is None:
+            touched = np.flatnonzero(rows)
+        else:
+            touched = np.flatnonzero(rows + nan_by_slot)
+        now = self._clock()
+        for slot in touched.tolist():
+            tenant = self._slots.get((family, slot))
+            if tenant is None:
+                continue
+            th = self._tenants[tenant]
+            n = int(rows[slot])
+            th.cur += hist[slot]
+            th.cur_rows += n
+            th.rows_total += n
+            if nan_by_slot is not None and nan_by_slot[slot]:
+                k = int(nan_by_slot[slot])
+                th.nan_window += k
+                th.nan_total += k
+                th.rows_total += k
+            # rotation triggers on TOTAL delivered rows — a tenant whose
+            # model emits 100% NaN must still rotate, or its nan_rate
+            # gauge (and the nan_rate_spike rule) would never publish
+            if (
+                th.cur_rows + th.nan_window + th.unscored_window
+                >= self.window_rows
+            ):
+                self._rotate(th, now)
+
+    def note_unscored(self, tenant: str, n: int) -> None:
+        """Rows delivered unscored (poisoned flush / parked family /
+        breaker drain) — folded into the tenant's delivery-quality rates."""
+        th = self._tenants.get(tenant)
+        if th is None or n <= 0:
+            return
+        th.unscored_window += int(n)
+        th.unscored_total += int(n)
+        th.rows_total += int(n)
+        if (
+            th.cur_rows + th.nan_window + th.unscored_window
+            >= self.window_rows
+        ):
+            self._rotate(th, self._clock())
+
+    def canary_note(
+        self, family: str, mean_abs_delta: float, topk_agreement: float,
+        rows: int,
+    ) -> None:
+        """One shadow-scored flush's divergence verdict (resolve path)."""
+        self.registry.gauge(
+            "score_canary_mean_abs_delta", family=family
+        ).set(mean_abs_delta)
+        self.registry.gauge(
+            "score_canary_topk_agreement", family=family
+        ).set(topk_agreement)
+        self.registry.counter(
+            "score_canary_flushes_total", family=family
+        ).inc()
+        self._canary[family] = {
+            "mean_abs_delta": round(float(mean_abs_delta), 6),
+            "topk_agreement": round(float(topk_agreement), 4),
+            "rows": int(rows),
+            "flushes": self.registry.counter(
+                "score_canary_flushes_total", family=family
+            ).value,
+        }
+
+    # -- window rotation / statistics ------------------------------------
+    def _rotate(self, th: _TenantHealth, now: float) -> None:
+        if th.ref is None and th.skipped < self.skip_windows:
+            # cold-start discard (see skip_windows): neither reference
+            # nor rolling state sees this window
+            th.skipped += 1
+            th.cur[:] = 0
+            th.cur_rows = 0
+            th.nan_window = 0
+            th.unscored_window = 0
+            th.last_rotate = now
+            return
+        th.windows.append(th.cur.copy())
+        while len(th.windows) > self.max_windows:
+            th.windows.popleft()
+        total = th.cur_rows + th.nan_window + th.unscored_window
+        th.nan_rate = th.nan_window / total if total else 0.0
+        th.unscored_rate = th.unscored_window / total if total else 0.0
+        if th.ref is None and len(th.windows) >= self.warmup_windows:
+            # warmup complete: freeze the reference the drift statistics
+            # compare against until an explicit re-baseline
+            th.ref = np.sum(np.stack(th.windows), axis=0)
+            th.ref_rows = int(th.ref.sum())
+            th.windows.clear()
+        if (
+            th.last_eval is None
+            or now - th.last_eval >= self.min_eval_interval_s
+        ):
+            th.last_eval = now
+            self._evaluate(th)
+        th.cur = np.zeros((self.nbins,), np.int64)
+        th.cur_rows = 0
+        th.nan_window = 0
+        th.unscored_window = 0
+        th.last_rotate = now
+
+    def _evaluate(self, th: _TenantHealth) -> None:
+        """Recompute drift statistics / quantiles / rates and publish the
+        tenant's gauges (the rate-limited half of a rotation)."""
+        merged = (
+            np.sum(np.stack(th.windows), axis=0) if th.windows else th.cur
+        )
+        labels = {"family": th.family, "tenant": th.tenant}
+        if th.ref is not None:
+            th.psi = psi(th.ref, merged)
+            th.ks = ks_stat(th.ref, merged)
+            self.registry.gauge("score_quality_psi", **labels).set(th.psi)
+            self.registry.gauge("score_quality_ks", **labels).set(th.ks)
+        edges = self._edges.get(th.family)
+        if edges is not None and merged.sum() > 0:
+            th.quantiles = {
+                q: hist_quantile(merged, edges, p)
+                for q, p in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
+            }
+            for q, v in th.quantiles.items():
+                self.registry.gauge(f"score_quality_{q}", **labels).set(v)
+        self.registry.gauge("score_quality_nan_rate", **labels).set(
+            th.nan_rate
+        )
+        self.registry.gauge("score_quality_unscored_rate", **labels).set(
+            th.unscored_rate
+        )
+
+    def refresh(self) -> None:
+        """Time-based rotation for slow streams (instance history tick):
+        a tenant trickling 10 rows/s must still rotate windows and keep
+        its drift gauges live instead of waiting hours for window_rows.
+        Also flushes any evaluation the rate limiter suppressed on a
+        tenant's LAST rotation (an idle tenant must not pin stale
+        gauges until its next rotation)."""
+        now = self._clock()
+        for th in list(self._tenants.values()):
+            if (
+                th.cur_rows + th.nan_window + th.unscored_window > 0
+                and now - th.last_rotate >= self.window_s
+            ):
+                self._rotate(th, now)
+            elif (
+                th.last_eval is not None
+                and th.last_rotate > th.last_eval
+                and now - th.last_eval >= self.min_eval_interval_s
+            ):
+                th.last_eval = now
+                self._evaluate(th)
+
+    # -- reports (REST surface) ------------------------------------------
+    def verdict(self, th: _TenantHealth) -> str:
+        if th.ref is None:
+            return "warming"
+        if th.psi is not None and th.psi >= self.psi_threshold:
+            return "drifting"
+        return "ok"
+
+    def health_report(self, tenant: str) -> Optional[dict]:
+        """The ``GET /api/tenants/{t}/health`` body."""
+        th = self._tenants.get(tenant)
+        if th is None:
+            return None
+        return {
+            "tenant": th.tenant,
+            "family": th.family,
+            "verdict": self.verdict(th),
+            "psi": None if th.psi is None else round(th.psi, 4),
+            "ks": None if th.ks is None else round(th.ks, 4),
+            "psi_threshold": self.psi_threshold,
+            "quantiles": {
+                k: round(v, 6) for k, v in th.quantiles.items()
+            },
+            "rates": {
+                "nan": round(th.nan_rate, 6),
+                "unscored": round(th.unscored_rate, 6),
+            },
+            "rows_total": th.rows_total,
+            "nan_total": th.nan_total,
+            "unscored_total": th.unscored_total,
+            "reference_rows": th.ref_rows,
+            "variant": dict(th.variant),
+            "canary": self._canary.get(th.family),
+        }
+
+    def dist_report(self, tenant: str) -> Optional[dict]:
+        """The ``GET /api/tenants/{t}/scores/dist`` body: bin edges plus
+        the current (rolling + accumulating) and reference histograms."""
+        th = self._tenants.get(tenant)
+        if th is None:
+            return None
+        edges = self._edges.get(th.family)
+        merged = (
+            np.sum(np.stack(th.windows), axis=0)
+            if th.windows else np.zeros((self.nbins,), np.int64)
+        ) + th.cur
+        return {
+            "tenant": th.tenant,
+            "family": th.family,
+            "nbins": self.nbins,
+            "edges": [] if edges is None else [float(e) for e in edges],
+            "current": [int(x) for x in merged],
+            "reference": (
+                None if th.ref is None else [int(x) for x in th.ref]
+            ),
+            "current_rows": int(merged.sum()),
+            "reference_rows": th.ref_rows,
+        }
+
+    def describe(self) -> List[dict]:
+        return [
+            r for r in (
+                self.health_report(t) for t in sorted(self._tenants)
+            ) if r is not None
+        ]

@@ -18,6 +18,7 @@ from contextlib import asynccontextmanager
 from pathlib import Path
 
 import numpy as np
+import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from sitewhere_tpu.api.rest import make_app
@@ -279,6 +280,58 @@ async def test_live_attribution_end_to_end():
             assert resp.status == 200
             assert hist["series"]["tpu_inference.flushes"]
             assert len(hist["age_s"]) == hist["samples"]
+
+
+async def test_shadow_canary_never_inflates_mfu_accounting():
+    """ISSUE-9 MfuAccount audit: with the canary shadow-scoring EVERY
+    flush (canary_frac=1.0, standing bf16 variant), tpu_flops_total must
+    equal flushes × plane × the SERVING variant's per-row flops exactly
+    — zero shadow contamination — while the shadow work lands in its own
+    tpu_shadow_flops_total counter. The MFU meter (the idle-decay tick)
+    must carry only the primary marks: a shadow flush marking it would
+    both inflate the live gauge and keep an idle family's decay alive."""
+    mb = MicroBatchConfig(max_batch=64, deadline_ms=5.0, buckets=(64,),
+                          window=32)
+    async with booted(
+        "t1", microbatch=mb, param_dtype="bf16", canary_frac=1.0,
+    ) as (inst, rt):
+        await ingest(inst, "t1", 200)
+        await wait_persisted(rt, 200)
+        m = inst.metrics
+        scorer = inst.inference.scorers["lstm_ad"]
+        assert scorer.param_dtype == "bf16" and scorer.canary_frac == 1.0
+        flushes = m.counter("tpu_inference.flushes").value
+        canary = m.counter("tpu_inference.canary_flushes").value
+        assert flushes >= 1 and canary == flushes  # frac 1.0, standing
+        primary = m.counter("tpu_flops_total", family="lstm_ad").value
+        shadow = m.counter("tpu_shadow_flops_total", family="lstm_ad").value
+        # exact expected totals from the same per-flush functions the
+        # service uses — equality IS the no-inflation proof
+        assert primary == pytest.approx(
+            flushes * scorer.flops_per_flush(64), rel=1e-6
+        )
+        assert shadow == pytest.approx(
+            canary * scorer.shadow_flops_per_flush(64), rel=1e-6
+        )
+        # the shadow count is the LEGACY (per-step head, full width)
+        # count — genuinely different work than the fused k=1 variant
+        assert scorer.shadow_flops_per_flush(64) > scorer.flops_per_flush(64)
+        # idle-decay meter carries only primary marks: its windowed mass
+        # equals the primary counter, not primary+shadow
+        acc = inst.inference._mfu["lstm_ad"]
+        marked = sum(n for _ts, n in acc._meter._events)
+        assert marked == pytest.approx(primary, rel=1e-6)
+        # divergence verdicts reached the canary surface
+        assert m.counter(
+            "score_canary_flushes_total", family="lstm_ad"
+        ).value == canary
+        delta = m.gauge(
+            "score_canary_mean_abs_delta", family="lstm_ad"
+        ).value
+        assert 0.0 <= delta < 0.05  # bf16 vs f32 master: cast noise only
+        rep = inst.tenant_health_report("t1")
+        assert rep["canary"]["flushes"] == canary
+        assert rep["variant"]["param_dtype"] == "bf16"
 
 
 # -- (b) breaker trip → snapshot over REST -------------------------------

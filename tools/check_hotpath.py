@@ -58,8 +58,17 @@ HOT_PATHS: Dict[str, List[str]] = {
         "TpuInferenceService._resolve_rows",
         "TpuInferenceService._reap_loop",
         "TpuInferenceService._resolve_flush",
+        "TpuInferenceService._canary_compare",
         "_LaneRing.push",
         "_LaneRing.pop_into",
+    ],
+    # the score-quality feed runs once per resolved flush at full ingest
+    # rate: sketches fold in as vectorized 64-bin adds per touched slot,
+    # never per-row Python (docs/OBSERVABILITY.md "Score health")
+    "runtime/scorehealth.py": [
+        "ScoreHealth.ingest_sketch",
+        "ScoreHealth.note_unscored",
+        "ScoreHealth.canary_note",
     ],
     "pipeline/media.py": [
         "MediaClassificationPipeline.submit_chunk",

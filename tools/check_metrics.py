@@ -18,7 +18,15 @@ stdin) and fails on malformed exposition lines:
   (``trace_id``, ``span_id``, ``seq``, …) are findings, and a family
   exceeding ``MAX_CHILDREN`` distinct label-value tuples is flagged as
   unbounded cardinality (labels must track live tenants / families /
-  devices, never per-event identity).
+  devices, never per-event identity);
+- per-bin expositions must stay sketch-sized: a ``*_bucket``-suffixed
+  family, or any family carrying a ``bin``/``le`` label, may expose at
+  most ``SKETCH_MAX_BINS`` distinct bin values (the device-side score
+  sketch is NBINS=64 fixed bins — anything past that is a runaway bin
+  axis, the per-bin analog of unbounded label cardinality);
+- ``score_quality_*`` families are GAUGES by contract (current state of
+  a rolling window, never monotonic): one declared as a counter — or
+  wearing the ``_total`` suffix — is a finding.
 
 Used two ways: ``python tools/check_metrics.py`` boots a small instance,
 drives events through the pipeline, and lints the scrape (exit 1 on
@@ -52,6 +60,15 @@ UNBOUNDED_LABEL_NAMES = frozenset({
 # it unbounded (live tenants × stages × devices lands far below this;
 # per-event identity blows past it immediately)
 MAX_CHILDREN = 1000
+
+# distinct bin values a per-bin family (``*_bucket`` suffix or a
+# ``bin``/``le`` label) may expose — the device-side score sketch's NBINS
+# (models.common.SKETCH_NBINS; kept as a literal so the lint stays
+# importable without the model stack)
+SKETCH_MAX_BINS = 64
+
+# label names that enumerate histogram bins (per-bin cardinality rule)
+BIN_LABEL_NAMES = ("bin", "le")
 
 
 def _parse_labels(block: str) -> Tuple[Dict[str, str], str]:
@@ -105,6 +122,7 @@ def lint_exposition(
     require_labeled_total: bool = True,
     require_eof: bool = True,
     max_children: int = MAX_CHILDREN,
+    max_bins: int = SKETCH_MAX_BINS,
 ) -> List[str]:
     """Lint one exposition payload; returns a list of findings (empty =
     conformant)."""
@@ -112,6 +130,7 @@ def lint_exposition(
     types: Dict[str, str] = {}
     helps: set = set()
     children: Dict[str, set] = {}  # family → distinct label tuples
+    bins: Dict[str, set] = {}      # family → distinct bin/le values
     lines = text.splitlines()
     if require_eof:
         tail = next((l for l in reversed(lines) if l.strip()), "")
@@ -181,6 +200,20 @@ def lint_exposition(
                 f"line {lineno}: gauge {name} carries the _total suffix "
                 f"(counters only)"
             )
+        if fam.startswith("score_quality_") and kind == "counter":
+            # the score-quality family is rolling-window STATE (gauges);
+            # a counter here means someone aggregated it wrong upstream
+            errors.append(
+                f"line {lineno}: {name} — score_quality_* families are "
+                f"gauges by contract, not counters"
+            )
+        for bl in BIN_LABEL_NAMES:
+            if bl in labels:
+                bins.setdefault(fam, set()).add(labels[bl])
+        if name.endswith("_bucket"):
+            bins.setdefault(fam, set()).add(
+                labels.get("le", labels.get("bin", name))
+            )
         bad_names = UNBOUNDED_LABEL_NAMES & real_labels.keys()
         if bad_names:
             errors.append(
@@ -196,6 +229,13 @@ def lint_exposition(
             errors.append(
                 f"family {fam} has {len(tuples)} labeled children "
                 f"(> {max_children}) — unbounded label set"
+            )
+    for fam, vals in sorted(bins.items()):
+        if len(vals) > max_bins:
+            errors.append(
+                f"family {fam} exposes {len(vals)} distinct bins "
+                f"(> {max_bins}) — per-bin exposition must stay "
+                f"sketch-sized (SKETCH_MAX_BINS)"
             )
     return errors
 
