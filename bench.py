@@ -936,6 +936,187 @@ def bench_e2e_multitenant(secs: float, **kw) -> dict:
     return asyncio.run(_bench_e2e_multitenant(secs, **kw))
 
 
+# ---------------------------------------------------------------- config 7
+async def _bench_mesh(
+    secs: float,
+    n_tenants: int = 8,
+    tenant_axis: int = 4,
+    data_axis: int = 2,
+    devices_per_tenant: int = 2,
+    burst: int = 64,
+) -> dict:
+    """Multi-chip serving row (ISSUE 11): tenants spread over the
+    tenant×data mesh, each slice flushing through its OWN scorer/staging/
+    reap queue. Reports total and PER-DEVICE ev/s, slice balance
+    (min/max per-device rows — 1.0 = perfectly even) and cross-slice
+    busy-time skew. Needs ≥ tenant_axis×data_axis devices; the full-run
+    driver reaches it through ``bench_mesh_subprocess`` on single-chip
+    rigs (forced-host 8-device CPU, the MULTICHIP dryrun pattern)."""
+    import jax
+
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        MicroBatchConfig,
+    )
+    from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+    need = tenant_axis * data_axis
+    if len(jax.devices()) < need:
+        return {"error": f"needs {need} devices, have {len(jax.devices())}"}
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="mesh",
+        mesh=MeshConfig(
+            tenant_axis=tenant_axis, data_axis=data_axis,
+            slots_per_shard=max(1, n_tenants // tenant_axis),
+        ),
+        inference_max_inflight=2 * tenant_axis,
+    ))
+    await inst.start()
+    try:
+        mb = MicroBatchConfig(
+            max_batch=4096, deadline_ms=5.0,
+            buckets=(1024, 4096), window=32,
+        )
+        for i in range(n_tenants):
+            await inst.tenant_management.create_tenant(
+                f"mt{i:02d}", template="iot-temperature", microbatch=mb,
+                decoder="binary", max_streams=1024, wire_dtype="bf16",
+                model_config={"hidden": 32},
+            )
+        await inst.drain_tenant_updates()
+        for _ in range(300):
+            if len(inst.tenants) == n_tenants:
+                break
+            await asyncio.sleep(0.05)
+        svc = inst.inference
+        slices = sorted({e.placement.shard for e in svc.engines.values()})
+        sims = []
+        for i in range(n_tenants):
+            tok = f"mt{i:02d}"
+            inst.tenants[tok].device_management.bootstrap_fleet(
+                devices_per_tenant
+            )
+            sims.append(DeviceSimulator(
+                inst.broker,
+                SimProfile(n_devices=devices_per_tenant, seed=i,
+                           samples_per_message=burst, wire="binary"),
+                topic_pattern=f"sitewhere/{tok}/input/{{device}}",
+            ))
+        await asyncio.get_running_loop().run_in_executor(
+            None, svc.prewarm
+        )
+        for s in sims:
+            await s.publish_round(0.0)
+        scored = inst.metrics.counter("tpu_inference.scored_total")
+        warm = n_tenants * devices_per_tenant * burst
+        for _ in range(600):
+            if scored.value >= warm:
+                break
+            await asyncio.sleep(0.05)
+        labels = [svc.mm.slice_device_label(sl) for sl in slices]
+        rows_c = {
+            lbl: inst.metrics.counter(
+                "tpu_inference_device_rows_total", device=lbl
+            )
+            for lbl in labels
+        }
+        busy_c = {
+            lbl: inst.metrics.counter(
+                "tpu_device_busy_seconds_total", family="lstm_ad",
+                device=lbl,
+            )
+            for lbl in labels
+        }
+        rows0 = {lbl: c.value for lbl, c in rows_c.items()}
+        busy0 = {lbl: c.value for lbl, c in busy_c.items()}
+        start = scored.value
+        rounds = [s.pregenerate(16, t0=1.0) for s in sims]
+        t0 = time.perf_counter()
+        step = 0
+        while time.perf_counter() - t0 < secs:
+            rr = step % 16
+            for s, r in zip(sims, rounds):
+                await s.publish_pregenerated(r[rr])
+            step += 1
+            await asyncio.sleep(0)
+        pumped = step * warm
+        for _ in range(1200):
+            if scored.value - start >= pumped:
+                break
+            await asyncio.sleep(0.05)
+        dt = time.perf_counter() - t0
+        n = scored.value - start
+        per_dev_rows = {
+            lbl: c.value - rows0[lbl] for lbl, c in rows_c.items()
+        }
+        per_dev_busy = {
+            lbl: round(c.value - busy0[lbl], 3)
+            for lbl, c in busy_c.items()
+        }
+        row_vals = [v for v in per_dev_rows.values()]
+        busy_vals = [v for v in per_dev_busy.values()]
+        balance = (
+            round(min(row_vals) / max(row_vals), 4)
+            if row_vals and max(row_vals) > 0 else None
+        )
+        skew = (
+            round((max(busy_vals) - min(busy_vals)) / max(busy_vals), 4)
+            if busy_vals and max(busy_vals) > 0 else None
+        )
+        return {
+            "events_per_sec": n / dt,
+            "n_tenants": n_tenants,
+            "n_devices": need,
+            "n_slices": len(slices),
+            "axes": {"tenant": tenant_axis, "data": data_axis},
+            "duration_s": dt,
+            "scored": int(n),
+            "per_device_ev_s": {
+                lbl: round(v / dt, 1) for lbl, v in per_dev_rows.items()
+            },
+            # min/max per-device rows: 1.0 = every chip carried the
+            # same load; the router's least-loaded placement owns this
+            "mesh_balance": balance,
+            # (max-min)/max per-device busy seconds: how unevenly chip
+            # TIME was spent (a hot model on one slice shows here even
+            # when row counts balance)
+            "cross_slice_skew": skew,
+            "per_device_busy_s": per_dev_busy,
+            "slice_moves": int(
+                inst.metrics.counter("tpu_inference.slice_moves").value
+            ),
+            **result_path_stats(inst.metrics),
+        }
+    finally:
+        await inst.terminate()
+
+
+def bench_mesh(secs: float, **kw) -> dict:
+    return asyncio.run(_bench_mesh(secs, **kw))
+
+
+def bench_mesh_subprocess(secs: float) -> dict:
+    """Run the mesh config on a forced-host 8-device CPU platform in a
+    fresh process — the MULTICHIP dryrun pattern, giving single-chip
+    rigs an 8-device serving row. On a real multi-chip host the parent
+    runs ``bench_mesh`` inline on the accelerators instead."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    return _run_bench_subprocess(
+        ["--configs", "mesh8", "--backend", "cpu",
+         "--e2e-secs", str(secs)],
+        "mesh8", timeout_s=900, env=env,
+    )
+
+
 # ---------------------------------------------------------------- config 6
 def _storage_batches(n_rows: int, burst: int = 8192, n_devices: int = 64,
                      t0_ms: float = 0.0, span_ms: float = 3_600_000.0):
@@ -1226,7 +1407,7 @@ def main() -> None:
     args = p.parse_args()
     which = set(args.configs.split(",")) if args.configs != "all" else {
         "e2e", "e2e-json", "e2e-cpu", "e2e-32t", "lstm", "deepar",
-        "tenants32", "vit", "storage"
+        "tenants32", "vit", "storage", "mesh8"
     }
 
     import jax
@@ -1384,6 +1565,24 @@ def main() -> None:
         else:
             log(f"  -> FAILED: {st['error'][:300]}")
 
+    if "mesh8" in which:
+        log("config 7: multi-chip serving (8-device mesh, per-slice "
+            "flush/stage/reap) ...")
+        if details["n_devices"] >= 8:
+            details["mesh8"] = bench_mesh(min(args.e2e_secs, 8.0))
+        else:
+            # single-chip rig: forced-host 8-device CPU child (the
+            # MULTICHIP dryrun pattern) — structure proof, not a chip
+            # throughput figure
+            details["mesh8"] = bench_mesh_subprocess(min(args.e2e_secs, 8.0))
+        m8 = details["mesh8"]
+        if "error" not in m8:
+            log(f"  -> {m8['events_per_sec']:.0f} ev/s over "
+                f"{m8['n_slices']} slices (balance {m8['mesh_balance']}, "
+                f"busy skew {m8['cross_slice_skew']})")
+        else:
+            log(f"  -> FAILED: {m8['error'][:300]}")
+
     if "e2e-cpu" in which:
         log("config 1c: E2E latency on the CPU backend (RTT=0) ...")
         details["e2e_pipeline_cpu"] = bench_e2e_cpu_subprocess(6.0)
@@ -1490,6 +1689,11 @@ def main() -> None:
         # storage axis (ROADMAP item 5): sealed-segment scan + end-to-end
         # replay-to-rescore through the REAL scoring path, both
         # regression-gated as throughput by tools/check_bench.py
+        # multi-chip serving (ISSUE 11): total ev/s over the 8-device
+        # mesh (throughput-gated in tools/check_bench.py; n/a against
+        # single-chip baselines) + slice row balance (info)
+        "ev_s_8dev": pick(details, "mesh8", "events_per_sec"),
+        "mesh_balance": pick(details, "mesh8", "mesh_balance", nd=3),
         "storage_scan_ev_s": pick(details, "storage", "scan_ev_s"),
         "storage_replay_ev_s": pick(details, "storage", "replay_ev_s"),
         "storage_write_mbps": pick(details, "storage", "write_mbps"),

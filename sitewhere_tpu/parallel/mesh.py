@@ -114,6 +114,54 @@ class MeshManager:
             f"{d.platform}:{d.id}" for d in self.mesh.devices.flat
         ]
 
+    # -- tenant-axis slices (multi-chip serving) -------------------------
+    @property
+    def n_slices(self) -> int:
+        """Independent serving slices = tenant-axis shards. Each slice
+        owns the (data × model) devices at one tenant coordinate and
+        serves its resident tenants with zero cross-slice traffic on the
+        hot path (docs/PERFORMANCE.md "Multi-chip serving")."""
+        return self.n_tenant_shards
+
+    def slice_manager(self, sl: int) -> "MeshManager":
+        """The sub-mesh MeshManager for tenant-axis slice ``sl``: a
+        (tenant=1, data=D, model=M) mesh over exactly that slice's
+        devices. Per-slice scorers built on these sub-meshes dispatch,
+        transfer, and reap independently — one slow chip never
+        serializes another slice's flushes. Cached: slice identity is
+        stable for the lifetime of the mesh."""
+        slices = getattr(self, "_slices", None)
+        if slices is None:
+            slices = self._slices = {}
+        mm = slices.get(sl)
+        if mm is None:
+            if not 0 <= sl < self.n_tenant_shards:
+                raise ValueError(
+                    f"slice {sl} out of range (mesh has "
+                    f"{self.n_tenant_shards} tenant shards)"
+                )
+            devs = list(self.mesh.devices[sl].flat)
+            mm = slices[sl] = MeshManager(
+                tenant=1,
+                data=self.mesh.shape[AXIS_DATA],
+                model=self.mesh.shape[AXIS_MODEL],
+                devices=devs,
+            )
+        return mm
+
+    def slice_device_label(self, sl: int) -> str:
+        """Metric label for the slice's anchor device (its result-path
+        consolidation target — slice-mesh device 0). Cached: callers
+        include per-flush hot paths (reap gauges, device counters)."""
+        labels = getattr(self, "_slice_labels", None)
+        if labels is None:
+            labels = self._slice_labels = {}
+        lbl = labels.get(sl)
+        if lbl is None:
+            d = self.mesh.devices[sl].flat[0]
+            lbl = labels[sl] = f"{d.platform}:{d.id}"
+        return lbl
+
     def describe(self) -> dict:
         return {
             "devices": self.n_devices,

@@ -1,0 +1,147 @@
+"""Partition rules: regex path → PartitionSpec for param/opt-state trees.
+
+The exemplar pattern (SNIPPETS.md [2][3]: ``match_partition_rules`` /
+``make_shard_and_gather_fns``) for placing a model's parameter pytree
+onto a mesh by NAME instead of by hand: each leaf's tree path is matched
+against an ordered rule list, the first hit's ``PartitionSpec`` wins,
+and per-leaf shard/gather callables carry arrays on/off the mesh.
+
+The scoring engine stacks per-tenant params along a leading slot dim
+sharded over the mesh ``tenant`` axis, so the serving entry point here
+is :func:`stacked_specs`: match the rules against the UNSTACKED leaf
+dims, prepend ``AXIS_TENANT``, and drop any named axis that does not
+exist in the mesh or does not divide the leaf dim (a rule must never
+turn into a resharding surprise — an indivisible ask degrades to
+replicated-within-shard, exactly the pre-rules placement).
+
+Optimizer state reuses the same rules: adam moments mirror the param
+tree (same paths → same specs); scalar-per-slot leaves (e.g. the adam
+step count) match no trailing dims and come out ``P(AXIS_TENANT)``-only
+by construction.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sitewhere_tpu.parallel.mesh import AXIS_MODEL, AXIS_TENANT
+
+# ordered (path regex, PartitionSpec over the UNSTACKED leaf dims).
+# Default serving rules: every leaf replicates within its tenant shard —
+# the stacked scoring kernels consume FULL per-slot weights, so a
+# model-axis split here would silently hand each model-parallel device a
+# kernel chunk. Families whose math IS tensor-parallel-aware opt in by
+# declaring ``ModelSpec.partition_rules`` (e.g. MODEL_PARALLEL_RULES
+# below); the stacked_specs guard still drops the axis on model=1
+# meshes and on indivisible dims.
+DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*", P()),
+)
+
+# opt-in rule set for TP-aware families: dense kernels ("<node>/w")
+# shard their output dim over the model axis, biases replicate.
+MODEL_PARALLEL_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*/w$", P(None, AXIS_MODEL)),
+    (r".*", P()),
+)
+
+
+def tree_paths(tree, sep: str = "/") -> List[str]:
+    """Flat ``sep``-joined key paths of ``tree``'s leaves, in leaf order."""
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [
+        sep.join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        for kp, _leaf in paths
+    ]
+
+
+def named_tree_map(fn: Callable, tree, sep: str = "/"):
+    """``tree_map`` handing ``fn`` the leaf's joined key path first —
+    the naming hook ``match_partition_rules`` matches against."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: fn(
+            sep.join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp),
+            leaf,
+        ),
+        tree,
+    )
+
+
+def _first_match(rules: Sequence[Tuple[str, P]], name: str) -> P:
+    for rule, spec in rules:
+        if re.search(rule, name) is not None:
+            return spec
+    raise ValueError(f"no partition rule matched param '{name}'")
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree):
+    """Pytree of PartitionSpec per leaf: first rule whose regex matches
+    the leaf's path wins; scalar leaves never partition."""
+
+    def get_spec(name: str, leaf) -> P:
+        if np.ndim(leaf) == 0 or int(np.prod(np.shape(leaf))) == 1:
+            return P()
+        return _first_match(rules, name)
+
+    return named_tree_map(get_spec, tree)
+
+
+def stacked_specs(rules: Sequence[Tuple[str, P]], tree, mesh: Mesh):
+    """Serving placement for a slot-STACKED tree: per leaf, match the
+    rules against the unstacked dims, prepend the tenant axis, and keep
+    a named axis only when the mesh has it with size > 1 AND it divides
+    the leaf dim it shards — otherwise that dim replicates. The result
+    is always a valid sharding for ``[T, ...]`` stacked leaves and
+    degenerates to ``P(AXIS_TENANT)`` everywhere on model=1 meshes
+    (bit-compatible with the pre-rules placement)."""
+    mesh_shape = dict(mesh.shape)
+
+    def keeps(axis, dim: int) -> bool:
+        return (
+            axis is not None
+            and mesh_shape.get(axis, 1) > 1
+            and dim % mesh_shape[axis] == 0
+        )
+
+    def stack_one(name: str, leaf) -> P:
+        base = tuple(_first_match(rules, name))
+        # .shape-first so abstract leaves (jax.eval_shape templates for
+        # derived trees, e.g. the quantized kernel sidecar) work too
+        leaf_shape = tuple(getattr(leaf, "shape", None) or np.shape(leaf))
+        dims = leaf_shape[1:]  # unstacked dims (leading dim = slots)
+        base = base[: len(dims)] + (None,) * (len(dims) - len(base))
+        kept = tuple(
+            ax if keeps(ax, d) else None for ax, d in zip(base, dims)
+        )
+        return P(AXIS_TENANT, *kept)
+
+    return named_tree_map(stack_one, tree)
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs):
+    """Per-leaf (shard, gather) callables from a spec pytree — the
+    SNIPPETS [2][3] surface. ``shard_fns`` place host/replicated arrays
+    onto the mesh (async ``device_put``); ``gather_fns`` pull them back
+    to host numpy (checkpoint/export)."""
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    shard_fns = jax.tree_util.tree_map(
+        lambda sh: (lambda x, _sh=sh: jax.device_put(x, _sh)), shardings
+    )
+    gather_fns = jax.tree_util.tree_map(
+        lambda _sh: (lambda x: np.asarray(x)), shardings
+    )
+    return shard_fns, gather_fns
+
+
+def shard_tree(tree, shard_fns):
+    """Apply a ``make_shard_and_gather_fns`` shard pytree to an array
+    pytree (leaf-wise device_put onto the rule-derived shardings)."""
+    return jax.tree_util.tree_map(lambda fn, x: fn(x), shard_fns, tree)

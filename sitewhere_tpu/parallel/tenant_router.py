@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 logger = logging.getLogger("sitewhere.tenant_router")
 
@@ -128,6 +128,47 @@ class TenantRouter:
                 )
                 return p
         raise PlacementError(f"no shard available for failover of '{tenant}'")
+
+    def rebalance(self, family: Optional[str] = None) -> List[
+        "Tuple[TenantPlacement, TenantPlacement]"
+    ]:
+        """Even out per-shard load after removes: repeatedly move one
+        tenant from the most-loaded shard to the least-loaded while the
+        gap exceeds one slot (a gap of 1 is already optimal — moving
+        would just swap the imbalance). Deterministic: donor = highest
+        load then highest index, receiver = lowest load then lowest
+        index, migrant = lexicographically-first tenant on the donor,
+        landing slot = lowest free. Returns ``[(old, new), ...]``
+        placements; the CALLER owns migrating live state — the serving
+        layer applies each move through its FIFO-preserving slice fence
+        (``TpuInferenceService.apply_rebalance``)."""
+        moves: List[Tuple[TenantPlacement, TenantPlacement]] = []
+        families = [family] if family is not None else sorted(self._used)
+        for fam in families:
+            used = self._used.get(fam)
+            if used is None:
+                continue
+            while True:
+                load = [len(s) for s in used]
+                donor = max(range(self.n_shards), key=lambda s: (load[s], s))
+                recv = min(range(self.n_shards), key=lambda s: (load[s], s))
+                if load[donor] - load[recv] <= 1:
+                    break
+                tenant = min(self.tenants_on(donor, fam))
+                old = self._placements[tenant]
+                slot = min(set(range(self.slots_per_shard)) - used[recv])
+                used[donor].discard(old.slot)
+                used[recv].add(slot)
+                new = TenantPlacement(
+                    tenant, fam, recv, slot, generation=old.generation + 1
+                )
+                self._placements[tenant] = new
+                moves.append((old, new))
+                logger.info(
+                    "rebalance tenant %s: shard %d.%d → %d.%d",
+                    tenant, old.shard, old.slot, recv, slot,
+                )
+        return moves
 
     # -- introspection ---------------------------------------------------
     def describe(self) -> dict:

@@ -53,6 +53,15 @@ Three latency-hiding moves matter here (SURVEY.md §7 hard parts):
 
 Tenant start/stop flips the scorer's active mask — no recompile; batch-size
 buckets keep XLA at a handful of compiled shapes.
+
+Multi-chip serving (docs/PERFORMANCE.md "Multi-chip serving"): the whole
+pipeline above is instantiated PER (family, mesh-slice) — the router
+places each tenant on a tenant-axis slice, and that slice's scorer,
+lane rings, staging pool, in-flight budget, and reap queue are its own.
+Slices flush concurrently with zero cross-slice collectives; tenant
+moves between slices (failover/rebalance) hold per-tenant FIFO through
+``_SliceFence``. A single-slice mesh degenerates to exactly the
+single-funnel path described above.
 """
 
 from __future__ import annotations
@@ -322,18 +331,26 @@ class _PendingFlush:
     deliver tasks did."""
 
     __slots__ = (
-        "family", "scores", "taken", "moved", "gathered", "t_dispatch",
-        "nbytes", "plane_nbytes", "host_future", "t_wait", "poisoned",
-        "flops", "rec", "sketch", "shadow", "slot_override",
+        "family", "sl", "scores", "taken", "moved", "gathered",
+        "t_dispatch", "nbytes", "plane_nbytes", "host_future", "t_wait",
+        "poisoned", "flops", "rec", "sketch", "shadow", "slot_override",
+        "resolved",
     )
 
     def __init__(
         self, family: str, scores, taken, moved: int, gathered: bool,
         nbytes: int, plane_nbytes: int, poisoned: bool = False,
         flops: float = 0.0, rec: Optional[dict] = None,
-        sketch=None, shadow=None,
+        sketch=None, shadow=None, sl: int = 0,
     ) -> None:
         self.family = family
+        # the mesh slice that ran this flush: reap queues, overlap
+        # probes, and device-labeled attribution are all keyed
+        # (family, slice) on a multi-chip mesh
+        self.sl = sl
+        # set when the flush's resolution finished (either way) — the
+        # slice-move fence waits on this, never on queue identity
+        self.resolved = False
         self.scores = scores
         self.taken = taken
         self.moved = moved
@@ -364,6 +381,10 @@ class _PendingFlush:
         # indices (rows then index row 0 of the slice); this remembers
         # the real slot so NaN attribution survives that path
         self.slot_override: Optional[int] = None
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.family, self.sl)
 
     def _materialize(self):
         """Worker-thread materialization of every device output riding
@@ -402,18 +423,112 @@ class _PendingFlush:
 
 
 class _ReapQueue(list):
-    """Per-family FIFO of in-flight flush completions. Depth is bounded
-    by the ``max_inflight`` semaphore (acquired before rows are popped
-    from lanes) and observable via the ``tpu_inference_deliver_inflight``
-    gauge + ``tpu_inference.deliver_backpressure`` counter
-    (tools/check_queues.py registry). FIFO per family is what gives
-    per-tenant in-order delivery: a tenant lives in exactly one family,
-    and the reaper never resolves past an unfinished head."""
+    """Per-(family, mesh-slice) FIFO of in-flight flush completions —
+    the PER-DEVICE drain queues of the multi-chip result path. Depth is
+    bounded by the ``max_inflight`` semaphore (acquired before rows are
+    popped from lanes) and observable via the
+    ``tpu_inference_deliver_inflight`` gauge (+ per-family and
+    per-device labeled variants) and the
+    ``tpu_inference.deliver_backpressure`` counter
+    (tools/check_queues.py registry). FIFO per (family, slice) is what
+    gives per-tenant in-order delivery: a tenant lives on exactly one
+    slice of one family, the reaper never resolves past an unfinished
+    head, and a slice MOVE (failover/rebalance) holds the tenant's rows
+    behind a ``_SliceFence`` until the old slice's in-flight flushes
+    resolve — so one slow chip's transfers never head-of-line block
+    another slice's deliveries, and ordering still survives the move."""
 
     __slots__ = ()
 
     def popleft(self) -> _PendingFlush:
         return self.pop(0)
+
+
+class AmbiguousFamilyError(KeyError):
+    """A family-string lookup matched MORE than one mesh slice — the
+    caller must key by (family, slice). Distinct from a plain missing
+    key so ``get()`` can default only the truly-absent case."""
+
+
+class _ScorerMap(dict):
+    """(family, slice) → ShardedScorer, with family-string convenience
+    lookup: ``scorers["lstm_ad"]`` resolves when exactly one slice hosts
+    the family (the common single-tenant/operator case); ambiguous
+    lookups must name the slice explicitly."""
+
+    def _resolve(self, family: str):
+        hits = [k for k in self if k[0] == family]
+        if len(hits) == 1:
+            return hits[0]
+        if not hits:
+            raise KeyError(family)
+        raise AmbiguousFamilyError(
+            f"family '{family}' is served on {len(hits)} mesh slices "
+            f"({sorted(k[1] for k in hits)}) — key scorers[(family, slice)]"
+        )
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            key = self._resolve(key)
+        return dict.__getitem__(self, key)
+
+    def __contains__(self, key) -> bool:
+        if isinstance(key, str):
+            return any(k[0] == key for k in self)
+        return dict.__contains__(self, key)
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except AmbiguousFamilyError:
+            # defaulting here would make a multi-slice family look
+            # ABSENT at exactly the moment a slice move spread it
+            raise
+        except KeyError:
+            return default
+
+    def family_items(self, family: str):
+        return sorted(
+            ((k[1], v) for k, v in self.items() if k[0] == family)
+        )
+
+
+class _SliceFence:
+    """Holds one re-placed tenant's rows until every flush that was in
+    flight on its OLD (family, slice) queue at move time has resolved.
+
+    Without the fence, a tenant moving from slice A to slice B could
+    have batch N still riding an unresolved slice-A flush while batch
+    N+1 flushes (and lands) on slice B first — breaking the per-tenant
+    FIFO guarantee the per-slice reap queues otherwise provide. Rows
+    re-keyed off the old lanes AND new bus intake stash here (FIFO
+    ``_LaneRing`` per data shard, counted against the tenant's lane
+    watermark so a long fence backpressures into the bus); the scoring
+    loop lifts the fence when the snapshot drains and pushes the stash
+    into the new slice's lanes in arrival order."""
+
+    __slots__ = ("tenant", "family", "pending", "stash", "new_sl", "new_slot")
+
+    def __init__(self, tenant: str, family: str, pending: List[_PendingFlush],
+                 new_sl: int, new_slot: int) -> None:
+        self.tenant = tenant
+        self.family = family
+        self.pending = pending        # old-slice flushes to outwait
+        self.stash: Dict[int, _LaneRing] = {}   # dshard → parked rows
+        self.new_sl = new_sl
+        self.new_slot = new_slot
+
+    def ready(self) -> bool:
+        return all(pf.resolved for pf in self.pending)
+
+    def park(self, dshard: int, ids, vals, seq, rows) -> None:
+        ring = self.stash.get(dshard)
+        if ring is None:
+            ring = self.stash[dshard] = _LaneRing()
+        ring.push(ids, vals, seq, rows)
+
+    def depth(self) -> int:
+        return sum(r.count for r in self.stash.values())
 
 
 class TpuInferenceEngine(TenantEngine):
@@ -428,7 +543,12 @@ class TpuInferenceEngine(TenantEngine):
     async def on_start(self) -> None:
         svc = self.service
         self.placement = svc.router.place(self.tenant, family=self.config.model)
-        scorer = svc.scorer_for_family(self.config.model, self.config)
+        # the tenant's scorer is its mesh SLICE's scorer: one compiled
+        # step per (family, tenant-axis slice), dispatching only to that
+        # slice's devices (docs/PERFORMANCE.md "Multi-chip serving")
+        scorer = svc.scorer_for_slice(
+            self.config.model, self.placement.shard, self.config
+        )
         self.streams = StreamRegistry(
             svc.mm.n_data_shards, scorer.max_streams // svc.mm.n_data_shards
         )
@@ -445,7 +565,7 @@ class TpuInferenceEngine(TenantEngine):
                 self.tenant, self.config.model,
             )
         scorer.activate(
-            svc.router.global_slot(self.placement), params=params,
+            self.placement.slot, params=params,
             trainable=self.config.training.enabled,
             lr=self.config.training.lr,
         )
@@ -456,8 +576,9 @@ class TpuInferenceEngine(TenantEngine):
         # output distribution (docs/OBSERVABILITY.md "re-baseline")
         svc.scorehealth.register(
             self.tenant, self.config.model,
-            svc.router.global_slot(self.placement),
+            self.placement.slot,
             getattr(scorer, "sketch_edges", []),
+            mesh_slice=self.placement.shard,
             variant={
                 "fused": bool(getattr(scorer, "fused", False)),
                 "k_steps": int(getattr(scorer, "k_steps", 1)),
@@ -470,15 +591,18 @@ class TpuInferenceEngine(TenantEngine):
         # and clears the family breaker's failure history with it
         svc._parked.discard(self.config.model)
         svc._failover_rounds.pop(self.config.model, None)
-        breaker = svc.breakers.get(self.config.model)
-        if breaker is not None:
+        for _sl, breaker in [
+            (k[1], v) for k, v in svc.breakers.items()
+            if k[0] == self.config.model
+        ]:
             breaker.reset()
 
     async def on_stop(self) -> None:
         svc = self.service
         if self.placement is not None:
-            slot = svc.router.global_slot(self.placement)
-            scorer = svc.scorers.get(self.config.model)
+            sl = self.placement.shard
+            slot = self.placement.slot
+            scorer = svc.scorers.get((self.config.model, sl))
             if scorer is not None and svc.checkpoints is not None:
                 # save this tenant's (possibly trained) weights BEFORE the
                 # slot wipe below destroys them. Materialize to numpy ON
@@ -500,7 +624,7 @@ class TpuInferenceEngine(TenantEngine):
             # already advanced past these rows, so dropping them would lose
             # them from the store on every tenant restart — resolve them
             # unscored (NaN) instead
-            lanes = svc._lanes.get(self.config.model)
+            lanes = svc._lanes.get((self.config.model, sl))
             if lanes is not None:
                 drained = svc.metrics.counter("tpu_inference.drained_on_stop")
                 for key in [k for k in lanes if k[0] == slot]:
@@ -513,6 +637,20 @@ class TpuInferenceEngine(TenantEngine):
                             family=self.config.model,
                         )
                         drained.inc(n)
+            # a tenant removed mid-slice-move: its fenced rows were
+            # consumed off the bus, so they resolve unscored too
+            fence = svc._fences.pop(self.tenant, None)
+            if fence is not None:
+                svc.metrics.gauge("tpu_inference_fences").set(
+                    len(svc._fences)
+                )
+                for ring in fence.stash.values():
+                    if ring.count:
+                        _i, _v, seqs, rows = ring.pop(ring.count)
+                        await svc._resolve_rows(
+                            seqs, rows, None, publish_nowait=True,
+                            family=self.config.model,
+                        )
             svc.router.remove(self.tenant)
             self.placement = None
         svc.fair.remove(self.tenant)
@@ -574,6 +712,11 @@ class TpuInferenceService(MultitenantService):
         # live device-time/MFU attribution per family (runtime.metrics
         # .MfuAccount; fed by resolved flushes, decayed by refresh_mfu)
         self._mfu: Dict[str, object] = {}
+        # per-(family, mesh-slice) device-labeled MFU accounts beside
+        # the family aggregate (separate metric names — see
+        # MfuAccount.DEVICE_NAMES): on a multi-chip mesh, per-chip
+        # utilization is what keeps tpu_mfu_pct honest at n_devices>1
+        self._mfu_dev: Dict[Tuple[str, int], object] = {}
         self._stage_timers: Dict[str, object] = {}
         self._seen_shapes: set = set()
         self._last_flush: Dict[str, dict] = {}
@@ -581,36 +724,54 @@ class TpuInferenceService(MultitenantService):
         self.slots_per_shard = slots_per_shard
         self.poll_batch = poll_batch  # bus items (batches) per poll
         self.router = TenantRouter(self.mm.n_tenant_shards, slots_per_shard)
-        self.scorers: Dict[str, ShardedScorer] = {}
-        # per-family circuit breaker over scorer dispatch+materialization
-        # (the first tenant's FaultTolerancePolicy pins it, like wire_dtype)
-        self.breakers: Dict[str, CircuitBreaker] = {}
-        self._lanes: Dict[str, Dict[Tuple[int, int], _LaneRing]] = {}
-        # reusable flush staging: (family, bucket) → [next_idx, sets];
-        # ``staging_slots`` sets rotate so flush N+1 packs host buffers
-        # while flush N's async h2d copy is still in flight
+        # (family, mesh-slice) → ShardedScorer over that slice's
+        # sub-mesh: each slice dispatches/stages/reaps independently —
+        # the unit of horizontal scale (ROADMAP item 1). String lookup
+        # resolves single-slice families for operator/test convenience.
+        self.scorers: _ScorerMap = _ScorerMap()
+        # first tenant of a family pins the family-wide knobs (wire
+        # dtype, fused kernel shape, model config): EVERY slice scorer
+        # of the family builds from this config so slices are
+        # numerically interchangeable across failover/rebalance moves
+        self._family_cfg: Dict[str, TenantEngineConfig] = {}
+        # per-(family, slice) circuit breaker over scorer dispatch +
+        # materialization (the first tenant's FaultTolerancePolicy pins
+        # the policy family-wide, like wire_dtype): breaker scope
+        # matches failure scope — one sick chip's open breaker must not
+        # short-circuit healthy slices of the family into unscored
+        # pass-through. String lookup resolves single-slice families.
+        self.breakers: _ScorerMap = _ScorerMap()
+        self._lanes: Dict[
+            Tuple[str, int], Dict[Tuple[int, int], _LaneRing]
+        ] = {}
+        # reusable flush staging: (family, slice, bucket) → [next_idx,
+        # sets]; ``staging_slots`` sets rotate PER SLICE so every slice
+        # packs host buffers while its own previous flush's async h2d
+        # copy is still in flight — slices never contend on one pool
         self.staging_slots = max(2, int(staging_slots))
-        self._staging: Dict[Tuple[str, int], list] = {}
-        # per-family last dispatch output — the overlap probe (next
-        # flush's staging "overlapped" ⇔ this is still computing). With
-        # the device-side gather it holds the GATHERED rows (a few KB),
-        # never the score plane, and the reaper drops it when the
-        # family's in-flight queue drains so an idle family pins nothing
-        self._last_scores: Dict[str, object] = {}
-        self._first_pending_ts: Dict[str, float] = {}
+        self._staging: Dict[Tuple[str, int, int], list] = {}
+        # per-(family, slice) last dispatch output — the overlap probe
+        # (next flush's staging "overlapped" ⇔ this is still computing).
+        # With the device-side gather it holds the GATHERED rows (a few
+        # KB), never the score plane, and the reaper drops it when the
+        # slice's in-flight queue drains so an idle slice pins nothing
+        self._last_scores: Dict[Tuple[str, int], object] = {}
+        self._first_pending_ts: Dict[Tuple[str, int], float] = {}
         self._loop_super: Optional[SupervisedTask] = None
         # batch registry: seq → [batch, rows_awaiting_scores]
         self._batches: Dict[int, list] = {}
         self._next_seq = 0
-        # live-training cadence: per-family {slot: flush-tick} + last losses
-        self._train_ticks: Dict[str, Dict[int, int]] = {}
-        self.last_train_losses: Dict[str, object] = {}  # device arrays
-        # auto-failover: consecutive scorer errors per family; at the
-        # threshold every tenant of the family re-places onto a different
-        # mesh shard (SURVEY.md §5: "tenant-engine failover to a different
-        # mesh shard")
+        # live-training cadence: per-(family, slice) {slot: flush-tick}
+        self._train_ticks: Dict[Tuple[str, int], Dict[int, int]] = {}
+        # per-(family, slice) last train losses (device arrays; string
+        # lookup resolves while one slice serves the family)
+        self.last_train_losses: _ScorerMap = _ScorerMap()
+        # auto-failover: consecutive scorer errors per (family, slice) —
+        # errors are chip-local, so only the sick slice's tenants
+        # re-place onto different mesh shards (SURVEY.md §5:
+        # "tenant-engine failover to a different mesh shard")
         self.failover_threshold = 3
-        self._consec_errors: Dict[str, int] = {}
+        self._consec_errors: Dict[Tuple[str, int], int] = {}
         # escalation: failover rounds without an intervening healthy
         # delivery; past max_failover_rounds the family PARKS — events
         # flow through unscored (degraded, never lost) until a tenant
@@ -618,20 +779,30 @@ class TpuInferenceService(MultitenantService):
         self.max_failover_rounds = 3
         self._failover_rounds: Dict[str, int] = {}
         self._parked: set = set()
-        self._inflight = asyncio.Semaphore(max_inflight)
+        # slice-move fences: tenant → _SliceFence while a failover/
+        # rebalance move outwaits the old slice's in-flight flushes
+        self._fences: Dict[str, _SliceFence] = {}
+        # in-flight flush budget PER (family, slice): the bound exists
+        # to limit concurrent d2h round trips on ONE device queue, so a
+        # saturated slice exhausts ITS OWN permits while other slices
+        # keep flushing — a global semaphore would let one slow chip
+        # starve every other slice's flush admission (the multi-chip
+        # analog of the head-of-line blocking the reaper already avoids)
+        self._inflight: Dict[Tuple[str, int], asyncio.Semaphore] = {}
         self.max_inflight = max_inflight
         self._deliver_pool = None  # created on start, shut down on stop
-        # result path: per-family FIFOs of in-flight flush completions,
-        # drained by the reaper task as d2h transfers land (out of order
-        # across families, in order per tenant)
-        self._reap: Dict[str, _ReapQueue] = {}
+        # result path: per-(family, slice) FIFOs of in-flight flush
+        # completions — per-DEVICE drain queues, drained by the reaper
+        # task as d2h transfers land (out of order across slices and
+        # families, in order per tenant)
+        self._reap: Dict[Tuple[str, int], _ReapQueue] = {}
         self._reap_event = asyncio.Event()
         self._reaper_super: Optional[SupervisedTask] = None
-        # per-family resolve task in flight (≤ 1 per family keeps the
-        # per-tenant FIFO; separate tasks keep one family's backpressured
-        # publish from head-of-line blocking every other family's landed
-        # transfers behind the single reaper coroutine)
-        self._resolving: Dict[str, asyncio.Task] = {}
+        # per-(family, slice) resolve task in flight (≤ 1 per slice
+        # queue keeps the per-tenant FIFO; separate tasks keep one
+        # tenant's backpressured publish from head-of-line blocking
+        # other slices' landed transfers behind the reaper coroutine)
+        self._resolving: Dict[Tuple[str, int], asyncio.Task] = {}
         # teardown grace for in-flight transfers before they force-resolve
         # unscored (a dead device must not hang the stop cascade)
         self.deliver_drain_timeout_s = 10.0
@@ -640,11 +811,27 @@ class TpuInferenceService(MultitenantService):
     def group(self) -> str:
         return "tpu-inference"
 
+    def _inflight_sem(self, key: Tuple[str, int]) -> asyncio.Semaphore:
+        sem = self._inflight.get(key)
+        if sem is None:
+            sem = self._inflight[key] = asyncio.Semaphore(self.max_inflight)
+        return sem
+
     def _make_engine(self, cfg: TenantEngineConfig) -> TpuInferenceEngine:
         return TpuInferenceEngine(cfg, self)
 
-    def scorer_for_family(self, family: str, cfg: TenantEngineConfig) -> ShardedScorer:
-        scorer = self.scorers.get(family)
+    def scorer_for_slice(
+        self, family: str, sl: int, cfg: TenantEngineConfig
+    ) -> ShardedScorer:
+        """The (family, mesh-slice) scorer, built lazily over the
+        slice's sub-mesh from the FAMILY-PINNED config (first tenant
+        wins — every slice of a family must compile the identical
+        kernel, or a failover move would change a tenant's numerics)."""
+        # knob-conflict checks compare against the family's pinned
+        # representative (any existing slice scorer of the family)
+        scorer = next(
+            (v for (f, _s), v in self.scorers.items() if f == family), None
+        )
         if scorer is not None and scorer.wire_dtype != cfg.wire_dtype:
             # the wire dtype is a property of the FAMILY stack (first
             # tenant wins); a later tenant asking for a different wire
@@ -687,27 +874,40 @@ class TpuInferenceService(MultitenantService):
                 ),
             )
             self.metrics.counter("tpu_inference.fused_knob_conflicts").inc()
-        if scorer is None:
+        if (family, sl) not in self.scorers:
+            # build THIS slice's scorer from the family-pinned config so
+            # every slice compiles the identical kernel variant
+            pin = self._family_cfg.setdefault(family, cfg)
             spec = get_model(family)
             mcfg = make_config(family, {
-                **cfg.model_config, "window": cfg.microbatch.window,
+                **pin.model_config, "window": pin.microbatch.window,
             })
             scorer = ShardedScorer(
-                self.mm,
+                self.mm.slice_manager(sl),
                 spec,
                 mcfg,
                 slots_per_shard=self.slots_per_shard,
-                max_streams=cfg.max_streams,
-                window=cfg.microbatch.window,
-                wire_dtype=cfg.wire_dtype,
-                fuse_k=getattr(cfg, "fuse_k", 1),
-                param_dtype=getattr(cfg, "param_dtype", "f32"),
+                max_streams=pin.max_streams,
+                window=pin.microbatch.window,
+                wire_dtype=pin.wire_dtype,
+                fuse_k=getattr(pin, "fuse_k", 1),
+                param_dtype=getattr(pin, "param_dtype", "f32"),
             )
             # shadow-canary fraction: family-pinned like the fused knobs
             # (first tenant wins; one shadow step per family stack)
-            scorer.canary_frac = float(getattr(cfg, "canary_frac", 0.0) or 0.0)
-            self.scorers[family] = scorer
-            self._lanes[family] = {}
+            scorer.canary_frac = float(getattr(pin, "canary_frac", 0.0) or 0.0)
+            self.scorers[(family, sl)] = scorer
+            self._lanes[(family, sl)] = {}
+            if self.mm.n_devices > 1:
+                # how many mesh slices currently serve this family —
+                # slice spread is the first thing to read when per-device
+                # rows/MFU look uneven (docs/OBSERVABILITY.md)
+                self.metrics.gauge(
+                    "tpu_inference_slice_scorers", family=family
+                ).set(sum(1 for k in self.scorers if k[0] == family))
+        else:
+            return self.scorers[(family, sl)]
+        if (family, sl) not in self.breakers:
             # the failover→park escalation is the scorer's first-line
             # healing; by default the breaker must not open mid-escalation
             # and starve it of failure outcomes (parked families stop
@@ -725,8 +925,8 @@ class TpuInferenceService(MultitenantService):
                 and ft.breaker_min_samples < park_budget
             ):
                 ft = _replace(ft, breaker_min_samples=park_budget)
-            self.breakers[family] = CircuitBreaker(
-                f"tpu_inference.{family}",
+            self.breakers[(family, sl)] = CircuitBreaker(
+                f"tpu_inference.{family}.s{sl}",
                 policy=ft,
                 metrics=self.metrics,
             )
@@ -741,7 +941,13 @@ class TpuInferenceService(MultitenantService):
         from concurrent.futures import ThreadPoolExecutor
 
         self._deliver_pool = ThreadPoolExecutor(
-            max_workers=self.max_inflight, thread_name_prefix="tpu-deliver"
+            # enough workers for every slice's in-flight window to
+            # materialize concurrently (per-slice inflight budgets),
+            # capped so a wide mesh doesn't spawn a thread army
+            max_workers=min(
+                32, self.max_inflight * max(1, self.mm.n_slices)
+            ),
+            thread_name_prefix="tpu-deliver",
         )
         # SUPERVISED scoring loop: a persistent loop error restarts it
         # with backoff instead of silently killing all scoring (the k8s
@@ -792,12 +998,14 @@ class TpuInferenceService(MultitenantService):
                 await self._resolve_rows(
                     seqs, rows, None, publish_nowait=True, family=pf.family
                 )
-                self._inflight.release()
+                pf.resolved = True
+                self._inflight_sem(pf.key).release()
         self._deliver_gauge()
-        # final sweep: rows can land in lanes AFTER their engine's own
-        # stop-drain (the scoring loop keeps consuming during the stop
-        # cascade) — resolve them unscored so no consumed event is lost
-        for fam, lanes in self._lanes.items():
+        # final sweep: rows can land in lanes (or slice-move fences)
+        # AFTER their engine's own stop-drain (the scoring loop keeps
+        # consuming during the stop cascade) — resolve them unscored so
+        # no consumed event is lost
+        for (fam, _sl), lanes in self._lanes.items():
             for key in list(lanes):
                 lane = lanes.pop(key)
                 if lane.count:
@@ -805,6 +1013,15 @@ class TpuInferenceService(MultitenantService):
                     await self._resolve_rows(
                         seqs, rows, None, publish_nowait=True, family=fam
                     )
+        for fence in list(self._fences.values()):
+            for ring in fence.stash.values():
+                if ring.count:
+                    _i, _v, seqs, rows = ring.pop(ring.count)
+                    await self._resolve_rows(
+                        seqs, rows, None, publish_nowait=True,
+                        family=fence.family,
+                    )
+        self._fences.clear()
         self._last_scores.clear()  # drop any pinned device score memory
         if self.mm.n_devices > 1:
             # cardinality guard (the drop_labeled pattern): a stopped
@@ -830,8 +1047,10 @@ class TpuInferenceService(MultitenantService):
         unscored right away (they still persist — degraded, never lost)
         so the TPU budget shrinks without breaking accounting."""
         family = engine.config.model
-        lanes = self._lanes[family]
-        slot = self.router.global_slot(engine.placement)
+        sl = engine.placement.shard
+        lanes = self._lanes[(family, sl)]
+        slot = engine.placement.slot
+        fence = self._fences.get(engine.tenant)
         n = batch.n
         if batch.scores is None:
             batch.scores = np.full((n,), np.nan, np.float32)
@@ -864,9 +1083,17 @@ class TpuInferenceService(MultitenantService):
             # batch) — publish now or the registry entry leaks forever
             await self._publish_batch(seq)
             return
+        parked = 0
         for d in range(self.mm.n_data_shards):
             sel = np.nonzero(dshards == d)[0]
             if sel.size == 0:
+                continue
+            if fence is not None:
+                # mid-slice-move: the tenant's new rows park behind the
+                # fence (FIFO) until the old slice's in-flight flushes
+                # resolve — per-tenant delivery order survives the move
+                fence.park(d, locals_[sel], batch.values[sel], seq, sel)
+                parked += sel.size
                 continue
             lane = lanes.get((slot, d))
             if lane is None:
@@ -882,8 +1109,12 @@ class TpuInferenceService(MultitenantService):
             # sel doubles as the row indices inside the batch; seq
             # broadcasts — rows land in the ring right here, at enqueue
             lane.push(locals_[sel], batch.values[sel], seq, sel)
-        if family not in self._first_pending_ts:
-            self._first_pending_ts[family] = time.monotonic()
+        if fence is not None:
+            if parked:
+                self.metrics.counter("tpu_inference.fenced_rows").inc(parked)
+            return
+        if (family, sl) not in self._first_pending_ts:
+            self._first_pending_ts[(family, sl)] = time.monotonic()
 
     # -- score write-back -------------------------------------------------
     async def _resolve_rows(
@@ -1054,28 +1285,43 @@ class TpuInferenceService(MultitenantService):
                 return min(b, max_batch)
         return max_batch
 
-    def _staging_set(self, family: str, scorer, b_lane: int) -> _StagingSet:
-        """Next rotating staging set for (family, bucket) — created once,
-        reused for the lifetime of the shape."""
-        key = (family, b_lane)
+    def _staging_set(
+        self, family: str, sl: int, scorer, b_lane: int
+    ) -> _StagingSet:
+        """Next rotating staging set for (family, slice, bucket) —
+        created once, reused for the lifetime of the shape. Per-slice
+        pools are what let slices pack+stage concurrently instead of
+        funneling through one rotation."""
+        key = (family, sl, b_lane)
         rot = self._staging.get(key)
         if rot is None:
             rot = self._staging[key] = [
                 0, [_StagingSet(scorer, b_lane) for _ in range(self.staging_slots)],
             ]
+            # bounded-pool observability (check_queues): total resident
+            # staging sets across every (family, slice, bucket) rotation
+            self.metrics.gauge("tpu_inference_staging_sets").set(
+                sum(len(r[1]) for r in self._staging.values())
+            )
         idx, sets = rot
         rot[0] = (idx + 1) % len(sets)
         st = sets[idx]
         st.ensure_reusable(self.metrics)
         return st
 
-    async def _flush_family(self, engine_cfgs: Dict[int, TenantEngineConfig], family: str) -> int:
-        """Pack one family's lane rings into a reusable staging set,
-        stage the buffers to device (async h2d — overlaps any in-flight
-        flush's dispatch), dispatch the jit step, and hand score
-        materialization to a pipelined delivery task."""
-        scorer = self.scorers[family]
-        lanes = self._lanes[family]
+    async def _flush_slice(
+        self, engine_cfgs: Dict[int, TenantEngineConfig], family: str,
+        sl: int,
+    ) -> int:
+        """Pack one (family, mesh-slice)'s lane rings into the slice's
+        reusable staging set, stage the buffers to the SLICE's devices
+        (async h2d — overlaps any in-flight flush's dispatch, on this
+        slice or any other), dispatch the slice's jit step, and hand
+        score materialization to the per-device reap queue. Slices flush
+        independently: no cross-slice collectives, no shared staging
+        pool, no shared completion stream."""
+        scorer = self.scorers[(family, sl)]
+        lanes = self._lanes[(family, sl)]
         if family in self._parked:
             # degraded mode: resolve pending rows unscored so events keep
             # flowing to persistence/rules while the scorer is parked
@@ -1086,12 +1332,12 @@ class TpuInferenceService(MultitenantService):
                     _i, _v, seqs, rows = lane.pop(lane.count)
                     await self._resolve_rows(seqs, rows, None, family=family)
                     drained += len(seqs)
-            self._first_pending_ts.pop(family, None)
+            self._first_pending_ts.pop((family, sl), None)
             return drained
         if not any(l.count for l in lanes.values()):
-            self._first_pending_ts.pop(family, None)
+            self._first_pending_ts.pop((family, sl), None)
             return 0
-        breaker = self.breakers.get(family)
+        breaker = self.breakers.get((family, sl))
         if breaker is not None and not breaker.allow():
             # breaker OPEN: stop hammering the scorer — resolve pending
             # rows unscored (degraded, never lost) until the half-open
@@ -1104,7 +1350,7 @@ class TpuInferenceService(MultitenantService):
                     _i, _v, seqs, rows = lane.pop(lane.count)
                     await self._resolve_rows(seqs, rows, None, family=family)
                     drained += len(seqs)
-            self._first_pending_ts.pop(family, None)
+            self._first_pending_ts.pop((family, sl), None)
             self.metrics.counter("tpu_inference.breaker_short_circuits").inc()
             return drained
         any_cfg = next(iter(engine_cfgs.values()))
@@ -1114,11 +1360,13 @@ class TpuInferenceService(MultitenantService):
         # (everything from the pop to the reap enqueue below is
         # await-free).
         t_acq = time.perf_counter()
-        if self._inflight.locked():
-            # all completion slots busy: the flush backpressures here,
-            # where depth is the deliver_inflight gauge (check_queues)
+        sem = self._inflight_sem((family, sl))
+        if sem.locked():
+            # all of THIS slice's completion slots busy: the flush
+            # backpressures here, where depth is the deliver_inflight
+            # gauge (check_queues) — other slices' budgets are untouched
             self.metrics.counter("tpu_inference.deliver_backpressure").inc()
-        await self._inflight.acquire()
+        await sem.acquire()
         self.metrics.histogram("tpu_inference.acquire_wait", unit="s").record(
             time.perf_counter() - t_acq
         )
@@ -1135,7 +1383,7 @@ class TpuInferenceService(MultitenantService):
         # no fresh flush arrays, no list accumulators, no np.asarray over
         # Python lists (tools/check_hotpath.py enforces this stays true).
         t_asm = time.perf_counter()
-        st = self._staging_set(family, scorer, b_lane)
+        st = self._staging_set(family, sl, scorer, b_lane)
         ids, vals, counts = st.ids, st.vals, st.counts
         counts[:] = 0
         take_total = 0
@@ -1169,11 +1417,11 @@ class TpuInferenceService(MultitenantService):
             depth_left
         )
         if depth_left:
-            self._first_pending_ts[family] = time.monotonic()
+            self._first_pending_ts[(family, sl)] = time.monotonic()
         else:
-            self._first_pending_ts.pop(family, None)
+            self._first_pending_ts.pop((family, sl), None)
         if moved == 0:
-            self._inflight.release()
+            sem.release()
             if breaker is not None:
                 breaker.release_trial()  # allowed, but no call was made
             return 0
@@ -1183,7 +1431,7 @@ class TpuInferenceService(MultitenantService):
         )
 
         taken = (slots_cat, cols_cat, seqs_cat, rows_cat)
-        shape_key = (family, b_lane)
+        shape_key = (family, sl, b_lane)
         compiling = shape_key not in self._seen_shapes
         h2d_stage_s: Optional[float] = None  # for the fault record when
         dispatch_s: Optional[float] = None   # the try below dies early
@@ -1194,7 +1442,7 @@ class TpuInferenceService(MultitenantService):
             # dispatch output is not yet ready ⇔ this staging copy rides
             # under genuinely in-flight device compute (a pending deliver
             # task alone could just be awaiting its publish).
-            prev_scores = self._last_scores.get(family)
+            prev_scores = self._last_scores.get((family, sl))
             try:
                 overlapped = (
                     prev_scores is not None and not prev_scores.is_ready()
@@ -1283,6 +1531,13 @@ class TpuInferenceService(MultitenantService):
                 "compiled": compiling,
                 "bucket": b_lane,
             }
+            if self.mm.n_devices > 1:
+                # per-device throughput attribution: which chip scored
+                # these rows (slice balance / skew ride on this)
+                self.metrics.counter(
+                    "tpu_inference_device_rows_total",
+                    device=scorer.device_label,
+                ).inc(moved)
             self.metrics.counter("tpu_inference.flushes").inc()
             self.metrics.counter("tpu_inference.flush_rows").inc(moved)
             if self.flightrec is not None:
@@ -1301,6 +1556,10 @@ class TpuInferenceService(MultitenantService):
                     # must name the variant, not just the family)
                     k_steps=getattr(scorer, "k_steps", 1),
                     param_dtype=getattr(scorer, "param_dtype", "f32"),
+                    # multi-chip attribution: WHICH slice/chip ran this
+                    # flush — incident snapshots must name the device
+                    mesh_slice=sl,
+                    device_label=scorer.device_label,
                     trace_id=self._flush_trace_id(seqs_cat),
                     status="inflight",
                 )
@@ -1339,7 +1598,7 @@ class TpuInferenceService(MultitenantService):
             # overlap probe for the NEXT flush — now holds the gathered
             # rows (a few KB), not a full flush of plane memory; the
             # reaper drops it when the family goes idle
-            self._last_scores[family] = scores_dev
+            self._last_scores[(family, sl)] = scores_dev
             try:
                 # start the d2h copy NOW: it rides under the next
                 # flush's compute and is (ideally) done by the time the
@@ -1380,6 +1639,8 @@ class TpuInferenceService(MultitenantService):
                         compiled=compiling,
                         k_steps=getattr(scorer, "k_steps", 1),
                         param_dtype=getattr(scorer, "param_dtype", "f32"),
+                        mesh_slice=sl,
+                        device_label=scorer.device_label,
                         trace_id=self._flush_trace_id(seqs_cat),
                         status="error", error=repr(exc),
                     )
@@ -1390,7 +1651,7 @@ class TpuInferenceService(MultitenantService):
             # stays held until the reaper resolves the entry.
             self._reap_enqueue(_PendingFlush(
                 family, None, taken, moved, False, 0, 0, poisoned=True,
-                rec=err_rec,
+                rec=err_rec, sl=sl,
             ))
             if (
                 self.flightrec is not None
@@ -1404,10 +1665,10 @@ class TpuInferenceService(MultitenantService):
                     f"breaker:{family}", family=family,
                     trace_id=err_rec.get("trace_id") if err_rec else None,
                 )
-            await self._note_scorer_error(family)
+            await self._note_scorer_error(family, sl)
             return moved
         try:
-            self._train_tick(family, scorer, engine_cfgs)
+            self._train_tick(family, sl, scorer, engine_cfgs)
         except Exception as exc:  # noqa: BLE001 - a training fault must not
             # leak the inflight permit or strand the step's rows (the
             # scoring step itself succeeded; delivery proceeds below)
@@ -1417,7 +1678,7 @@ class TpuInferenceService(MultitenantService):
             family, scores_dev, taken, moved, gathered,
             int(getattr(scores_dev, "nbytes", 0)), plane_nbytes,
             flops=float(flops_fn(b_lane)) if flops_fn is not None else 0.0,
-            rec=rec, sketch=sketch_dev, shadow=shadow_dev,
+            rec=rec, sketch=sketch_dev, shadow=shadow_dev, sl=sl,
         )
         pf.slot_override = slot_override
         if not hasattr(scores_dev, "copy_to_host_async"):
@@ -1445,35 +1706,29 @@ class TpuInferenceService(MultitenantService):
         """Queue one pending flush (normal or poisoned) for the reaper:
         the single definition of the enqueue protocol — FIFO append,
         gauge refresh, reaper wake."""
-        q = self._reap.get(pf.family)
+        q = self._reap.get(pf.key)
         if q is None:
-            q = self._reap[pf.family] = _ReapQueue()
+            q = self._reap[pf.key] = _ReapQueue()
         q.append(pf)
         self._deliver_gauge()
         self._reap_event.set()
 
     # -- auto-failover ----------------------------------------------------
-    async def _note_scorer_error(self, family: str) -> None:
-        """Count consecutive scorer failures for a family; at the
-        threshold, rebuild the scorer runtime (a failed dispatch can
-        invalidate the donated state buffer) and fail every tenant of the
-        family over to a DIFFERENT mesh shard (reference analog: tenant
-        engines restarting on another replica after repeated probe
-        failures [U]). Repeated rounds without a healthy delivery PARK
-        the family: events pass through unscored rather than churning
-        failovers forever — degraded, never lost.
-
-        Scope note: within ONE process the scoring step is a single
-        shard_map over the whole mesh, so re-placement heals slot-level
-        poisoning; an entire dead device additionally needs the runtime
-        rebuild below, and if the fault persists the family parks. In a
-        multi-host deployment each host runs its own scorer over its mesh
-        slice, and re-placement moves tenants off the sick host."""
-        n = self._consec_errors.get(family, 0) + 1
-        self._consec_errors[family] = n
+    async def _note_scorer_error(self, family: str, sl: int = 0) -> None:
+        """Count consecutive scorer failures per (family, mesh-slice);
+        at the threshold, rebuild the SICK SLICE's scorer runtime (a
+        failed dispatch can invalidate the donated state buffer) and
+        fail that slice's tenants over to DIFFERENT mesh shards
+        (reference analog: tenant engines restarting on another replica
+        after repeated probe failures [U]) — healthy slices keep
+        serving untouched. Repeated rounds without a healthy delivery
+        PARK the family: events pass through unscored rather than
+        churning failovers forever — degraded, never lost."""
+        n = self._consec_errors.get((family, sl), 0) + 1
+        self._consec_errors[(family, sl)] = n
         if n < self.failover_threshold or family in self._parked:
             return
-        self._consec_errors[family] = 0
+        self._consec_errors[(family, sl)] = 0
         rounds = self._failover_rounds.get(family, 0) + 1
         self._failover_rounds[family] = rounds
         if rounds > self.max_failover_rounds:
@@ -1486,15 +1741,16 @@ class TpuInferenceService(MultitenantService):
             )
             self.metrics.counter("tpu_inference.parked").inc()
             return
-        self._last_scores.pop(family, None)  # may reference dead buffers
-        scorer = self.scorers.get(family)
+        # may reference dead buffers
+        self._last_scores.pop((family, sl), None)
+        scorer = self.scorers.get((family, sl))
         if scorer is not None:
             try:
                 scorer.rebuild_runtime()
                 # the rebuilt jit cache recompiles every shape: reset the
-                # family's seen-shape set so the compile counter stays true
+                # slice's seen-shape set so the compile counter stays true
                 self._seen_shapes = {
-                    k for k in self._seen_shapes if k[0] != family
+                    k for k in self._seen_shapes if k[:2] != (family, sl)
                 }
             except Exception as exc:  # noqa: BLE001 - device may be gone
                 self._record_error("rebuild", exc)
@@ -1503,74 +1759,172 @@ class TpuInferenceService(MultitenantService):
                 isinstance(engine, TpuInferenceEngine)
                 and engine.placement is not None
                 and engine.config.model == family
+                and engine.placement.shard == sl
             ):
                 await self._failover_tenant(engine)
 
     async def _failover_tenant(self, engine: "TpuInferenceEngine") -> bool:
-        """Re-place one tenant onto another shard: carry its params (live
-        copy if the old shard still answers, else last checkpoint, else
-        pristine), wipe + free the old slot, re-key pending lanes. Stream
-        → data-shard assignments are placement-independent, so no rows and
-        no window routing are lost."""
+        """Re-place one tenant onto another shard (usually a different
+        MESH SLICE): carry its params (live copy if the old slice still
+        answers, else last checkpoint, else pristine), wipe + free the
+        old slot, and move pending rows through a ``_SliceFence`` so
+        per-tenant delivery order survives the move. Stream →
+        data-shard assignments are placement-independent, so no rows
+        and no window routing are lost (window HISTORY restarts on the
+        new slice, as before)."""
         from sitewhere_tpu.parallel.tenant_router import PlacementError
-        from sitewhere_tpu.runtime.checkpoint import host_copy_params
 
         tenant = engine.tenant
-        family = engine.config.model
-        scorer = self.scorers.get(family)
-        if scorer is None:
-            return False
-        old_slot = self.router.global_slot(engine.placement)
-        params = None
-        try:  # live params may be unreachable on a sick shard
-            params = host_copy_params(scorer.slot_params(old_slot))
-        except Exception:  # noqa: BLE001
-            if self.checkpoints is not None:
-                try:
-                    params = await asyncio.get_running_loop().run_in_executor(
-                        None, self.checkpoints.load_params, tenant, family
-                    )
-                except Exception as exc:  # noqa: BLE001
-                    self._record_error("failover-params", exc)
         try:
+            old_p = engine.placement
             new_p = self.router.failover(tenant)
         except PlacementError as exc:
             self._record_error("failover", exc)
             return False
-        try:
-            scorer.reset_slot(old_slot)
-        except Exception as exc:  # noqa: BLE001 - the old shard may be dead
-            self._record_error("failover-reset", exc)
+        await self._apply_move(engine, old_p, new_p)
+        self.metrics.counter("tpu_inference.failovers").inc()
+        return True
+
+    async def _apply_move(
+        self, engine: "TpuInferenceEngine", old_p, new_p
+    ) -> None:
+        """Migrate one tenant's live serving state between placements —
+        the shared mechanics of failover and rebalance. The router has
+        ALREADY committed ``new_p``."""
+        from sitewhere_tpu.runtime.checkpoint import host_copy_params
+
+        tenant = engine.tenant
+        family = engine.config.model
+        old_scorer = self.scorers.get((family, old_p.shard))
+        params = None
+        if old_scorer is not None:
+            try:  # live params may be unreachable on a sick slice
+                params = host_copy_params(old_scorer.slot_params(old_p.slot))
+            except Exception:  # noqa: BLE001
+                if self.checkpoints is not None:
+                    try:
+                        params = (
+                            await asyncio.get_running_loop().run_in_executor(
+                                None, self.checkpoints.load_params,
+                                tenant, family,
+                            )
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        self._record_error("failover-params", exc)
+            try:
+                old_scorer.reset_slot(old_p.slot)
+            except Exception as exc:  # noqa: BLE001 - slice may be dead
+                self._record_error("failover-reset", exc)
         engine.placement = new_p
-        new_slot = self.router.global_slot(new_p)
-        scorer.activate(
-            new_slot, params=params,
+        new_scorer = self.scorer_for_slice(family, new_p.shard, engine.config)
+        new_scorer.activate(
+            new_p.slot, params=params,
             trainable=engine.config.training.enabled,
             lr=engine.config.training.lr,
         )
         # slot re-map only: the model didn't change, so the drift
-        # reference survives the failover (register keeps same-family
+        # reference survives the move (register keeps same-family
         # history — see ScoreHealth.register)
         self.scorehealth.register(
-            tenant, family, new_slot,
-            getattr(scorer, "sketch_edges", []),
+            tenant, family, new_p.slot,
+            getattr(new_scorer, "sketch_edges", []),
+            mesh_slice=new_p.shard,
         )
-        # pending rows keyed by the old slot ride over to the new one
-        lanes = self._lanes.get(family, {})
+        self._begin_fence(engine, old_p, new_p)
+
+    def _begin_fence(self, engine: "TpuInferenceEngine", old_p, new_p) -> None:
+        """Start (or re-target) the tenant's slice-move fence: snapshot
+        the OLD slice queue's in-flight flushes and park the tenant's
+        pending lane rows behind them. Same-slice moves (the old
+        single-slice failover shape) need no ordering fence — rows
+        re-key directly."""
+        tenant = engine.tenant
+        family = engine.config.model
+        fence = self._fences.get(tenant)
+        if fence is not None:
+            # a second move before the first fence lifted: rows are
+            # already parked and the ORIGINAL old-slice snapshot still
+            # gates them — only the landing target changes
+            fence.new_sl, fence.new_slot = new_p.shard, new_p.slot
+            return
+        old_lanes = self._lanes.get((family, old_p.shard), {})
+        pending = list(self._reap.get((family, old_p.shard), ()))
+        if old_p.shard == new_p.shard:
+            # same-slice slot move: FIFO is already guaranteed by the
+            # single slice queue — re-key lanes in place
+            for d in range(self.mm.n_data_shards):
+                lane = old_lanes.pop((old_p.slot, d), None)
+                if lane is not None and lane.count:
+                    dst = old_lanes.get((new_p.slot, d))
+                    if dst is None:
+                        old_lanes[(new_p.slot, d)] = lane
+                    else:
+                        li, lv, ls, lr = lane.pop(lane.count)
+                        dst.push(li, lv, ls, lr)
+            return
+        self.metrics.counter("tpu_inference.slice_moves").inc()
+        fence = _SliceFence(
+            tenant, family, pending, new_p.shard, new_p.slot
+        )
         for d in range(self.mm.n_data_shards):
-            lane = lanes.pop((old_slot, d), None)
+            lane = old_lanes.pop((old_p.slot, d), None)
             if lane is not None and lane.count:
-                dst = lanes.get((new_slot, d))
+                li, lv, ls, lr = lane.pop(lane.count)
+                fence.park(d, li, lv, ls, lr)
+        if not pending and not fence.depth():
+            return  # nothing in flight, nothing parked — no fence needed
+        self._fences[tenant] = fence
+        self.metrics.gauge("tpu_inference_fences").set(len(self._fences))
+
+    def _lift_fences(self) -> None:
+        """Release every fence whose old-slice snapshot has fully
+        resolved: parked rows push into the NEW slice's lanes in arrival
+        order. Driven from the scoring loop (cheap no-op while no move
+        is in flight)."""
+        for tenant in list(self._fences):
+            fence = self._fences[tenant]
+            if not fence.ready():
+                continue
+            del self._fences[tenant]
+            lanes = self._lanes.get((fence.family, fence.new_sl))
+            if lanes is None:
+                lanes = self._lanes[(fence.family, fence.new_sl)] = {}
+            moved = 0
+            for d, ring in sorted(fence.stash.items()):
+                if not ring.count:
+                    continue
+                li, lv, ls, lr = ring.pop(ring.count)
+                dst = lanes.get((fence.new_slot, d))
                 if dst is None:
-                    lanes[(new_slot, d)] = lane
-                else:
-                    li, lv, ls, lr = lane.pop(lane.count)
-                    dst.push(li, lv, ls, lr)
-        self.metrics.counter("tpu_inference.failovers").inc()
-        return True
+                    dst = lanes[(fence.new_slot, d)] = _LaneRing(
+                        max(64, ring.capacity)
+                    )
+                dst.push(li, lv, ls, lr)
+                moved += len(ls)
+            if moved:
+                key = (fence.family, fence.new_sl)
+                if key not in self._first_pending_ts:
+                    self._first_pending_ts[key] = time.monotonic()
+        self.metrics.gauge("tpu_inference_fences").set(len(self._fences))
+
+    async def apply_rebalance(self, family: Optional[str] = None) -> int:
+        """Router-planned load rebalance (tenant add/remove skew):
+        apply each move through the same fenced migration as failover —
+        per-tenant FIFO delivery holds across every slice move. Returns
+        the number of tenants moved."""
+        moves = self.router.rebalance(family)
+        applied = 0
+        for old_p, new_p in moves:
+            engine = self.engines.get(old_p.tenant)
+            if engine is None or not isinstance(engine, TpuInferenceEngine):
+                continue
+            await self._apply_move(engine, old_p, new_p)
+            applied += 1
+            self.metrics.counter("tpu_inference.rebalanced").inc()
+        return applied
 
     def _train_tick(
-        self, family: str, scorer: ShardedScorer,
+        self, family: str, sl: int, scorer: ShardedScorer,
         engine_cfgs: Dict[int, TenantEngineConfig],
     ) -> int:
         """Live training cadence: every Nth scoring flush dispatches ONE
@@ -1589,7 +1943,7 @@ class TpuInferenceService(MultitenantService):
             return 0
         # per-TENANT cadence: each slot matures on its own every_n_flushes
         # (and trains at its own lr — see ShardedScorer.slot_lr)
-        ticks = self._train_ticks.setdefault(family, {})
+        ticks = self._train_ticks.setdefault((family, sl), {})
         mature = []
         for slot, tc in enabled.items():
             n = ticks.get(slot, 0) + 1
@@ -1604,7 +1958,7 @@ class TpuInferenceService(MultitenantService):
             scorer.init_optimizer()  # scale_by_adam + per-slot lr
         mask = np.zeros((scorer.n_slots,), bool)
         mask[mature] = True
-        self.last_train_losses[family] = scorer.train_resident(mask)
+        self.last_train_losses[(family, sl)] = scorer.train_resident(mask)
         self.metrics.counter("tpu_inference.train_steps").inc()
         return 1
 
@@ -1612,15 +1966,28 @@ class TpuInferenceService(MultitenantService):
         self.metrics.gauge("tpu_inference_deliver_inflight").set(
             sum(len(q) for q in self._reap.values())
         )
-        # labeled variant beside the legacy aggregate: the reap queues
-        # are PER-FAMILY, so per-family depth is where a wedged tenant
-        # family actually shows (the aggregate hides it). Separate
-        # family name — mixing bare and {family} children under one
-        # name would double-count sum() aggregations.
-        for family, q in self._reap.items():
+        # labeled variants beside the legacy aggregate: the reap queues
+        # are PER-(family, slice), so per-family depth is where a wedged
+        # tenant family shows and per-DEVICE depth is where one slow
+        # chip shows (the aggregate hides both). Separate names —
+        # mixing bare and labeled children under one name would
+        # double-count sum() aggregations.
+        fam_depth: Dict[str, int] = {}
+        dev_depth: Dict[str, int] = {}
+        multi = self.mm.n_devices > 1
+        for (family, sl), q in self._reap.items():
+            fam_depth[family] = fam_depth.get(family, 0) + len(q)
+            if multi:
+                lbl = self.mm.slice_device_label(sl)
+                dev_depth[lbl] = dev_depth.get(lbl, 0) + len(q)
+        for family, depth in fam_depth.items():
             self.metrics.gauge(
                 "tpu_inference_deliver_inflight_family", family=family
-            ).set(len(q))
+            ).set(depth)
+        for lbl, depth in dev_depth.items():
+            self.metrics.gauge(
+                "tpu_inference_deliver_inflight_device", device=lbl
+            ).set(depth)
 
     # -- device-time / MFU attribution -----------------------------------
     def _mfu_account(self, family: str):
@@ -1631,12 +1998,31 @@ class TpuInferenceService(MultitenantService):
             acc = self._mfu[family] = MfuAccount(self.metrics, family)
         return acc
 
+    def _mfu_device_account(self, family: str, sl: int):
+        """Per-(family, mesh-slice) MFU account under the DEVICE-labeled
+        names (MfuAccount.DEVICE_NAMES): chip-level utilization so an
+        idle or skewed slice is visible instead of averaged away by the
+        family aggregate. Cardinality is mesh-bounded."""
+        acc = self._mfu_dev.get((family, sl))
+        if acc is None:
+            from sitewhere_tpu.runtime.metrics import MfuAccount
+
+            f_name, s_name, g_name = MfuAccount.DEVICE_NAMES
+            acc = self._mfu_dev[(family, sl)] = MfuAccount(
+                self.metrics, family,
+                flops_name=f_name, secs_name=s_name, gauge_name=g_name,
+                device=self.mm.slice_device_label(sl),
+            )
+        return acc
+
     def refresh_mfu(self) -> None:
         """Decay idle families' ``tpu_mfu_pct`` gauges from the sliding
         window (called by the instance's 1 s history tick and the
         /metrics scrape — a family that stopped flushing must read 0,
         not its last busy value)."""
         for acc in self._mfu.values():
+            acc.refresh()
+        for acc in self._mfu_dev.values():
             acc.refresh()
         # same tick drives the score-health time-based window rotation:
         # a slow stream must still rotate its drift windows instead of
@@ -1710,11 +2096,11 @@ class TpuInferenceService(MultitenantService):
         task = asyncio.get_running_loop().create_task(
             self._resolve_flush(pf)
         )
-        self._resolving[pf.family] = task
+        self._resolving[pf.key] = task
 
-        def _done(t: asyncio.Task, family: str = pf.family) -> None:
-            if self._resolving.get(family) is t:
-                del self._resolving[family]
+        def _done(t: asyncio.Task, key: Tuple[str, int] = pf.key) -> None:
+            if self._resolving.get(key) is t:
+                del self._resolving[key]
             if not t.cancelled() and t.exception() is not None:
                 # _resolve_flush handles its own failures; anything
                 # escaping would otherwise vanish with the task
@@ -1827,7 +2213,8 @@ class TpuInferenceService(MultitenantService):
                             _slots[nan_mask], minlength=sketch_np.shape[0]
                         )
                 self.scorehealth.ingest_sketch(
-                    pf.family, sketch_np.sum(axis=1), nan_by_slot
+                    pf.family, sketch_np.sum(axis=1), nan_by_slot,
+                    mesh_slice=pf.sl,
                 )
             if shadow_np is not None:
                 self._canary_compare(pf, picks, shadow_np)
@@ -1850,9 +2237,15 @@ class TpuInferenceService(MultitenantService):
             device_s = max(0.0, now - pf.t_dispatch)
             if pf.flops:
                 self._mfu_account(pf.family).record(pf.flops, device_s)
+                if self.mm.n_devices > 1:
+                    # per-chip utilization beside the family aggregate:
+                    # each slice's flushes feed ITS device's account
+                    self._mfu_device_account(pf.family, pf.sl).record(
+                        pf.flops, device_s
+                    )
             d2h_labels = {"family": pf.family}
             if self.mm.n_devices > 1:
-                scorer = self.scorers.get(pf.family)
+                scorer = self.scorers.get(pf.key)
                 d2h_labels["device"] = getattr(
                     scorer, "device_label", "device:?"
                 )
@@ -1880,9 +2273,9 @@ class TpuInferenceService(MultitenantService):
                 self.metrics.counter("tpu_inference.d2h_plane_bytes").inc(
                     pf.plane_nbytes
                 )
-            self._consec_errors.pop(pf.family, None)  # healthy again
+            self._consec_errors.pop(pf.key, None)  # healthy again
             self._failover_rounds.pop(pf.family, None)
-            breaker = self.breakers.get(pf.family)
+            breaker = self.breakers.get(pf.key)
             if breaker is not None:
                 breaker.record_success()
         except asyncio.CancelledError:
@@ -1912,7 +2305,7 @@ class TpuInferenceService(MultitenantService):
                 # a poisoned flush's dispatch failure was already counted
                 # at the flush site — recording it again here would let a
                 # downstream bus hiccup double-pace failover/parking
-                breaker = self.breakers.get(pf.family)
+                breaker = self.breakers.get(pf.key)
                 if breaker is not None:
                     breaker.record_failure()
                     if (
@@ -1925,26 +2318,28 @@ class TpuInferenceService(MultitenantService):
                                 pf.rec.get("trace_id") if pf.rec else None
                             ),
                         )
-                await self._note_scorer_error(pf.family)
+                await self._note_scorer_error(pf.family, pf.sl)
         finally:
             # the head leaves the queue only once its resolution is DONE
             # (either way) — queue length and the deliver_inflight gauge
-            # honestly count unfinished flushes, and the teardown drain
-            # can't miss a flush the reaper was cancelled inside
-            q = self._reap.get(pf.family)
+            # honestly count unfinished flushes, the teardown drain
+            # can't miss a flush the reaper was cancelled inside, and
+            # slice-move fences wait on exactly this flag
+            pf.resolved = True
+            q = self._reap.get(pf.key)
             if q and q[0] is pf:
                 q.popleft()
             self._deliver_gauge()
-            self._inflight.release()
+            self._inflight_sem(pf.key).release()
             if (
-                self._last_scores.get(pf.family) is pf.scores
-                and not self._reap.get(pf.family)
+                self._last_scores.get(pf.key) is pf.scores
+                and not self._reap.get(pf.key)
             ):
-                # family idle: the overlap probe must not pin this
+                # slice idle: the overlap probe must not pin this
                 # flush's device scores until the next (maybe never)
                 # flush — by now the probe is ready, so dropping it
                 # can't change the next overlap verdict
-                self._last_scores.pop(pf.family, None)
+                self._last_scores.pop(pf.key, None)
 
     # -- legacy object path (low-volume / tests) --------------------------
     async def _enqueue_events(self, engine: TpuInferenceEngine, events: List) -> List:
@@ -1979,6 +2374,10 @@ class TpuInferenceService(MultitenantService):
             # the weight ratio and a hostile tenant's backlog stays in
             # ITS bus topic (where lag → credit → receiver shed)
             self.fair.replenish()
+            if self._fences:
+                # slice moves in flight: release any whose old-slice
+                # snapshot fully resolved (parked rows re-enter lanes)
+                self._lift_fences()
             for tenant, engine in list(self.engines.items()):
                 if engine.state is not LifecycleState.STARTED:
                     continue
@@ -1986,9 +2385,9 @@ class TpuInferenceService(MultitenantService):
                 if engine.placement is not None:
                     # register for flush even when throttled below: lanes
                     # already holding this tenant's rows must still drain
-                    fam_cfgs.setdefault(engine.config.model, {})[
-                        self.router.global_slot(engine.placement)
-                    ] = engine.config
+                    fam_cfgs.setdefault(
+                        (engine.config.model, engine.placement.shard), {}
+                    )[engine.placement.slot] = engine.config
                 budget = self.fair.budget(tenant)
                 if budget <= 0:
                     throttled.inc()
@@ -1998,12 +2397,20 @@ class TpuInferenceService(MultitenantService):
                 # gauge, lag drives the credit signal, and retention
                 # bounds memory) instead of buffering unboundedly in
                 # lanes. 2× max_batch keeps the next flush fed.
-                lanes_now = self._lanes.get(engine.config.model, {})
-                slot_now = self.router.global_slot(engine.placement)
+                lanes_now = self._lanes.get(
+                    (engine.config.model, engine.placement.shard), {}
+                )
+                slot_now = engine.placement.slot
                 pending_rows = sum(
                     l.count for (s, _d), l in lanes_now.items()
                     if s == slot_now
                 )
+                fence_now = self._fences.get(tenant)
+                if fence_now is not None:
+                    # parked rows count against the watermark: a long
+                    # fence must backpressure intake into the bus, not
+                    # buffer unboundedly host-side
+                    pending_rows += fence_now.depth()
                 if pending_rows >= 2 * engine.config.microbatch.max_batch:
                     self.metrics.counter(
                         "tpu_inference.lane_backpressure"
@@ -2053,14 +2460,14 @@ class TpuInferenceService(MultitenantService):
                             self.bus, topic, ev, metrics=self.metrics
                         )
                     moved += len(objects)
-            for family, cfgs in fam_cfgs.items():
-                if family not in self.scorers:
+            for (family, sl), cfgs in fam_cfgs.items():
+                if (family, sl) not in self.scorers:
                     continue
                 mb = next(iter(cfgs.values())).microbatch
-                lanes = self._lanes[family]
+                lanes = self._lanes[(family, sl)]
                 full = any(l.count >= mb.max_batch for l in lanes.values())
-                if full or self._deadline_reached(family, mb.deadline_ms):
-                    moved += await self._flush_family(cfgs, family)
+                if full or self._deadline_reached((family, sl), mb.deadline_ms):
+                    moved += await self._flush_slice(cfgs, family, sl)
             if moved == 0:
                 await asyncio.sleep(0.001)
 
@@ -2093,22 +2500,31 @@ class TpuInferenceService(MultitenantService):
                 self.bus.publish_nowait(topic, item)
             raise
 
-    def _deadline_reached(self, family: str, deadline_ms: float) -> bool:
-        first = self._first_pending_ts.get(family)
+    def _deadline_reached(self, key: Tuple[str, int], deadline_ms: float) -> bool:
+        first = self._first_pending_ts.get(key)
         return first is not None and (time.monotonic() - first) * 1000.0 >= deadline_ms
 
     def prewarm(self) -> None:
         """Compile every active family's bucket shapes (see
         ShardedScorer.prewarm). Call after tenants are added, before
         latency-sensitive traffic."""
+        # union of every resident engine's bucket sizes per (family,
+        # slice): tenants sharing a slice may configure different
+        # buckets, and a missed size is a mid-scoring-loop XLA compile
+        wanted: Dict[Tuple[str, int], set] = {}
         for tenant, engine in self.engines.items():
             assert isinstance(engine, TpuInferenceEngine)
-            scorer = self.scorers.get(engine.config.model)
-            if scorer is None:
+            if engine.placement is None:
                 continue
+            key = (engine.config.model, engine.placement.shard)
             mb = engine.config.microbatch
-            sizes = [min(b, mb.max_batch) for b in mb.buckets] + [mb.max_batch]
-            scorer.prewarm(sizes)
+            wanted.setdefault(key, set()).update(
+                [min(b, mb.max_batch) for b in mb.buckets] + [mb.max_batch]
+            )
+        for key, sizes in wanted.items():
+            scorer = self.scorers.get(key)
+            if scorer is not None:
+                scorer.prewarm(sorted(sizes))
 
     def params_source(self, tenant: str):
         """A zero-arg callable yielding the tenant's CURRENT slot params
@@ -2121,12 +2537,12 @@ class TpuInferenceService(MultitenantService):
             engine = self.engines.get(tenant)
             if engine is None or engine.placement is None:
                 return None
-            scorer = self.scorers.get(engine.config.model)
+            scorer = self.scorers.get(
+                (engine.config.model, engine.placement.shard)
+            )
             if scorer is None:
                 return None
-            return scorer.slot_params(
-                self.router.global_slot(engine.placement)
-            )
+            return scorer.slot_params(engine.placement.slot)
 
         return source
 
@@ -2140,11 +2556,14 @@ class TpuInferenceService(MultitenantService):
             assert isinstance(engine, TpuInferenceEngine)
             if engine.placement is None:
                 continue
-            scorer = self.scorers.get(engine.config.model)
+            scorer = self.scorers.get(
+                (engine.config.model, engine.placement.shard)
+            )
             if scorer is None:
                 continue
-            slot = self.router.global_slot(engine.placement)
-            out[(tenant, engine.config.model)] = scorer.slot_params(slot)
+            out[(tenant, engine.config.model)] = scorer.slot_params(
+                engine.placement.slot
+            )
         return out
 
     # -- introspection ---------------------------------------------------
@@ -2153,7 +2572,11 @@ class TpuInferenceService(MultitenantService):
             "mesh": self.mm.describe(),
             "router": self.router.describe(),
             "families": {
-                f: {"n_slots": s.n_slots, "max_streams": s.max_streams}
-                for f, s in self.scorers.items()
+                f"{fam}@{sl}": {
+                    "n_slots": s.n_slots,
+                    "max_streams": s.max_streams,
+                    "device": s.device_label,
+                }
+                for (fam, sl), s in sorted(self.scorers.items())
             },
         }

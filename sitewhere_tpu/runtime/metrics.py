@@ -287,35 +287,47 @@ class MfuAccount:
 
     __slots__ = ("family", "peak", "_flops_c", "_secs_c", "_gauge", "_meter")
 
+    # per-DEVICE attribution names (multi-chip serving): the slice-anchored
+    # accounts must not share family names with the per-family aggregate —
+    # mixing label sets under one name would double-count sum() over the
+    # family (docs/OBSERVABILITY.md "Device-labeled metrics")
+    DEVICE_NAMES = (
+        "tpu_device_flops_total",
+        "tpu_device_busy_seconds_total",
+        "tpu_mfu_device_pct",
+    )
+
     def __init__(
         self,
         registry: "MetricsRegistry",
         family: str,
         peak: float = PEAK_FLOPS_BF16,
         window_s: float = 10.0,
+        flops_name: str = "tpu_flops_total",
+        secs_name: str = "tpu_device_seconds_total",
+        gauge_name: str = "tpu_mfu_pct",
         **extra_labels: str,
     ) -> None:
         self.family = family
         self.peak = float(peak)
         labels = {"family": family, **extra_labels}
         registry.describe(
-            "tpu_flops_total", "executed model FLOPs per family "
+            flops_name, "executed model FLOPs "
             "(analytic matmul count x padded plane rows)"
         )
         registry.describe(
-            "tpu_device_seconds_total",
+            secs_name,
             "wall seconds scoring dispatches were outstanding "
-            "(dispatch -> transfer landed) per family",
+            "(dispatch -> transfer landed)",
         )
         registry.describe(
-            "tpu_mfu_pct", "live MFU: windowed FLOP/s / chip peak x 100"
+            gauge_name, "live MFU: windowed FLOP/s / chip peak x 100"
         )
-        self._flops_c = registry.counter("tpu_flops_total", **labels)
-        self._secs_c = registry.counter(
-            "tpu_device_seconds_total", **labels
-        )
-        self._gauge = registry.gauge("tpu_mfu_pct", **labels)
-        self._meter = MeterRate(f"mfu.{family}", window_s=window_s)
+        self._flops_c = registry.counter(flops_name, **labels)
+        self._secs_c = registry.counter(secs_name, **labels)
+        self._gauge = registry.gauge(gauge_name, **labels)
+        key = ".".join([family, *extra_labels.values()])
+        self._meter = MeterRate(f"mfu.{key}", window_s=window_s)
 
     def record(self, flops: float, device_s: float) -> None:
         if flops <= 0 and device_s <= 0:

@@ -151,7 +151,7 @@ class _TenantHealth:
     """Rolling score-distribution state for one (tenant, family)."""
 
     __slots__ = (
-        "tenant", "family", "slot", "variant", "cur", "cur_rows",
+        "tenant", "family", "slot", "mesh_slice", "variant", "cur", "cur_rows",
         "windows", "ref", "ref_rows", "nan_window", "unscored_window",
         "nan_rate", "unscored_rate", "psi", "ks", "quantiles",
         "rows_total", "nan_total", "unscored_total", "last_rotate",
@@ -159,10 +159,12 @@ class _TenantHealth:
     )
 
     def __init__(self, tenant: str, family: str, slot: int,
-                 variant: Dict[str, object], nbins: int, now: float) -> None:
+                 variant: Dict[str, object], nbins: int, now: float,
+                 mesh_slice: int = 0) -> None:
         self.tenant = tenant
         self.family = family
         self.slot = slot
+        self.mesh_slice = mesh_slice
         self.variant = dict(variant)
         self.cur = np.zeros((nbins,), np.int64)
         self.cur_rows = 0
@@ -229,9 +231,9 @@ class ScoreHealth:
         self.psi_threshold = float(psi_threshold)
         self._clock = clock
         self._tenants: Dict[str, _TenantHealth] = {}
-        # (family, slot) → tenant key: the resolve path indexes sketches
-        # by stacked slot, never by name
-        self._slots: Dict[Tuple[str, int], str] = {}
+        # (family, mesh_slice, slot) → tenant key: the resolve path
+        # indexes sketches by per-slice stacked slot, never by name
+        self._slots: Dict[Tuple[str, int, int], str] = {}
         self._edges: Dict[str, np.ndarray] = {}     # family → interior edges
         self._canary: Dict[str, dict] = {}          # family → last canary
         registry.describe(
@@ -270,27 +272,32 @@ class ScoreHealth:
         slot: int,
         edges: np.ndarray,
         variant: Optional[Dict[str, object]] = None,
+        mesh_slice: int = 0,
     ) -> None:
         """(Re)bind a tenant to its stacked slot. A NEW registration (or a
         re-register after remove — tenant restart / param hot-swap at
         engine start) starts from a fresh, un-baselined state; a pure slot
-        move (failover) keeps the history — the model didn't change."""
+        move (failover — possibly onto a different MESH SLICE) keeps the
+        history — the model didn't change. ``slot`` is slice-LOCAL on
+        multi-slice meshes: sketches arrive per slice, so the slot→tenant
+        join is keyed (family, mesh_slice, slot)."""
         self._edges[family] = np.asarray(edges, np.float32)
         th = self._tenants.get(tenant)
         if th is not None and th.family == family:
             # slot re-map (failover): keep distributions and reference
-            self._slots.pop((family, th.slot), None)
+            self._slots.pop((family, th.mesh_slice, th.slot), None)
             th.slot = int(slot)
+            th.mesh_slice = int(mesh_slice)
             if variant is not None:
                 th.variant = dict(variant)
         else:
             if th is not None:
-                self._slots.pop((th.family, th.slot), None)
+                self._slots.pop((th.family, th.mesh_slice, th.slot), None)
             th = self._tenants[tenant] = _TenantHealth(
                 tenant, family, int(slot), variant or {}, self.nbins,
-                self._clock(),
+                self._clock(), mesh_slice=int(mesh_slice),
             )
-        self._slots[(family, int(slot))] = tenant
+        self._slots[(family, int(mesh_slice), int(slot))] = tenant
 
     def rebaseline(self, tenant: str) -> bool:
         """Drop the frozen reference and rolling windows — the warmup
@@ -330,7 +337,7 @@ class ScoreHealth:
         th = self._tenants.pop(tenant, None)
         if th is None:
             return
-        self._slots.pop((th.family, th.slot), None)
+        self._slots.pop((th.family, th.mesh_slice, th.slot), None)
         # cardinality guard: a removed tenant's score-health gauges must
         # not be exported forever — scoped to THIS module's families
         self.registry.drop_labeled(
@@ -347,10 +354,13 @@ class ScoreHealth:
         family: str,
         hist: np.ndarray,                    # i64/i32 [T, NBINS] merged over D
         nan_by_slot: Optional[np.ndarray] = None,   # i64 [T] NaN rows
+        mesh_slice: int = 0,
     ) -> None:
         """Fold one flush's device sketch into every registered tenant of
         the family. Vectorized per SLOT (≤ stacked slots per flush, never
-        per row); slots with no rows and no NaNs are skipped."""
+        per row); slots with no rows and no NaNs are skipped. On a
+        multi-slice mesh a flush carries ONE slice's sketch, so slot
+        indices resolve through (family, mesh_slice, slot)."""
         rows = hist.sum(axis=1)
         if nan_by_slot is None:
             touched = np.flatnonzero(rows)
@@ -358,7 +368,7 @@ class ScoreHealth:
             touched = np.flatnonzero(rows + nan_by_slot)
         now = self._clock()
         for slot in touched.tolist():
-            tenant = self._slots.get((family, slot))
+            tenant = self._slots.get((family, mesh_slice, slot))
             if tenant is None:
                 continue
             th = self._tenants[tenant]

@@ -194,10 +194,15 @@ async def test_chaos_zero_event_loss_with_dlq_and_requeue():
         ), "events vanished under publish faults + scorer crashes"
         # the scorer breaker tripped (breaker-first chaos policy) and rows
         # kept flowing unscored instead of hammering the crashing scorer
-        assert (
-            inst.metrics.counter("breaker.tpu_inference.lstm_ad.opened").value
-            >= 1
-        )
+        # breakers are per (family, mesh slice), and the tenant may
+        # have failed over OFF the faulting slice by now — the trip
+        # happened on whichever slice the faults landed
+        assert sum(
+            inst.metrics.counter(
+                f"breaker.tpu_inference.lstm_ad.s{_sl}.opened"
+            ).value
+            for _sl in range(inst.inference.mm.n_slices)
+        ) >= 1
         inst.bus.clear_faults(naming.decoded_events("acme"))
         inst.bus.clear_faults(naming.scored_events("acme"))
         assert await _wait_for(lambda: b <= _store_values(store)), \

@@ -132,8 +132,10 @@ async def test_tenant_params_persist_across_restart(tmp_path):
             break
         await asyncio.sleep(0.02)
     engine = inst.inference.engines["acme"]
-    scorer = inst.inference.scorers[engine.config.model]
-    slot = inst.inference.router.global_slot(engine.placement)
+    scorer = inst.inference.scorers[
+        (engine.config.model, engine.placement.shard)
+    ]
+    slot = engine.placement.slot
     # perturb the tenant's params so restore is observable
     marked = jax.tree_util.tree_map(
         lambda x: x + 1.25, scorer.slot_params(slot)
@@ -147,8 +149,10 @@ async def test_tenant_params_persist_across_restart(tmp_path):
     await inst2.start()
     await inst2.restore()
     engine2 = inst2.inference.engines["acme"]
-    scorer2 = inst2.inference.scorers[engine2.config.model]
-    slot2 = inst2.inference.router.global_slot(engine2.placement)
+    scorer2 = inst2.inference.scorers[
+        (engine2.config.model, engine2.placement.shard)
+    ]
+    slot2 = engine2.placement.slot
     got = scorer2.slot_params(slot2)
     for a, b in zip(
         jax.tree_util.tree_leaves(marked), jax.tree_util.tree_leaves(got)

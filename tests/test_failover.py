@@ -95,23 +95,28 @@ async def test_scorer_faults_trigger_failover_without_losing_events():
 
 
 async def test_failover_carries_trained_params():
+    """A failover move carries the tenant's live params onto the NEW
+    mesh slice's scorer and wipes the vacated slot — params follow the
+    tenant across chips."""
     inst = await _instance()
     try:
         import jax
 
         engine = inst.inference.engines["acme"]
-        scorer = inst.inference.scorers["lstm_ad"]
-        old_slot = inst.inference.router.global_slot(engine.placement)
+        old_p = engine.placement
+        old_scorer = inst.inference.scorers[("lstm_ad", old_p.shard)]
         # perturb the tenant's params so the carry-over is observable
         marked = jax.tree_util.tree_map(
-            lambda x: x + 0.75, scorer.slot_params(old_slot)
+            lambda x: x + 0.75, old_scorer.slot_params(old_p.slot)
         )
-        scorer.activate(old_slot, params=marked)
+        old_scorer.activate(old_p.slot, params=marked)
         ok = await inst.inference._failover_tenant(engine)
         assert ok
-        new_slot = inst.inference.router.global_slot(engine.placement)
-        assert new_slot != old_slot
-        got = scorer.slot_params(new_slot)
+        new_p = engine.placement
+        assert new_p.shard != old_p.shard
+        new_scorer = inst.inference.scorers[("lstm_ad", new_p.shard)]
+        assert new_scorer is not old_scorer
+        got = new_scorer.slot_params(new_p.slot)
         for a, b in zip(
             jax.tree_util.tree_leaves(marked), jax.tree_util.tree_leaves(got)
         ):
@@ -119,9 +124,9 @@ async def test_failover_carries_trained_params():
                 np.asarray(a), np.asarray(b), rtol=1e-5
             )
         # the vacated slot is wiped back to pristine
-        base = scorer._base_params
+        base = old_scorer._base_params
         for a, b in zip(
-            jax.tree_util.tree_leaves(scorer.slot_params(old_slot)),
+            jax.tree_util.tree_leaves(old_scorer.slot_params(old_p.slot)),
             jax.tree_util.tree_leaves(base),
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
@@ -169,8 +174,14 @@ async def test_persistent_faults_park_family_but_events_still_flow():
     inst = await _instance()
     try:
         svc = inst.inference
-        scorer = svc.scorers["lstm_ad"]
-        scorer.fault_steps = 10**9  # permanent fault
+        # the fault is chip-independent here: pre-build BOTH slices'
+        # scorers and poison them, so failover moves land on an equally
+        # broken slice and the park escalation engages
+        engine = svc.engines["acme"]
+        for sl in range(svc.mm.n_slices):
+            svc.scorer_for_slice("lstm_ad", sl, engine.config)
+        for _sl, sc in svc.scorers.family_items("lstm_ad"):
+            sc.fault_steps = 10**9  # permanent fault
         sim = DeviceSimulator(
             inst.broker, SimProfile(n_devices=6, seed=6, samples_per_message=5),
             topic_pattern="sitewhere/input/{device}",
@@ -195,7 +206,8 @@ async def test_persistent_faults_park_family_but_events_still_flow():
             await asyncio.sleep(0.02)
         assert persisted.value >= sim.sent, (persisted.value, sim.sent)
         # tenant restart clears the fault (rebuild) and unparks
-        scorer.fault_steps = 0
+        for _sl, sc in svc.scorers.family_items("lstm_ad"):
+            sc.fault_steps = 0
         await inst.restart_tenant("acme")
         assert "lstm_ad" not in svc._parked
         before = inst.metrics.counter("tpu_inference.scored_total").value

@@ -242,11 +242,13 @@ async def test_flush_uses_staging_and_records_feed_metrics():
         svc = inst.inference
         assert any(k[0] == "lstm_ad" for k in svc._staging)
         # ...and the result path reaped the flush through the device-side
-        # gather: d2h volume was rows-sized, not the T×lane plane
+        # gather: d2h volume is rows-sized, never MORE than the slice's
+        # T×lane plane (with per-slice serving the plane itself is small
+        # — a slice at/below the gather floor transfers exactly plane)
         assert inst.metrics.counter("tpu_inference.reaped").value >= 1
         d2h = inst.metrics.counter("tpu_inference.d2h_bytes").value
         plane = inst.metrics.counter("tpu_inference.d2h_plane_bytes").value
-        assert 0 < d2h < plane
+        assert 0 < d2h <= plane
         assert inst.metrics.gauge("tpu_inference_deliver_inflight").value == 0
     finally:
         await inst.terminate()

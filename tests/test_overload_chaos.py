@@ -140,8 +140,6 @@ async def test_sustained_overload_with_hostile_tenant():
         # compile is a latency excursion, not overload — not under test)
         inst.inference.prewarm()
         inst.inference.fair.quantum = 64
-        scorer = inst.inference.scorers["lstm_ad"]
-        orig_step = scorer.step_counts
 
         # slow the device→host materialization leg (a worker-thread
         # sleep, like a real TPU round-trip) rather than the dispatch:
@@ -160,10 +158,13 @@ async def test_sustained_overload_with_hostile_tenant():
                 a = np.asarray(self.inner)
                 return a.astype(dtype) if dtype is not None else a
 
-        def slow_step(ids, vals, counts):
-            return SlowScores(orig_step(ids, vals, counts))
+        # flush capacity must be scarce on EVERY mesh slice serving the
+        # family — tenants are spread across slices by the router
+        for _sl, sc in inst.inference.scorers.family_items("lstm_ad"):
+            def slow_step(ids, vals, counts, _orig=sc.step_counts):
+                return SlowScores(_orig(ids, vals, counts))
 
-        scorer.step_counts = slow_step
+            sc.step_counts = slow_step
         # a tight hostile receiver queue keeps the test's shed threshold
         # reachable (prod-sized 65536 would need minutes of backlog)
         h_rt = inst.tenants[HOSTILE]

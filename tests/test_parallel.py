@@ -26,6 +26,74 @@ def test_mesh_manager_axes():
     assert mm.n_devices == 8
 
 
+def test_slice_manager_sub_meshes():
+    """Each tenant-axis slice owns exactly its own (data × model)
+    devices, cached, with a stable anchor-device label."""
+    mm = MeshManager(tenant=4, data=2)
+    seen = []
+    for sl in range(mm.n_slices):
+        sub = mm.slice_manager(sl)
+        assert sub is mm.slice_manager(sl)  # cached
+        assert sub.n_tenant_shards == 1 and sub.n_data_shards == 2
+        devs = list(sub.mesh.devices.flat)
+        assert devs == list(mm.mesh.devices[sl].flat)
+        seen.extend(devs)
+        assert mm.slice_device_label(sl) == (
+            f"{devs[0].platform}:{devs[0].id}"
+        )
+    assert len(set(seen)) == 8  # slices partition the mesh
+    with pytest.raises(ValueError):
+        mm.slice_manager(4)
+
+
+def test_partition_rules_and_stacked_specs():
+    """match_partition_rules: first regex hit wins, scalars never
+    partition; stacked_specs: tenant axis prepended, named axes kept
+    only when the mesh has them AND they divide the dim."""
+    from jax.sharding import PartitionSpec as P
+
+    from sitewhere_tpu.parallel import partition as pt
+
+    tree = {"wx": {"w": np.zeros((1, 16)), "b": np.zeros((16,))},
+            "scale": np.float32(2.0)}
+    specs = pt.match_partition_rules(pt.MODEL_PARALLEL_RULES, tree)
+    assert specs["wx"]["w"] == P(None, "model")
+    assert specs["wx"]["b"] == P()
+    assert specs["scale"] == P()  # scalar guard
+    with pytest.raises(ValueError):
+        pt.match_partition_rules(((r"^only/this$", P()),), tree)
+
+    stacked = {"wx": {"w": np.zeros((8, 1, 16)), "b": np.zeros((8, 16))}}
+    # model=1 mesh: the model-axis ask is dropped → replicate in shard
+    mm = MeshManager(tenant=4, data=2)
+    ss = pt.stacked_specs(pt.MODEL_PARALLEL_RULES, stacked, mm.mesh)
+    assert ss["wx"]["w"] == P("tenant", None, None)
+    assert ss["wx"]["b"] == P("tenant", None)
+    # model=4 mesh: kept where the dim divides (16 % 4 == 0)...
+    mm4 = MeshManager(tenant=2, data=1, model=4)
+    ss4 = pt.stacked_specs(pt.MODEL_PARALLEL_RULES, stacked, mm4.mesh)
+    assert ss4["wx"]["w"] == P("tenant", None, "model")
+    # ...and dropped where it does not (15 % 4 != 0)
+    ragged = {"wx": {"w": np.zeros((8, 1, 15)), "b": np.zeros((8, 15))}}
+    ssr = pt.stacked_specs(pt.MODEL_PARALLEL_RULES, ragged, mm4.mesh)
+    assert ssr["wx"]["w"] == P("tenant", None, None)
+
+
+def test_shard_and_gather_fns_roundtrip():
+    from jax.sharding import PartitionSpec as P
+
+    from sitewhere_tpu.parallel import partition as pt
+
+    mm = MeshManager(tenant=4, data=2)
+    tree = {"w": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    specs = {"w": P("tenant")}
+    shard_fns, gather_fns = pt.make_shard_and_gather_fns(mm.mesh, specs)
+    placed = pt.shard_tree(tree, shard_fns)
+    assert placed["w"].sharding.spec == P("tenant")
+    back = gather_fns["w"](placed["w"])
+    np.testing.assert_array_equal(back, tree["w"])
+
+
 class TestTenantRouter:
     def test_balanced_placement_32_tenants(self):
         """The 32-tenant concurrent-scoring config (BASELINE.json:10)."""

@@ -206,11 +206,11 @@ async def test_out_of_order_across_families():
         await inst.bus.publish(
             inst.bus.naming.inbound_events("slowt"), _batch("slowt", toks_s, 16)
         )
-        assert await _wait_for(lambda: len(svc._reap.get("lstm_ad", [])) == 1)
+        assert await _wait_for(lambda: len(svc._reap.get(("lstm_ad", 0), [])) == 1)
         await inst.bus.publish(
             inst.bus.naming.inbound_events("fastt"), _batch("fastt", toks_f, 16)
         )
-        assert await _wait_for(lambda: len(svc._reap.get("deepar", [])) == 1)
+        assert await _wait_for(lambda: len(svc._reap.get(("deepar", 0), [])) == 1)
         gate_fast.set()  # only the NEWER family's transfer lands
         got_fast: list = []
 
@@ -220,7 +220,7 @@ async def test_out_of_order_across_families():
 
         assert await _poll(fast_arrived), "fast family blocked behind slow"
         # the slow family is STILL in flight — nothing delivered for it
-        assert len(svc._reap.get("lstm_ad", [])) == 1
+        assert len(svc._reap.get(("lstm_ad", 0), [])) == 1
         assert not await drain_slow()
         gate_slow.set()
         got_slow: list = []
@@ -272,12 +272,12 @@ async def test_in_order_per_tenant_within_family():
             inst.bus.naming.inbound_events("acme"),
             _batch("acme", toks, 8, base=100.0),
         )
-        assert await _wait_for(lambda: len(svc._reap.get("lstm_ad", [])) == 1)
+        assert await _wait_for(lambda: len(svc._reap.get(("lstm_ad", 0), [])) == 1)
         await inst.bus.publish(
             inst.bus.naming.inbound_events("acme"),
             _batch("acme", toks, 8, base=200.0),
         )
-        assert await _wait_for(lambda: len(svc._reap.get("lstm_ad", [])) == 2)
+        assert await _wait_for(lambda: len(svc._reap.get(("lstm_ad", 0), [])) == 2)
         assert len(gates) == 2
         gates[1].set()  # flush 2 lands first...
         await asyncio.sleep(0.3)
@@ -324,13 +324,13 @@ async def test_failed_dispatch_stays_fifo_per_tenant():
             inst.bus.naming.inbound_events("acme"),
             _batch("acme", toks, 8, base=100.0),
         )
-        assert await _wait_for(lambda: len(svc._reap.get("lstm_ad", [])) == 1)
+        assert await _wait_for(lambda: len(svc._reap.get(("lstm_ad", 0), [])) == 1)
         await inst.bus.publish(
             inst.bus.naming.inbound_events("acme"),
             _batch("acme", toks, 8, base=200.0),
         )
         # the failed flush queues as a poisoned entry BEHIND the gated one
-        assert await _wait_for(lambda: len(svc._reap.get("lstm_ad", [])) == 2)
+        assert await _wait_for(lambda: len(svc._reap.get(("lstm_ad", 0), [])) == 2)
         await asyncio.sleep(0.3)
         assert not await drain(), "failed flush overtook the in-flight one"
         gate.set()
@@ -382,8 +382,8 @@ async def test_blocked_publish_does_not_stall_other_families():
         # the resolve task is now blocked INSIDE its publish: the flush
         # stays at the head of its queue (it only leaves on resolution)
         assert await _wait_for(
-            lambda: "lstm_ad" in svc._resolving
-            and len(svc._reap.get("lstm_ad", [])) == 1
+            lambda: ("lstm_ad", 0) in svc._resolving
+            and len(svc._reap.get(("lstm_ad", 0), [])) == 1
         )
         await asyncio.sleep(0.2)  # give a head-of-line bug time to wedge
         await inst.bus.publish(
@@ -400,7 +400,7 @@ async def test_blocked_publish_does_not_stall_other_families():
             "healthy family stalled behind another family's full "
             "scored topic"
         )
-        assert "lstm_ad" in svc._resolving, (
+        assert ("lstm_ad", 0) in svc._resolving, (
             "slow family resolved despite its wedged topic"
         )
         # unwedge: the pinned group leaves → the publish unblocks and the
@@ -408,7 +408,7 @@ async def test_blocked_publish_does_not_stall_other_families():
         tp.retention = 65536
         inst.bus.unsubscribe(topic_s, "stall")
         assert await _wait_for(
-            lambda: not svc._resolving and not svc._reap.get("lstm_ad")
+            lambda: not svc._resolving and not svc._reap.get(("lstm_ad", 0))
         )
         assert inst.metrics.counter("tpu_inference.scored_total").value >= 32
     finally:
@@ -470,7 +470,7 @@ async def test_teardown_with_stuck_transfer_loses_nothing():
         await inst.bus.publish(
             inst.bus.naming.inbound_events("acme"), _batch("acme", toks, 10)
         )
-        assert await _wait_for(lambda: len(svc._reap.get("lstm_ad", [])) == 1)
+        assert await _wait_for(lambda: len(svc._reap.get(("lstm_ad", 0), [])) == 1)
         assert scored.value == 0
     finally:
         await inst.terminate()
@@ -511,7 +511,7 @@ async def test_result_path_metrics_flow():
         # the probe holds nothing once the family went idle (no leak of
         # a full flush of device score memory)
         assert await _wait_for(
-            lambda: "lstm_ad" not in inst.inference._last_scores
+            lambda: ("lstm_ad", 0) not in inst.inference._last_scores
         )
     finally:
         await inst.terminate()

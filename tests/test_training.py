@@ -69,8 +69,10 @@ async def test_pipeline_trains_and_model_adapts():
         assert trains.value > 3, "training cadence never fired"
         # params measurably diverged from the pristine base
         engine = inst.inference.engines["acme"]
-        scorer = inst.inference.scorers["lstm_ad"]
-        slot = inst.inference.router.global_slot(engine.placement)
+        scorer = inst.inference.scorers[
+            ("lstm_ad", engine.placement.shard)
+        ]
+        slot = engine.placement.slot
         import jax
 
         diffs = [
@@ -183,15 +185,16 @@ async def test_disabled_training_tenant_is_masked_in_shared_stack():
         assert trains.value >= 2
         import jax
 
-        scorer = inst.inference.scorers["lstm_ad"]
-
         def diverged(tenant):
             engine = inst.inference.engines[tenant]
-            slot = inst.inference.router.global_slot(engine.placement)
+            place = engine.placement
+            scorer = inst.inference.scorers[
+                (engine.config.model, place.shard)
+            ]
             return max(
                 float(np.abs(np.asarray(a) - np.asarray(b)).max())
                 for a, b in zip(
-                    jax.tree_util.tree_leaves(scorer.slot_params(slot)),
+                    jax.tree_util.tree_leaves(scorer.slot_params(place.slot)),
                     jax.tree_util.tree_leaves(scorer._base_params),
                 )
             )
@@ -223,7 +226,11 @@ async def test_wire_dtype_conflict_surfaces():
         conflicts = inst.metrics.counter(
             "tpu_inference.wire_dtype_conflicts")
         assert conflicts.value == 1
-        # the family runs at the FIRST tenant's wire (documented first-wins)
-        assert inst.inference.scorers["lstm_ad"].wire_dtype == "bf16"
+        # the family runs at the FIRST tenant's wire (documented
+        # first-wins) — on EVERY slice it is served from
+        slices = inst.inference.scorers.family_items("lstm_ad")
+        assert slices and all(
+            sc.wire_dtype == "bf16" for _sl, sc in slices
+        )
     finally:
         await inst.terminate()

@@ -48,7 +48,12 @@ _THROUGHPUT_SUFFIXES = ("_ev_s", "_fps", "_fc_s", "_mbps", "_mbps_staged")
 # one process (common-mode drift cancels in the ratio). The 2-core CPU
 # dev rig's ±10% step noise would make this gate flake — but that rig's
 # headlines are never recorded as baselines (docs/PERF_NOTES.md).
-_THROUGHPUT_EXACT = {"mfu_32t_pct", "fused_speedup_32t"}
+# ev_s_8dev (ISSUE 11): total events/s over the 8-device mesh serving
+# row — the direct horizontal-scale figure; chip-recorded baselines
+# gate it like any throughput key (new key reports n/a against
+# single-chip baselines). mesh_balance stays info-class: a balance dip
+# is a routing-quality signal, not a throughput regression per se.
+_THROUGHPUT_EXACT = {"mfu_32t_pct", "fused_speedup_32t", "ev_s_8dev"}
 
 
 def classify(key: str) -> str:

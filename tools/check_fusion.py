@@ -97,6 +97,34 @@ def _count_dots(jaxpr) -> int:
     return n
 
 
+# cross-device communication primitives. The serving hot path (the
+# per-slice scoring step + gather) must contain NONE of these: a
+# collective gang-schedules a rendezvous across devices per flush —
+# it deadlocks concurrent flush dispatch on the forced-host CPU rig and
+# serializes the mesh on a pod (the PR 5 gotcha, now a structural
+# check). The TRAIN step's data-axis psum is the one sanctioned
+# exception, and it never runs on the serving path.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "all_gather", "all_reduce", "reduce_scatter",
+    "all_to_all", "ppermute", "collective_permute", "pmin", "pmax",
+    "psum_scatter", "pgather", "all_gather_invariant",
+})
+
+
+def collective_eqns(jaxpr) -> List[str]:
+    """Collective-primitive names anywhere in ``jaxpr``, recursing into
+    nested call/scan/shard_map bodies. The multi-chip serving test
+    asserts this returns [] for the compiled per-slice step — zero
+    cross-slice (or intra-slice) collectives on the hot path."""
+    out: List[str] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            out.append(eqn.primitive.name)
+    for _eqn, sub in _subjaxprs(jaxpr):
+        out.extend(collective_eqns(sub))
+    return out
+
+
 def _degenerate_contractions(jaxpr) -> int:
     """dot_general eqns in ``jaxpr`` (recursing into nested call
     bodies) whose contracting dims include a size-1 axis — the

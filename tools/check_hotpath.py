@@ -54,13 +54,17 @@ SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
 HOT_PATHS: Dict[str, List[str]] = {
     "pipeline/inference.py": [
         "TpuInferenceService._enqueue_batch",
-        "TpuInferenceService._flush_family",
+        # the slice-routed flush + completion path (multi-chip serving):
+        # every function here runs per flush per SLICE at full rate
+        "TpuInferenceService._flush_slice",
         "TpuInferenceService._resolve_rows",
         "TpuInferenceService._reap_loop",
         "TpuInferenceService._resolve_flush",
         "TpuInferenceService._canary_compare",
+        "TpuInferenceService._deliver_gauge",
         "_LaneRing.push",
         "_LaneRing.pop_into",
+        "_SliceFence.park",
     ],
     # the score-quality feed runs once per resolved flush at full ingest
     # rate: sketches fold in as vectorized 64-bin adds per touched slot,

@@ -73,21 +73,35 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
     },
     ("pipeline/inference.py", r"_ReapQueue\("): {
         "queue": "deliver reap queues (in-flight flush completions per "
-                 "family; bounded by the max_inflight semaphore)",
+                 "(family, mesh slice); bounded by the max_inflight "
+                 "semaphore)",
         "depth_gauge": "tpu_inference_deliver_inflight",
         # per-family labeled variant beside the legacy aggregate: the
-        # queues ARE per-family, so a wedged family shows here while the
-        # aggregate hides it behind healthy siblings
+        # queues ARE per-(family, slice), so a wedged family shows here
+        # while the aggregate hides it behind healthy siblings
         "family_depth_gauge": "tpu_inference_deliver_inflight_family",
+        # ...and the per-DEVICE variant (multi-chip serving): one slow
+        # chip's queue depth must be visible as THAT chip's, not
+        # averaged into the fleet
+        "device_depth_gauge": "tpu_inference_deliver_inflight_device",
         # completions never shed: a full in-flight window backpressures
         # the NEXT flush at the semaphore (counted before the acquire)
         "backpressure_counter": "tpu_inference.deliver_backpressure",
+    },
+    ("pipeline/inference.py", r"\[_StagingSet\("): {
+        "queue": "per-(family, mesh-slice, bucket) rotating flush "
+                 "staging sets (bounded by staging_slots per rotation)",
+        "depth_gauge": "tpu_inference_staging_sets",
+        # staging never sheds: recycling a set whose async h2d copy is
+        # still in flight BLOCKS until the transfer lands (counted)
+        "backpressure_counter": "tpu_inference.stage_reuse_waits",
     },
 }
 
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
-    r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\(|= _ReplayRing\()"
+    r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\(|= _ReplayRing\("
+    r"|\[_StagingSet\()"
 )
 
 
