@@ -912,6 +912,11 @@ class SiteWhereInstance(LifecycleComponent):
         m.describe(
             "media_queue_depth", "pending frames per tenant media pipeline"
         )
+        m.describe(
+            "media_ring_bytes",
+            "resident compressed-frame ring bytes per tenant media "
+            "pipeline (the byte watermark the arena bounds)",
+        )
         if isinstance(self.bus, EventBus):
             # remote buses answer lags() over the wire — the async
             # /metrics handler awaits it and feeds apply_lag_gauges
@@ -938,6 +943,9 @@ class SiteWhereInstance(LifecycleComponent):
             if rt.media_pipeline is not None:
                 m.gauge("media_queue_depth", tenant=token).set(
                     rt.media_pipeline.pending_frames()
+                )
+                m.gauge("media_ring_bytes", tenant=token).set(
+                    rt.media_pipeline.pending_bytes()
                 )
 
     def apply_lag_gauges(self, lags: Dict[str, dict]) -> None:

@@ -93,6 +93,31 @@ def apply(params: Params, cfg: ViTConfig, images: jnp.ndarray) -> jnp.ndarray:
     return dense(params["head"], x[:, 0], dtype).astype(jnp.float32)
 
 
+def apply_dct(
+    params: Params,
+    cfg: ViTConfig,
+    y_z: jnp.ndarray,
+    cb_z: jnp.ndarray,
+    cr_z: jnp.ndarray,
+    layout,
+) -> jnp.ndarray:
+    """Compressed-wire forward: truncated zigzag DCT coefficients →
+    logits, decode fused INTO preprocessing (one XLA program).
+
+    The media pipeline ships jpegwire's entropy-decoded coefficient
+    planes instead of raw RGB (h2d payload ~5-20× smaller); the
+    embarrassingly parallel reconstruction — dezigzag, IDCT, chroma
+    upsample, YCbCr→RGB, normalization — runs here as einsums feeding
+    straight into patchify, so no intermediate frame buffer ever
+    materializes on host OR in HBM. ``layout`` is a static
+    ``ops.dct.FrameLayout`` (part of the jit cache key)."""
+    from sitewhere_tpu.ops.dct import decode_frames
+
+    rgb = decode_frames(y_z, cb_z, cr_z, layout)   # f32 0..255
+    images = (rgb / 255.0 - 0.5) / 0.5             # the u8 wire's norm
+    return apply(params, cfg, images)
+
+
 def loss(params: Params, cfg: ViTConfig, images: jnp.ndarray, labels: jnp.ndarray):
     logits = apply(params, cfg, images)
     logp = jax.nn.log_softmax(logits)

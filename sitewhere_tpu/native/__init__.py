@@ -11,6 +11,10 @@ Current components:
 - ``jsonwire``: bulk parser for the dominant JSON telemetry wire shape,
   feeding the columnar ingest path directly (values f32 / event_ts f64
   into preallocated numpy buffers).
+- ``jpegwire`` (sitewhere_tpu/native/jpegwire.py): baseline-JPEG entropy
+  decoder for the compressed media wire — Huffman + dequant per frame
+  into dense int16 DCT coefficient blocks; the IDCT and everything after
+  it runs on device (sitewhere_tpu/ops/dct.py).
 """
 
 from __future__ import annotations
@@ -33,20 +37,21 @@ _BUILT = threading.Event()
 SW_UNSUPPORTED, SW_MALFORMED, SW_OVERFLOW = -1, -2, -3
 
 
-def _build_lib() -> Optional[ctypes.CDLL]:
-    """Compile (once, content-hashed) and load the jsonwire library.
-    Returns None when no toolchain is available — callers fall back."""
+def build_native_lib(src: Path, name: str) -> Optional[ctypes.CDLL]:
+    """Compile (once, content-hashed) and load one csrc/ library.
+    Returns None when no toolchain is available — callers fall back.
+    Shared by every native component (jsonwire, jpegwire)."""
     try:
-        src = _SRC.read_bytes()
+        src_bytes = src.read_bytes()
     except OSError:
         return None
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    tag = hashlib.sha256(src_bytes).hexdigest()[:16]
     build_dir = _HERE / "_build"
-    so_path = build_dir / f"jsonwire-{tag}.so"
+    so_path = build_dir / f"{name}-{tag}.so"
     if not so_path.exists():
         build_dir.mkdir(parents=True, exist_ok=True)
         tmp = so_path.with_suffix(f".tmp{os.getpid()}")
-        cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+        cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(src)]
         try:
             subprocess.run(
                 cmd, check=True, capture_output=True, timeout=120
@@ -59,8 +64,15 @@ def _build_lib() -> Optional[ctypes.CDLL]:
                 pass
             return None
     try:
-        lib = ctypes.CDLL(str(so_path))
+        return ctypes.CDLL(str(so_path))
     except OSError:
+        return None
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    """Compile and bind the jsonwire library (or None — callers fall back)."""
+    lib = build_native_lib(_SRC, "jsonwire")
+    if lib is None:
         return None
     lib.sw_parse_bulk.restype = ctypes.c_long
     lib.sw_parse_bulk.argtypes = [

@@ -1,0 +1,448 @@
+/* jpegwire.c — native batched JPEG entropy decoder for the media wire.
+ *
+ * The media counterpart of jsonwire.c (which killed the JSON tax on the
+ * scalar event wire): camera frames now cross the host boundary as
+ * compressed JPEG bytes, and the SERIAL part of the decode — Huffman
+ * entropy decoding + dequantization, branchy bit-twiddling no
+ * accelerator wants — runs here, per frame, on an executor thread pool.
+ * The output is dense int16 DCT coefficient blocks in ZIGZAG order; the
+ * embarrassingly parallel rest (dezigzag, IDCT, chroma upsample,
+ * YCbCr→RGB, ViT patchify) runs ON DEVICE as one fused jit
+ * (sitewhere_tpu/ops/dct.py), so the host→device payload is truncated
+ * coefficient planes instead of raw RGB pixels.
+ *
+ * Scope (speed, not coverage — anything else returns SW_UNSUPPORTED and
+ * the caller falls back to the PIL path, exactly like jsonwire's bail
+ * semantics): baseline sequential DCT (SOF0), 8-bit precision, 3
+ * components (YCbCr), sampling 4:4:4 (all 1x1) or 4:2:0 (Y 2x2, C 1x1),
+ * 8-bit quant tables, optional restart intervals. Progressive (SOF2),
+ * arithmetic coding, 12-bit, CMYK, 4:2:2 and exotic samplings all bail.
+ *
+ * Output layout: per component, blocks in raster order over the padded
+ * (MCU-aligned) block grid; each block is 64 int16 DEQUANTIZED
+ * coefficients in zigzag order. info[] reports the true pixel dims, the
+ * padded grids, the subsampling mode, and the max nonzero zigzag extent
+ * per component group — the Python side buckets that extent into the
+ * static truncation width it ships to the chip (coefficients past the
+ * extent are exactly zero, so truncation is lossless).
+ *
+ * Build: cc -O3 -shared -fPIC (see sitewhere_tpu/native/__init__.py).
+ */
+
+#include <stddef.h>
+#include <string.h>
+
+#define SW_UNSUPPORTED (-1)
+#define SW_MALFORMED   (-2)
+#define SW_OVERFLOW    (-3)
+
+/* ---------------------------------------------------------------- tables */
+
+typedef struct {
+    unsigned char symbols[256];   /* in code order                       */
+    int mincode[17], maxcode[17], valptr[17];
+    short fast[256];              /* (len<<8)|symbol for codes <= 8 bits */
+    int valid;
+} huff_t;
+
+typedef struct {
+    unsigned short q[64];         /* zigzag order, 8-bit baseline values */
+    int valid;
+} qtab_t;
+
+static int huff_build(huff_t *h, const unsigned char *counts,
+                      const unsigned char *symbols, int nsyms) {
+    int code = 0, k = 0, i, l;
+    memcpy(h->symbols, symbols, (size_t)nsyms);
+    for (i = 0; i < 256; i++) h->fast[i] = -1;
+    for (l = 1; l <= 16; l++) {
+        h->valptr[l] = k;
+        h->mincode[l] = code;
+        if (counts[l - 1]) {
+            if (code + counts[l - 1] > (1 << l))
+                return SW_MALFORMED;              /* oversubscribed */
+            if (l <= 8) {
+                int c;
+                for (c = 0; c < counts[l - 1]; c++) {
+                    /* every 8-bit prefix of this code resolves to it */
+                    int shift = 8 - l;
+                    int base = (code + c) << shift, j;
+                    for (j = 0; j < (1 << shift); j++)
+                        h->fast[base + j] =
+                            (short)((l << 8) | symbols[k + c]);
+                }
+            }
+            k += counts[l - 1];
+            code += counts[l - 1];
+        }
+        h->maxcode[l] = code - 1;
+        code <<= 1;
+    }
+    h->valid = 1;
+    return 0;
+}
+
+/* ------------------------------------------------------------ bit reader */
+
+typedef struct {
+    const unsigned char *p, *end;
+    unsigned int bits;   /* MSB-first; low ``nbits`` bits are pending */
+    int nbits;
+    int marker;          /* stopped at a non-stuffing marker          */
+    long synth;          /* synthetic zero bits fed past the data end */
+} br_t;
+
+static void br_init(br_t *b, const unsigned char *p,
+                    const unsigned char *end) {
+    b->p = p; b->end = end; b->bits = 0; b->nbits = 0;
+    b->marker = 0; b->synth = 0;
+}
+
+static void br_fill(br_t *b) {
+    while (b->nbits <= 24) {
+        unsigned int c;
+        if (b->marker || b->p >= b->end) {
+            b->marker = 1;
+            b->bits <<= 8;                        /* zero padding */
+            b->nbits += 8;
+            b->synth += 8;
+            continue;
+        }
+        c = *b->p++;
+        if (c == 0xFF) {
+            if (b->p < b->end && *b->p == 0x00) {
+                b->p++;                           /* byte stuffing */
+            } else {
+                b->p--;                           /* leave marker unread */
+                b->marker = 1;
+                continue;
+            }
+        }
+        b->bits = (b->bits << 8) | c;
+        b->nbits += 8;
+    }
+}
+
+static int br_getbits(br_t *b, int n) {
+    int v;
+    if (n == 0) return 0;
+    if (b->nbits < n) br_fill(b);
+    v = (int)((b->bits >> (b->nbits - n)) & ((1u << n) - 1));
+    b->nbits -= n;
+    return v;
+}
+
+/* Consumed-synthetic check: synthetic bits are always the most recently
+ * fed, so the count CONSUMED so far is synth_fed - still_pending (never
+ * negative). A valid stream's last entropy bit is a real bit — any
+ * consumed synthetic bit means the data ran out mid-scan (torn frame). */
+static long br_synth_consumed(const br_t *b) {
+    long pend = b->nbits < 0 ? 0 : b->nbits;
+    long c = b->synth - pend;
+    return c > 0 ? c : 0;
+}
+
+static int huff_decode(br_t *b, const huff_t *h) {
+    int code, l;
+    short f;
+    if (b->nbits < 16) br_fill(b);
+    f = h->fast[(b->bits >> (b->nbits - 8)) & 0xFF];
+    if (f >= 0) {
+        b->nbits -= (f >> 8);
+        return f & 0xFF;
+    }
+    code = 0;
+    for (l = 1; l <= 16; l++) {
+        code = (code << 1) | br_getbits(b, 1);
+        if (h->maxcode[l] >= h->mincode[l] && code >= h->mincode[l]
+            && code <= h->maxcode[l])
+            return h->symbols[h->valptr[l] + (code - h->mincode[l])];
+    }
+    return -1;
+}
+
+/* JPEG F.2.2.1 sign extension */
+static int receive_extend(br_t *b, int s) {
+    int v = br_getbits(b, s);
+    if (v < (1 << (s - 1))) v += ((-1) << s) + 1;
+    return v;
+}
+
+/* -------------------------------------------------------------- helpers */
+
+static unsigned int rd16(const unsigned char *p) {
+    return ((unsigned int)p[0] << 8) | p[1];
+}
+
+static short clamp16(long v) {
+    if (v > 32767) return 32767;
+    if (v < -32768) return -32768;
+    return (short)v;
+}
+
+/* ------------------------------------------------------------ the codec */
+
+typedef struct {
+    int h, v, qi;         /* sampling factors, quant table id      */
+    int dc_id, ac_id;     /* huffman table ids (from SOS)          */
+    int bw, bh;           /* padded block-grid dims                */
+    int pred;             /* DC predictor                          */
+    short *out;           /* coefficient output base               */
+    int maxk;             /* max nonzero zigzag index+1 seen       */
+} comp_t;
+
+/* Decode one 8x8 block into out[64] (zigzag order, dequantized). */
+static int decode_block(br_t *b, comp_t *c, const huff_t *dc,
+                        const huff_t *ac, const qtab_t *q, short *out) {
+    int t, k;
+    memset(out, 0, 64 * sizeof(short));
+    t = huff_decode(b, dc);
+    if (t < 0 || t > 11) return SW_MALFORMED;
+    if (t) c->pred += receive_extend(b, t);
+    out[0] = clamp16((long)c->pred * (long)q->q[0]);
+    if (out[0] && c->maxk < 1) c->maxk = 1;
+    k = 1;
+    while (k < 64) {
+        int rs = huff_decode(b, ac);
+        int r, s;
+        if (rs < 0) return SW_MALFORMED;
+        r = rs >> 4; s = rs & 15;
+        if (s == 0) {
+            if (r == 15) { k += 16; continue; }   /* ZRL */
+            break;                                 /* EOB */
+        }
+        k += r;
+        if (k > 63) return SW_MALFORMED;
+        out[k] = clamp16((long)receive_extend(b, s) * (long)q->q[k]);
+        if (out[k] && k + 1 > c->maxk) c->maxk = k + 1;
+        k++;
+    }
+    return 0;
+}
+
+/* Entry point.
+ *
+ * buf/len: one complete JPEG file. ycoef: int16[ycap_blocks][64];
+ * cbcoef/crcoef: int16[ccap_blocks][64] each. All zigzag, dequantized.
+ * Blocks land in raster order over the PADDED (MCU-aligned) grid.
+ *
+ * info (out, 10 ints): 0 width, 1 height, 2 y grid w (blocks), 3 y grid
+ * h, 4 c grid w, 5 c grid h, 6 subsampling (1 = 4:4:4, 2 = 4:2:0),
+ * 7 max nonzero zigzag extent over Y blocks, 8 same over Cb+Cr,
+ * 9 number of Y blocks written.
+ *
+ * Returns the number of Y blocks (> 0) or SW_UNSUPPORTED /
+ * SW_MALFORMED / SW_OVERFLOW. */
+long sw_jpeg_decode(const unsigned char *buf, long len,
+                    short *ycoef, long ycap_blocks,
+                    short *cbcoef, short *crcoef, long ccap_blocks,
+                    int *info) {
+    const unsigned char *p = buf, *end = buf + len;
+    qtab_t qtabs[4];
+    huff_t hdc[4], hac[4];
+    comp_t comps[3];
+    int width = 0, height = 0, ncomp = 0, sub = 0;
+    int comp_id[3] = {0, 0, 0};
+    int restart_interval = 0;
+    int have_sof = 0, have_sos = 0;
+    int i;
+
+    memset(qtabs, 0, sizeof(qtabs));
+    memset(hdc, 0, sizeof(hdc));
+    memset(hac, 0, sizeof(hac));
+    memset(comps, 0, sizeof(comps));
+
+    if (len < 4 || p[0] != 0xFF || p[1] != 0xD8) return SW_UNSUPPORTED;
+    p += 2;
+
+    /* ---- marker segment loop (until SOS) ---- */
+    while (!have_sos) {
+        unsigned int m, seglen;
+        const unsigned char *seg;
+        while (p + 1 < end && p[0] == 0xFF && p[1] == 0xFF)
+            p++;                                 /* fill bytes */
+        if (p + 2 > end || p[0] != 0xFF) return SW_MALFORMED;
+        m = p[1];
+        p += 2;
+        if (m == 0xD8) continue;                 /* stray SOI */
+        if (m == 0xD9) return SW_MALFORMED;      /* EOI before SOS */
+        if (p + 2 > end) return SW_MALFORMED;
+        seglen = rd16(p);
+        if (seglen < 2 || p + seglen > end) return SW_MALFORMED;
+        seg = p + 2;
+        p += seglen;
+
+        switch (m) {
+        case 0xDB: {                             /* DQT */
+            const unsigned char *q = seg, *qend = p;
+            while (q < qend) {
+                int pq = q[0] >> 4, tq = q[0] & 15;
+                if (pq != 0) return SW_UNSUPPORTED;   /* 16-bit tables */
+                if (tq > 3) return SW_MALFORMED;
+                if (q + 1 + 64 > qend) return SW_MALFORMED;
+                q++;
+                for (i = 0; i < 64; i++) qtabs[tq].q[i] = q[i];
+                qtabs[tq].valid = 1;
+                q += 64;
+            }
+            break;
+        }
+        case 0xC4: {                             /* DHT */
+            const unsigned char *q = seg, *qend = p;
+            while (q < qend) {
+                int tc, th, nsyms = 0, rc;
+                if (q + 17 > qend) return SW_MALFORMED;
+                tc = q[0] >> 4; th = q[0] & 15;
+                if (tc > 1 || th > 3) return SW_UNSUPPORTED;
+                for (i = 0; i < 16; i++) nsyms += q[1 + i];
+                if (nsyms > 256 || q + 17 + nsyms > qend)
+                    return SW_MALFORMED;
+                rc = huff_build(tc ? &hac[th] : &hdc[th], q + 1,
+                                q + 17, nsyms);
+                if (rc) return rc;
+                q += 17 + nsyms;
+            }
+            break;
+        }
+        case 0xC0: {                             /* SOF0 baseline */
+            int prec;
+            if (have_sof) return SW_MALFORMED;
+            if (seglen < 2 + 6) return SW_MALFORMED;
+            prec = seg[0];
+            height = (int)rd16(seg + 1);
+            width = (int)rd16(seg + 3);
+            ncomp = seg[5];
+            if (prec != 8 || ncomp != 3) return SW_UNSUPPORTED;
+            if (width <= 0 || height <= 0) return SW_MALFORMED;
+            if (seglen < (unsigned int)(2 + 6 + 3 * ncomp))
+                return SW_MALFORMED;
+            for (i = 0; i < 3; i++) {
+                comp_id[i] = seg[6 + 3 * i];
+                comps[i].h = seg[6 + 3 * i + 1] >> 4;
+                comps[i].v = seg[6 + 3 * i + 1] & 15;
+                comps[i].qi = seg[6 + 3 * i + 2];
+                if (comps[i].qi > 3) return SW_MALFORMED;
+            }
+            if (comps[1].h != 1 || comps[1].v != 1
+                || comps[2].h != 1 || comps[2].v != 1)
+                return SW_UNSUPPORTED;
+            if (comps[0].h == 1 && comps[0].v == 1) sub = 1;
+            else if (comps[0].h == 2 && comps[0].v == 2) sub = 2;
+            else return SW_UNSUPPORTED;          /* 4:2:2 & friends */
+            have_sof = 1;
+            break;
+        }
+        /* every other SOF flavor: progressive, arithmetic, 12-bit... */
+        case 0xC1: case 0xC2: case 0xC3: case 0xC5: case 0xC6:
+        case 0xC7: case 0xC9: case 0xCA: case 0xCB: case 0xCD:
+        case 0xCE: case 0xCF:
+            return SW_UNSUPPORTED;
+        case 0xDD:                                /* DRI */
+            if (seglen < 4) return SW_MALFORMED;
+            restart_interval = (int)rd16(seg);
+            break;
+        case 0xDA: {                              /* SOS */
+            int ns;
+            if (!have_sof) return SW_MALFORMED;
+            if (seglen < 2 + 1) return SW_MALFORMED;
+            ns = seg[0];
+            if (ns != 3) return SW_UNSUPPORTED;
+            if (seglen < (unsigned int)(2 + 1 + 2 * ns + 3))
+                return SW_MALFORMED;
+            for (i = 0; i < 3; i++) {
+                /* scan order must match SOF order: we decode MCUs
+                 * positionally, so a reordered scan would cross the
+                 * planes/tables silently — bail to the PIL path */
+                if (seg[1 + 2 * i] != comp_id[i]) return SW_UNSUPPORTED;
+                comps[i].dc_id = seg[1 + 2 * i + 1] >> 4;
+                comps[i].ac_id = seg[1 + 2 * i + 1] & 15;
+                if (comps[i].dc_id > 3 || comps[i].ac_id > 3)
+                    return SW_MALFORMED;
+            }
+            have_sos = 1;
+            break;
+        }
+        default:
+            break;                                /* APPn, COM, ... */
+        }
+    }
+
+    /* ---- validate tables ---- */
+    for (i = 0; i < 3; i++) {
+        if (!qtabs[comps[i].qi].valid) return SW_MALFORMED;
+        if (!hdc[comps[i].dc_id].valid || !hac[comps[i].ac_id].valid)
+            return SW_MALFORMED;
+    }
+
+    {
+        int mcu_px = 8 * comps[0].h;             /* h==v for both modes */
+        int mcu_w = (width + mcu_px - 1) / mcu_px;
+        int mcu_h = (height + mcu_px - 1) / mcu_px;
+        long n_yblocks = (long)mcu_w * mcu_h * comps[0].h * comps[0].v;
+        long n_cblocks = (long)mcu_w * mcu_h;
+        int mx, my, mcus_done = 0;
+        br_t br;
+
+        comps[0].bw = mcu_w * comps[0].h;
+        comps[0].bh = mcu_h * comps[0].v;
+        comps[1].bw = comps[2].bw = mcu_w;
+        comps[1].bh = comps[2].bh = mcu_h;
+        if (n_yblocks > ycap_blocks || n_cblocks > ccap_blocks)
+            return SW_OVERFLOW;
+        comps[0].out = ycoef;
+        comps[1].out = cbcoef;
+        comps[2].out = crcoef;
+
+        br_init(&br, p, end);
+        for (my = 0; my < mcu_h; my++) {
+            for (mx = 0; mx < mcu_w; mx++) {
+                int ci;
+                if (restart_interval && mcus_done
+                    && mcus_done % restart_interval == 0) {
+                    /* byte-align (pending bits are pre-marker padding),
+                     * expect RSTn at the marker stop, reset preds */
+                    const unsigned char *rp = br.p;
+                    if (br_synth_consumed(&br) > 0) return SW_MALFORMED;
+                    if (rp + 2 > end || rp[0] != 0xFF
+                        || (rp[1] & 0xF8) != 0xD0)
+                        return SW_MALFORMED;
+                    br_init(&br, rp + 2, end);
+                    for (ci = 0; ci < 3; ci++) comps[ci].pred = 0;
+                }
+                for (ci = 0; ci < 3; ci++) {
+                    comp_t *c = &comps[ci];
+                    int bx, by;
+                    for (by = 0; by < c->v; by++) {
+                        for (bx = 0; bx < c->h; bx++) {
+                            long row = (long)my * c->v + by;
+                            long col = (long)mx * c->h + bx;
+                            long idx = row * c->bw + col;
+                            int rc = decode_block(
+                                &br, c, &hdc[c->dc_id],
+                                &hac[c->ac_id], &qtabs[c->qi],
+                                c->out + idx * 64);
+                            if (rc) return rc;
+                        }
+                    }
+                }
+                mcus_done++;
+            }
+        }
+        /* torn-frame check: a valid scan's last entropy bit is a real
+         * bit — consuming any synthetic padding means the data ran out
+         * before the MCU count did */
+        if (br_synth_consumed(&br) > 0) return SW_MALFORMED;
+
+        if (info) {
+            info[0] = width; info[1] = height;
+            info[2] = comps[0].bw; info[3] = comps[0].bh;
+            info[4] = comps[1].bw; info[5] = comps[1].bh;
+            info[6] = sub;
+            info[7] = comps[0].maxk;
+            info[8] = comps[1].maxk > comps[2].maxk
+                          ? comps[1].maxk : comps[2].maxk;
+            info[9] = (int)n_yblocks;
+        }
+        return n_yblocks;
+    }
+}

@@ -1,0 +1,211 @@
+"""ctypes binding for the native JPEG entropy decoder (csrc/jpegwire.c).
+
+The compressed media wire's host half: ``decode_into`` runs the serial
+Huffman + dequant stage for ONE frame into caller-preallocated int16
+coefficient buffers (zigzag order, padded MCU-aligned block grids). The
+media pipeline fans frames of a batch across an executor thread pool —
+the ctypes call releases the GIL, so per-frame decodes genuinely run in
+parallel. Everything after the coefficients (dezigzag, IDCT, chroma
+upsample, color convert, ViT patchify) is one fused jit on device
+(sitewhere_tpu/ops/dct.py).
+
+Build/fallback contract is jsonwire's: compiled in the background with
+the in-image ``cc`` on first import, content-hashed, and a missing
+toolchain (or an unsupported/torn stream) degrades to the PIL path —
+counted (``media_native_decode_fallback_total``), never an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from sitewhere_tpu.native import (  # noqa: F401 - codes re-exported for
+    SW_MALFORMED,                   # callers comparing rc_out
+    SW_OVERFLOW,
+    SW_UNSUPPORTED,
+    _HERE,
+    build_native_lib,
+)
+
+_SRC = _HERE / "csrc" / "jpegwire.c"
+_LIB: Optional[ctypes.CDLL] = None
+_BUILT = threading.Event()
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.sw_jpeg_decode.restype = ctypes.c_long
+    lib.sw_jpeg_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,          # buf, len
+        ctypes.POINTER(ctypes.c_short), ctypes.c_long,   # ycoef, cap
+        ctypes.POINTER(ctypes.c_short),                  # cbcoef
+        ctypes.POINTER(ctypes.c_short), ctypes.c_long,   # crcoef, cap
+        ctypes.POINTER(ctypes.c_int),                    # info[10]
+    ]
+    return lib
+
+
+def _bg_build() -> None:
+    global _LIB
+    try:
+        lib = build_native_lib(_SRC, "jpegwire")
+        _LIB = _bind(lib) if lib is not None else None
+    finally:
+        _BUILT.set()
+
+
+# background compile at import time (the jsonwire pattern): the first
+# cold-cache cc run must never stall the event loop; until it lands the
+# media pipeline reports "no library" and PIL carries the frames
+threading.Thread(
+    target=_bg_build, name="jpegwire-build", daemon=True
+).start()
+
+
+def jpegwire_lib(
+    wait: bool = True, timeout_s: float = 180.0
+) -> Optional[ctypes.CDLL]:
+    """The compiled library, or None. ``wait=False`` (the per-frame hot
+    path) never blocks on an in-progress build; callers that must not
+    stall (pipeline start) pass a short ``timeout_s`` and re-probe
+    later via :func:`build_resolved`."""
+    if wait:
+        _BUILT.wait(timeout=timeout_s)
+    return _LIB if _BUILT.is_set() else None
+
+
+def build_resolved() -> bool:
+    """True once the background build reached a DEFINITIVE outcome
+    (loaded or failed) — a timed-out probe is not an answer, and
+    callers keep re-probing nonblockingly until this flips."""
+    return _BUILT.is_set()
+
+
+def peek_geometry(data) -> Optional[tuple]:
+    """Cheap pure-Python SOF peek: ``(width, height, sub)`` for a
+    baseline stream this decoder could handle, else None — WITHOUT
+    paying the entropy decode.
+
+    The media pipeline pre-checks every frame of a batch against the
+    classifier's frame size (and learns the subsampling mode) before
+    committing to the native path: a camera posting off-size or
+    progressive streams would otherwise pay a full wasted Huffman pass
+    per batch forever, just to discover the geometry mismatch and
+    re-decode via PIL. Marker walk only — scalar reads straight off
+    the buffer/ndarray view (no chunk copy; only the ~17-byte SOF
+    segment is ever materialized) — microseconds per frame."""
+    buf = data
+    n = len(buf)
+    # int() normalizes ndarray uint8 scalars (whose << / | promotion
+    # rules vary by numpy version) and bytes ints alike
+    if n < 4 or int(buf[0]) != 0xFF or int(buf[1]) != 0xD8:
+        return None
+    i = 2
+    while i + 4 <= n:
+        if int(buf[i]) != 0xFF:
+            return None
+        m = int(buf[i + 1])
+        if m == 0xFF:  # fill byte
+            i += 1
+            continue
+        if m == 0xD8:
+            i += 2
+            continue
+        if m in (0xD9, 0xDA):  # EOI / SOS before any SOF
+            return None
+        seglen = (int(buf[i + 2]) << 8) | int(buf[i + 3])
+        if seglen < 2 or i + 2 + seglen > n:
+            return None
+        if m == 0xC0:  # baseline SOF — the one shape we decode
+            seg = bytes(buf[i + 4 : i + 2 + seglen])
+            if len(seg) < 6 + 9 or seg[0] != 8 or seg[5] != 3:
+                return None
+            height = (seg[1] << 8) | seg[2]
+            width = (seg[3] << 8) | seg[4]
+            hv = [(seg[6 + 3 * c + 1] >> 4, seg[6 + 3 * c + 1] & 15)
+                  for c in range(3)]
+            if hv[1] != (1, 1) or hv[2] != (1, 1):
+                return None
+            if hv[0] == (1, 1):
+                sub = 1
+            elif hv[0] == (2, 2):
+                sub = 2
+            else:
+                return None
+            return (width, height, sub)
+        if 0xC1 <= m <= 0xCF and m != 0xC4 and m != 0xC8 and m != 0xCC:
+            return None  # progressive/arithmetic/12-bit SOF flavors
+        i += 2 + seglen
+    return None
+
+
+class JpegInfo(NamedTuple):
+    """One decoded frame's geometry (the padded block grids the
+    coefficient buffers were written over) + spectral extent."""
+
+    width: int
+    height: int
+    y_gw: int       # Y block-grid width (blocks)
+    y_gh: int
+    c_gw: int       # chroma block-grid width (blocks)
+    c_gh: int
+    sub: int        # 1 = 4:4:4, 2 = 4:2:0
+    y_k: int        # max nonzero zigzag extent over Y blocks (1..64)
+    c_k: int        # same over Cb+Cr
+
+
+def decode_into(
+    data,
+    ycoef: np.ndarray,
+    cbcoef: np.ndarray,
+    crcoef: np.ndarray,
+    rc_out=None,
+) -> Optional[JpegInfo]:
+    """Entropy-decode one JPEG into preallocated zigzag coefficient
+    buffers (int16, C-contiguous, shaped ``[cap_blocks, 64]``).
+
+    ``data`` is ``bytes`` or a contiguous ``uint8`` ndarray view (the
+    byte-ring staging span — passed by pointer, zero copy; the caller
+    owns the buffer for the duration of the call). Returns the frame's
+    :class:`JpegInfo`, or None when the frame needs the PIL path
+    (unsupported shape, torn/malformed stream, buffers too small, or no
+    native library). Blocks land in raster order over the padded grid;
+    coefficients past the reported extent are exactly zero, so zigzag
+    truncation at ``>= y_k``/``c_k`` is lossless.
+
+    ``rc_out`` (optional 1-element int array/list) receives the raw
+    native return code, letting diagnostics and tests distinguish
+    SW_UNSUPPORTED / SW_MALFORMED / SW_OVERFLOW outcomes (the media
+    pipeline itself avoids overflow up front: ``peek_geometry`` learns
+    the subsampling mode before buffers are sized)."""
+    lib = jpegwire_lib(wait=False)
+    if lib is None or len(data) == 0:
+        return None
+    if isinstance(data, np.ndarray):
+        nbytes = int(data.shape[0])
+        buf = ctypes.c_char_p(data.ctypes.data)
+    else:
+        nbytes = len(data)
+        buf = data
+    info = (ctypes.c_int * 10)()
+    n = lib.sw_jpeg_decode(
+        buf, nbytes,
+        ycoef.ctypes.data_as(ctypes.POINTER(ctypes.c_short)),
+        ycoef.shape[0],
+        cbcoef.ctypes.data_as(ctypes.POINTER(ctypes.c_short)),
+        crcoef.ctypes.data_as(ctypes.POINTER(ctypes.c_short)),
+        min(cbcoef.shape[0], crcoef.shape[0]),
+        info,
+    )
+    if rc_out is not None:
+        rc_out[0] = n
+    if n <= 0:
+        return None  # caller counts + falls back (jsonwire semantics)
+    return JpegInfo(
+        width=info[0], height=info[1],
+        y_gw=info[2], y_gh=info[3], c_gw=info[4], c_gh=info[5],
+        sub=info[6], y_k=max(info[7], 1), c_k=max(info[8], 1),
+    )

@@ -1,0 +1,207 @@
+"""On-device JPEG reconstruction: zigzag coefficients → RGB frames.
+
+The device half of the compressed media wire (the host half is
+``native/jpegwire.py``'s Huffman + dequant stage). What crosses
+host→device is truncated int16 DCT coefficient planes — ~5-20× smaller
+than raw RGB for typical camera content — and this module turns them
+back into frames INSIDE the classifier's jit (``models.vit.apply_dct``),
+so dezigzag, IDCT, chroma upsample, color conversion, normalization and
+ViT patchify all fuse into one XLA program per (batch, layout) shape.
+
+TPU notes (why it looks the way it does):
+
+- **Everything is an einsum.** Dezigzag is a ``[k, 64]`` one-hot matmul,
+  the 8×8 IDCT is two matmuls against the orthonormal DCT basis
+  (``M^T C M``) — MXU work, not gather soup. The whole decode costs
+  ≤ 12 MFLOPs per 224² frame vs the ViT-B/16's ~35 GFLOPs (< 0.04%), so
+  the chip does it for free while the wire wins 5-20×.
+- **Static shapes.** ``FrameLayout`` (grid dims, subsampling, truncation
+  width ``k``) is hashable and rides the jit cache key; the media
+  pipeline buckets the per-batch spectral extent into ``COEF_BUCKETS``
+  so a handful of programs cover all traffic.
+- **Zero collectives, zero per-frame Python.** Batch rides array axes
+  end to end; tools/check_fusion.py traces this module and asserts the
+  dot count is batch-invariant and collective-free.
+- **Truncation is lossless.** jpegwire reports the max nonzero zigzag
+  extent per frame; coefficients past it are exactly zero, so slicing
+  the wire at the bucketed extent reproduces the full-precision decode
+  bit for bit.
+
+Parity: IDCT in f32 + the libjpeg-style triangle ("fancy") chroma
+upsample lands within ~1-2/255 of PIL's fixed-point decode (property-
+tested in tests/test_media_wire.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# zigzag position -> natural (row-major) position inside an 8x8 block
+ZIGZAG = np.array([
+    0,  1,  8, 16,  9,  2,  3, 10, 17, 24, 32, 25, 18, 11,  4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13,  6,  7, 14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63,
+], np.int32)
+
+# static truncation-width ladder: the media pipeline buckets each
+# batch's max zigzag extent up to one of these, so XLA compiles at most
+# len(COEF_BUCKETS) decode variants per batch shape (smooth camera
+# content at q75 typically lands 8-32; 64 = full precision, worst case)
+COEF_BUCKETS = (8, 16, 32, 64)
+
+
+def coef_bucket(k: int) -> int:
+    """Smallest ladder width holding a zigzag extent of ``k``."""
+    for b in COEF_BUCKETS:
+        if k <= b:
+            return b
+    return 64
+
+
+class FrameLayout(NamedTuple):
+    """Static geometry of one coefficient batch (jit cache key).
+
+    width/height: true pixel dims (crop target); y_gw/y_gh and
+    c_gw/c_gh: padded MCU-aligned block grids the coefficients cover;
+    sub: 1 = 4:4:4, 2 = 4:2:0; k: zigzag truncation width on the wire.
+    """
+
+    width: int
+    height: int
+    y_gw: int
+    y_gh: int
+    c_gw: int
+    c_gh: int
+    sub: int
+    k: int
+
+    @property
+    def y_blocks(self) -> int:
+        return self.y_gw * self.y_gh
+
+    @property
+    def c_blocks(self) -> int:
+        return self.c_gw * self.c_gh
+
+    def wire_bytes(self, batch: int = 1) -> int:
+        """int16 payload bytes one batch ships h2d at this layout."""
+        return 2 * self.k * batch * (self.y_blocks + 2 * self.c_blocks)
+
+
+def layout_for(width: int, height: int, sub: int, k: int) -> FrameLayout:
+    """The layout a ``width × height`` frame decodes to at subsampling
+    ``sub`` (padded MCU-aligned grids — what jpegwire reports for a
+    conformant stream of those dims)."""
+    mcu = 8 * sub
+    mw = (width + mcu - 1) // mcu
+    mh = (height + mcu - 1) // mcu
+    return FrameLayout(
+        width=width, height=height,
+        y_gw=mw * sub, y_gh=mh * sub, c_gw=mw, c_gh=mh,
+        sub=sub, k=k,
+    )
+
+
+def idct_basis() -> np.ndarray:
+    """Orthonormal 8-point DCT-II basis ``M`` (forward: C = M X M^T,
+    inverse: X = M^T C M)."""
+    m = np.zeros((8, 8), np.float64)
+    for u in range(8):
+        a = np.sqrt(1.0 / 8.0) if u == 0 else np.sqrt(2.0 / 8.0)
+        for x in range(8):
+            m[u, x] = a * np.cos((2 * x + 1) * u * np.pi / 16.0)
+    return m.astype(np.float32)
+
+
+def dezigzag_matrix(k: int) -> np.ndarray:
+    """``[k, 64]`` one-hot scatter: zigzag-truncated wire → natural
+    order, as a matmul (MXU-friendly; k is static per jit variant)."""
+    s = np.zeros((k, 64), np.float32)
+    s[np.arange(k), ZIGZAG[:k]] = 1.0
+    return s
+
+
+def idct_plane(coef_z: jnp.ndarray, gh: int, gw: int, k: int) -> jnp.ndarray:
+    """Zigzag coefficient blocks ``i16/f32[B, gh*gw, k]`` → pixel plane
+    ``f32[B, gh*8, gw*8]`` (level-shifted to 0..255)."""
+    b = coef_z.shape[0]
+    x = coef_z.astype(jnp.float32)
+    # dezigzag as one matmul, then the separable 2-D IDCT as two more
+    nat = jnp.einsum("bnk,ko->bno", x, dezigzag_matrix(k))
+    blocks = nat.reshape(b, gh, gw, 8, 8)
+    m = jnp.asarray(idct_basis())
+    px = jnp.einsum("ux,bgwuv,vy->bgwxy", m, blocks, m) + 128.0
+    # block grid -> plane
+    return px.transpose(0, 1, 3, 2, 4).reshape(b, gh * 8, gw * 8)
+
+
+def _upsample2x_1d(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Double ``axis`` with the libjpeg "fancy" triangle filter: each
+    output pair is (3·cur+prev)/4, (3·cur+next)/4 with edge replication."""
+    lo = jnp.concatenate(
+        [jnp.take(x, jnp.array([0]), axis=axis),
+         jnp.take(x, jnp.arange(x.shape[axis] - 1), axis=axis)], axis=axis)
+    hi = jnp.concatenate(
+        [jnp.take(x, jnp.arange(1, x.shape[axis]), axis=axis),
+         jnp.take(x, jnp.array([x.shape[axis] - 1]), axis=axis)], axis=axis)
+    a = 0.75 * x + 0.25 * lo
+    c = 0.75 * x + 0.25 * hi
+    stacked = jnp.stack([a, c], axis=axis + 1)
+    shape = list(x.shape)
+    shape[axis] *= 2
+    return stacked.reshape(shape)
+
+
+def upsample2x(plane: jnp.ndarray) -> jnp.ndarray:
+    """``f32[B, H, W]`` → ``f32[B, 2H, 2W]`` triangle upsample (the
+    h2v2 "fancy" kernel libjpeg decodes 4:2:0 chroma with)."""
+    return _upsample2x_1d(_upsample2x_1d(plane, 1), 2)
+
+
+def ycbcr_to_rgb(y: jnp.ndarray, cb: jnp.ndarray, cr: jnp.ndarray) -> jnp.ndarray:
+    """JFIF BT.601 full-range conversion; output ``f32[B, H, W, 3]``
+    clamped to 0..255."""
+    cb = cb - 128.0
+    cr = cr - 128.0
+    r = y + 1.402 * cr
+    g = y - 0.344136 * cb - 0.714136 * cr
+    b = y + 1.772 * cb
+    return jnp.clip(jnp.stack([r, g, b], axis=-1), 0.0, 255.0)
+
+
+def decode_frames(
+    y_z: jnp.ndarray,
+    cb_z: jnp.ndarray,
+    cr_z: jnp.ndarray,
+    layout: FrameLayout,
+) -> jnp.ndarray:
+    """Truncated zigzag coefficient batch → RGB frames
+    ``f32[B, height, width, 3]`` in 0..255.
+
+    ``y_z``: ``[B, y_blocks, k]``; ``cb_z``/``cr_z``: ``[B, c_blocks,
+    k]`` (int16 as shipped over the wire). Pure jnp — call it inside the
+    classifier jit so XLA fuses decode into preprocessing."""
+    yp = idct_plane(y_z, layout.y_gh, layout.y_gw, layout.k)
+    cbp = idct_plane(cb_z, layout.c_gh, layout.c_gw, layout.k)
+    crp = idct_plane(cr_z, layout.c_gh, layout.c_gw, layout.k)
+    if layout.sub == 2:
+        cbp = upsample2x(cbp)
+        crp = upsample2x(crp)
+    h, w = layout.height, layout.width
+    rgb = ycbcr_to_rgb(yp[:, :h, :w], cbp[:, :h, :w], crp[:, :h, :w])
+    return rgb
+
+
+def decode_flops_per_frame(layout: FrameLayout) -> float:
+    """Analytic matmul FLOPs (2/MAC) one frame costs through the decode
+    kernel — dezigzag + the two IDCT matmuls per block. Reported for
+    attribution only: decode FLOPs stay OUT of the ViT model's MFU
+    numerator (docs/PERFORMANCE.md "Media wire & on-chip decode")."""
+    n_blocks = layout.y_blocks + 2 * layout.c_blocks
+    dezig = 2.0 * layout.k * 64
+    idct = 2.0 * 2 * 8 * 8 * 8  # two [8,8]x[8,8] matmuls
+    return n_blocks * (dezig + idct)

@@ -163,6 +163,55 @@ class StreamingMedia:
                 pass
         return pv, iv
 
+    def classify_coeffs_dispatch(
+        self,
+        y_z: np.ndarray,
+        cb_z: np.ndarray,
+        cr_z: np.ndarray,
+        layout,
+        top_k: int = 5,
+        tiny: bool = False,
+    ) -> Tuple[object, object]:
+        """Compressed-wire classify dispatch: truncated zigzag DCT
+        coefficient batch → device top-k, decode FUSED into the ViT jit.
+
+        The h2d payload is ``layout.wire_bytes(B)`` of int16
+        coefficients (typically 2-10× smaller than the raw-RGB frames
+        they reconstruct); dezigzag → IDCT → chroma upsample →
+        YCbCr→RGB → normalize → patchify all run on device inside ONE
+        XLA program (``models.vit.apply_dct``), so the chip does the
+        embarrassingly parallel half of the JPEG decode for < 0.04% of
+        the model's FLOPs. ``layout`` is a static
+        ``ops.dct.FrameLayout`` riding the jit cache key. Same async
+        readback contract as ``classify_frames_dispatch``."""
+        import jax
+        import jax.numpy as jnp
+
+        spec, cfg, params, _ = self._get_classifier(tiny)
+        cache = getattr(self, "_coef_jits", None)
+        if cache is None:
+            cache = self._coef_jits = {}
+        key = (tiny, top_k, layout)
+        fn = cache.get(key)
+        if fn is None:
+            from sitewhere_tpu.models.vit import apply_dct
+
+            def run(p, y, cb, cr):
+                logits = apply_dct(p, cfg, y, cb, cr, layout)
+                probs = jax.nn.softmax(logits, axis=-1)
+                return jax.lax.top_k(probs, top_k)
+
+            fn = cache[key] = jax.jit(run)
+        pv, iv = fn(
+            params, jnp.asarray(y_z), jnp.asarray(cb_z), jnp.asarray(cr_z)
+        )
+        for a in (pv, iv):
+            try:
+                a.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - non-jax test doubles
+                pass
+        return pv, iv
+
     @staticmethod
     def topk_results(
         pv, iv, n: Optional[int] = None

@@ -53,12 +53,29 @@ _THROUGHPUT_SUFFIXES = ("_ev_s", "_fps", "_fc_s", "_mbps", "_mbps_staged")
 # gate it like any throughput key (new key reports n/a against
 # single-chip baselines). mesh_balance stays info-class: a balance dip
 # is a routing-quality signal, not a throughput regression per se.
-_THROUGHPUT_EXACT = {"mfu_32t_pct", "fused_speedup_32t", "ev_s_8dev"}
+# vit_pipeline_ratio (ISSUE 12): media pipeline f/s ÷ model-only f/s —
+# the compressed-wire acceptance figure (real-chip goal ≥ 0.5, i.e.
+# pipeline within 2× of model-only). Higher is better and a drop is
+# exactly the h2d-ceiling regression the compressed wire exists to
+# prevent; vit_fps and vit_wire_mbps already gate via the suffix rules
+# (n/a against pre-compression baselines that lack the keys).
+_THROUGHPUT_EXACT = {
+    "mfu_32t_pct", "fused_speedup_32t", "ev_s_8dev", "vit_pipeline_ratio",
+}
+
+# info-class by NAME even though a suffix rule would gate them:
+# vit_wire_mbps = wire bytes/frame × submit rate, so a DELIBERATE wire
+# diet (smaller jpegs after an encoder change) would read as a
+# throughput regression — fps/ratio regressions are already gated by
+# vit_fps / vit_pipeline_ratio.
+_INFO_EXACT = {"vit_wire_mbps"}
 
 
 def classify(key: str) -> str:
     """'throughput' (higher is better, gated), 'p99' (lower is better,
     gated), or 'info' (reported, never gates)."""
+    if key in _INFO_EXACT:
+        return "info"
     if key.endswith("_p99_ms"):
         return "p99"
     if (

@@ -61,6 +61,18 @@ REGISTRY: Dict[str, dict] = {
     "transformer": {"context": 8, "dim": 16, "depth": 1, "heads": 2},
 }
 
+# media decode kernels (ops/dct.py): the compressed-wire ViT leg fuses
+# JPEG reconstruction into the classifier jit. Traced at B=2 and B=4
+# with the same invariants as the scoring kernels — the dot count must
+# be BATCH-invariant (a per-frame Python loop over the batch doubles
+# it) and the whole program must contain zero collective primitives
+# (the PR 5 gotcha: one collective gang-schedules every concurrent
+# classify dispatch). Entries: name → (subsampling, truncation k).
+DCT_REGISTRY: Dict[str, Tuple[int, int]] = {
+    "vit_dct_420": (2, 16),
+    "vit_dct_444": (1, 64),
+}
+
 _W, _B, _K = 8, 4, 2  # traced window/batch/K-step shape
 
 
@@ -198,6 +210,58 @@ def _trace_counts(
     ]
 
 
+def _trace_dct_counts(sub: int, k: int, batch: int) -> Tuple[int, List[str]]:
+    """(total dot_generals, collective primitive names) for the fused
+    compressed-wire ViT forward (decode + model) traced at ``batch``
+    frames on the tiny config. Shape-only — no device work."""
+    import jax
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.models import vit
+    from sitewhere_tpu.ops.dct import layout_for
+
+    cfg = vit.VIT_TINY_TEST
+    size = cfg.image_size
+    # the SAME layout rule the pipeline ships (a diverging inline copy
+    # would lint a layout production never uses)
+    lay = layout_for(size, size, sub, k)
+    params = vit.init(jax.random.PRNGKey(0), cfg)
+    y = jnp.zeros((batch, lay.y_blocks, k), jnp.int16)
+    c = jnp.zeros((batch, lay.c_blocks, k), jnp.int16)
+    closed = jax.make_jaxpr(
+        lambda p, yy, cb, cr: vit.apply_dct(p, cfg, yy, cb, cr, lay)
+    )(params, y, c, c)
+    return _count_dots(closed.jaxpr), collective_eqns(closed.jaxpr)
+
+
+def lint_dct(registry: Optional[Dict[str, Tuple[int, int]]] = None) -> List[str]:
+    """Trace every registered media decode variant; returns findings
+    (empty = clean)."""
+    findings: List[str] = []
+    for name, (sub, k) in (registry or DCT_REGISTRY).items():
+        try:
+            total2, coll2 = _trace_dct_counts(sub, k, 2)
+            total4, coll4 = _trace_dct_counts(sub, k, 4)
+        except Exception as exc:  # noqa: BLE001 - a trace failure is a finding
+            findings.append(f"{name}: decode forward failed to trace: {exc!r}")
+            continue
+        if coll2 or coll4:
+            findings.append(
+                f"{name}: fused decode+classify program contains "
+                f"collective primitive(s) {sorted(set(coll2 + coll4))} — "
+                "the media hot path must stay collective-free (concurrent "
+                "classify dispatch gang-deadlocks on a rendezvous)"
+            )
+        if total2 != total4:
+            findings.append(
+                f"{name}: dot_general count scales with batch "
+                f"({total2} at B=2 vs {total4} at B=4) — a per-frame "
+                "Python loop is unrolling the batch; keep decode on "
+                "batched einsums"
+            )
+    return findings
+
+
 def lint_fusion(registry: Optional[Dict[str, dict]] = None) -> List[str]:
     """Trace every registered fused entry point; returns findings
     (empty = clean)."""
@@ -254,12 +318,12 @@ def lint_fusion(registry: Optional[Dict[str, dict]] = None) -> List[str]:
 
 
 def main() -> int:
-    findings = lint_fusion()
+    findings = lint_fusion() + lint_dct()
     for f in findings:
         print(f"check_fusion: {f}", file=sys.stderr)
     print(
-        f"check_fusion: {len(REGISTRY)} fused entry point(s), "
-        f"{len(findings)} finding(s)"
+        f"check_fusion: {len(REGISTRY)} fused entry point(s) + "
+        f"{len(DCT_REGISTRY)} decode variant(s), {len(findings)} finding(s)"
     )
     return 1 if findings else 0
 

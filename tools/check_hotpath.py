@@ -77,8 +77,33 @@ HOT_PATHS: Dict[str, List[str]] = {
     "pipeline/media.py": [
         "MediaClassificationPipeline.submit_chunk",
         "MediaClassificationPipeline._classify_and_publish",
+        "MediaClassificationPipeline._classify_compressed",
+        "MediaClassificationPipeline._finish_classify",
+        # the compressed-wire decode stage runs once per classify batch
+        # at camera rate: coefficient packing must stay one vectorized
+        # copy per component, frame fan-out rides preallocated
+        # index/keep arrays (per-FRAME loops are the unit here — the
+        # per-EVENT ban still holds)
+        "MediaClassificationPipeline._decode_batch",
         "_FrameRing.reserve",
         "_FrameRing.pop_into",
+        "_ByteRing.append",
+        "_ByteRing.pop_into",
+    ],
+    # the native decode binding runs per frame on the decode pool; its
+    # job is pointer hand-off — any per-coefficient Python here would
+    # multiply by 64 blocks × rate
+    "native/jpegwire.py": [
+        "decode_into",
+    ],
+    # the on-device decode kernels trace under jit (tools/check_fusion.py
+    # asserts batch-invariant lowering); at the Python layer they must
+    # stay free of per-frame/per-block list building
+    "ops/dct.py": [
+        "decode_frames",
+        "idct_plane",
+        "upsample2x",
+        "ycbcr_to_rgb",
     ],
     "core/batch.py": [
         "make_event_ids",

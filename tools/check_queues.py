@@ -52,9 +52,40 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         "shed_counter": "receiver_shed_total",
     },
     ("pipeline/media.py", r"_FrameRing\("): {
-        "queue": "media frame ring (newest-frame-wins shedding)",
+        "queue": "media frame ring (newest-frame-wins shedding; the "
+                 "legacy/kill-switch decoded-pixel ring)",
         "depth_gauge": "media_queue_depth",
         "shed_counter": "media_frames_shed_total",
+    },
+    ("pipeline/media.py", r"_ByteRing\("): {
+        "queue": "compressed media byte ring (variable-length frame "
+                 "spans in one preallocated arena; newest-frame-wins "
+                 "shedding on index OR byte exhaustion)",
+        "depth_gauge": "media_queue_depth",
+        # the byte watermark: arena_bytes bounds RESIDENT bytes, so the
+        # byte gauge — not frame count — is the capacity signal here
+        "bytes_gauge": "media_ring_bytes",
+        "shed_counter": "media_frames_shed_total",
+    },
+    ("pipeline/inference.py", r"ThreadPoolExecutor\("): {
+        "queue": "deliver materialization pool (one job per in-flight "
+                 "flush transfer; occupancy bounded by the per-slice "
+                 "max_inflight semaphores that also bound the reap "
+                 "queues feeding it)",
+        "depth_gauge": "tpu_inference_deliver_inflight",
+        # the pool never sheds: a full in-flight window backpressures
+        # the NEXT flush at the semaphore, same bound as the reap FIFO
+        "backpressure_counter": "tpu_inference.deliver_backpressure",
+    },
+    ("pipeline/media.py", r"ThreadPoolExecutor\("): {
+        "queue": "media native-decode pool (per-WORKER range jobs over "
+                 "a batch's frames; gauge ceiling = max_inflight × "
+                 "decode_workers concurrent jobs)",
+        "depth_gauge": "media_decode_inflight",
+        # the pool never sheds: a saturated pool queues jobs and the
+        # classify semaphore backpressures the batching loop (counted
+        # when a submission lands behind a fully busy pool)
+        "backpressure_counter": "media.decode_backpressure",
     },
     ("pipeline/inference.py", r"_LaneRing\("): {
         "queue": "scoring lane rings (pending rows per (slot, data-shard))",
@@ -101,7 +132,7 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
     r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\(|= _ReplayRing\("
-    r"|\[_StagingSet\()"
+    r"|= _ByteRing\(|ThreadPoolExecutor\(|\[_StagingSet\()"
 )
 
 
@@ -122,13 +153,18 @@ def lint_queues() -> List[str]:
         str(p.relative_to(SRC_ROOT)): p.read_text()
         for p in _source_files()
     }
-    # 1) every bounded-queue site must be registered
-    registered_files = {f for (f, _pat) in REGISTRY}
+    # 1) every bounded-queue site must be registered — PER LINE, not per
+    # file: a new pool/ring construction in a file that already has an
+    # unrelated registry entry must still surface (the old per-file
+    # check silently exempted exactly that case)
     for rel, text in texts.items():
         for lineno, line in enumerate(text.splitlines(), 1):
             if not BOUNDED_RE.search(line):
                 continue
-            if rel not in registered_files:
+            if not any(
+                f == rel and re.search(pat, line)
+                for (f, pat) in REGISTRY
+            ):
                 findings.append(
                     f"{rel}:{lineno}: unregistered bounded queue "
                     f"({line.strip()[:60]!r}) — add a tools/check_queues.py "
