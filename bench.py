@@ -1394,6 +1394,240 @@ def bench_storage(secs: float, **kw) -> dict:
     return asyncio.run(_bench_storage(secs, **kw))
 
 
+# ---------------------------------------------------------------- config 8
+async def _bench_train_run(
+    secs: float,
+    train: bool,
+    paced_rate: float,
+    n_devices: int = 32,
+    burst: int = 20,
+    hidden: int = 16,
+    window: int = 16,
+    max_streams: int = 1024,
+    history_rows: int = 32_768,
+) -> dict:
+    """One serve(+train) run at a fixed paced rate: a live instance, one
+    trainable tenant, and — when ``train`` — a replay train job streaming
+    scored history into the lane while serve traffic flows. The twin
+    (``train=False``) runs the identical load with training disabled, so
+    the p99 ratio isolates exactly the train lane's cost."""
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.core.batch import MeasurementBatch
+    from sitewhere_tpu.runtime.config import (
+        InstanceConfig,
+        MeshConfig,
+        MicroBatchConfig,
+        TrainingConfig,
+    )
+    from sitewhere_tpu.sim import DeviceSimulator, SimProfile
+
+    inst = SiteWhereInstance(InstanceConfig(
+        instance_id="bench-train",
+        mesh=MeshConfig(tenant_axis=1, data_axis=1, slots_per_shard=1),
+    ))
+    await inst.start()
+    try:
+        mb = MicroBatchConfig(
+            max_batch=4096, deadline_ms=5.0,
+            buckets=(256, 1024, 4096), window=window,
+        )
+        await inst.tenant_management.create_tenant(
+            "bench", template="iot-temperature",
+            microbatch=mb, decoder="binary", max_streams=max_streams,
+            model_config={"hidden": hidden},
+            training=TrainingConfig(
+                enabled=train, every_n_flushes=4, lr=1e-3,
+                swap_every=4, replay_microbatch=4096,
+            ),
+        )
+        await inst.drain_tenant_updates()
+        for _ in range(200):
+            if "bench" in inst.tenants:
+                break
+            await asyncio.sleep(0.02)
+        inst.tenants["bench"].device_management.bootstrap_fleet(n_devices)
+        sim = DeviceSimulator(
+            inst.broker,
+            SimProfile(n_devices=n_devices, seed=3,
+                       samples_per_message=burst, wire="binary"),
+            topic_pattern="sitewhere/input/{device}",
+        )
+        await asyncio.get_running_loop().run_in_executor(
+            None, inst.inference.prewarm
+        )
+        scored = inst.metrics.counter("tpu_inference.scored_total")
+        await sim.publish_round(0.0)
+        for _ in range(600):
+            if scored.value >= n_devices * 0.5:
+                break
+            await asyncio.sleep(0.05)
+        rounds = sim.pregenerate(64, t0=1.0)
+        job = None
+        if train:
+            # scored history beyond the resident windows: the replay
+            # engine's train target feeds the lane while serving runs
+            store = inst.tenants["bench"].event_store
+            rng = np.random.RandomState(11)
+            devs = np.array(
+                [f"dev-{i:05d}" for i in range(n_devices)], object
+            )
+            now_ms = time.time() * 1000.0
+            step_rows = 8192
+            for off in range(0, history_rows, step_rows):
+                k = min(step_rows, history_rows - off)
+                ts = now_ms - 3_600_000.0 + off * 10.0 + np.arange(
+                    k, dtype=np.float64
+                )
+                store.add_measurement_batch(MeasurementBatch(
+                    tenant="bench",
+                    stream_ids=np.zeros((k,), np.int32),
+                    values=rng.randn(k).astype(np.float32),
+                    event_ts=ts,
+                    received_ts=ts + 5.0,
+                    valid=np.ones((k,), bool),
+                    device_tokens=devs[
+                        np.arange(off, off + k) % n_devices
+                    ],
+                    names=np.full((k,), "temperature", object),
+                    scores=np.abs(rng.randn(k)).astype(np.float32),
+                ))
+            store.measurements._seal()
+            job = inst.replay.start_job("bench", store, target="train")
+        # ---- timed paced window ----------------------------------------
+        hist = inst.metrics.histogram("tpu_inference.latency", unit="s")
+        hist.reset()
+        m = inst.metrics
+        flops0 = m.counter("tpu_flops_total", family="lstm_ad").value
+        tflops0 = m.counter("tpu_train_flops_total", family="lstm_ad").value
+        steps0 = m.counter("tpu_inference.train_steps").value
+        rows0 = m.counter("tpu_train_rows_total", family="lstm_ad").value
+        swaps0 = m.counter("tpu_train_swaps_total", family="lstm_ad").value
+        per_round = n_devices * burst
+        # the pump's unit is one full round, so the floor of achievable
+        # pacing is per_round ev/s — clamp AND report the effective rate
+        # (a silently-clamped figure would record the p99 at a different
+        # operating point than the one asked for)
+        paced_rate = max(paced_rate, float(per_round))
+        interval = per_round / paced_rate
+        scored0 = scored.value
+        t0 = time.perf_counter()
+        step = 0
+        while time.perf_counter() - t0 < secs:
+            await sim.publish_pregenerated(rounds[step % len(rounds)])
+            step += 1
+            next_at = t0 + step * interval
+            delay = next_at - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+        await asyncio.sleep(1.0)  # tail drains into the histogram
+        dt = time.perf_counter() - t0
+        from sitewhere_tpu.runtime.metrics import PEAK_FLOPS_BF16
+
+        serve_flops = m.counter(
+            "tpu_flops_total", family="lstm_ad"
+        ).value - flops0
+        train_flops = m.counter(
+            "tpu_train_flops_total", family="lstm_ad"
+        ).value - tflops0
+        out = {
+            "train": train,
+            "paced_rate": paced_rate,
+            "achieved_ev_s": (scored.value - scored0) / max(dt, 1e-9),
+            "duration_s": dt,
+            "p50_ms": hist.quantile(0.5) * 1e3,
+            "p99_ms": hist.quantile(0.99) * 1e3,
+            "train_steps": int(
+                m.counter("tpu_inference.train_steps").value - steps0
+            ),
+            "train_rows": int(m.counter(
+                "tpu_train_rows_total", family="lstm_ad"
+            ).value - rows0),
+            "swaps": int(m.counter(
+                "tpu_train_swaps_total", family="lstm_ad"
+            ).value - swaps0),
+            # device-work MFU over the window: serving alone, and
+            # serving+training — the lift is what overlap buys on the
+            # otherwise-idle MXU (train FLOPs stay OUT of the live
+            # tpu_mfu_pct gauge, which means serving work)
+            "mfu_serve_pct": 100.0 * serve_flops / (
+                PEAK_FLOPS_BF16 * max(dt, 1e-9)
+            ),
+            "mfu_with_train_pct": 100.0 * (serve_flops + train_flops) / (
+                PEAK_FLOPS_BF16 * max(dt, 1e-9)
+            ),
+        }
+        if job is not None:
+            out["replay_job"] = {
+                "status": job.status,
+                "replayed": job.replayed,
+                "throttled": job.throttled,
+            }
+        return out
+    finally:
+        await inst.terminate()
+
+
+async def _bench_train(secs: float, paced_rate: float = 0.0) -> dict:
+    """Config 8 "train": serve+train concurrency vs a training-off twin
+    at the same plane shape and offered load (back-to-back in one
+    process — common-mode rig drift cancels in the p99 ratio).
+
+    Headline keys: ``train_ev_s`` (replay-fed rows/s the lane sustained
+    on serve headroom) and ``serve_p99_train_delta`` (serve p99 with the
+    lane active ÷ the twin's — the zero-stall acceptance figure, ≤ 1.10
+    on the real chip)."""
+    if paced_rate <= 0:
+        # probe capacity with a short training-off saturation burst,
+        # then pace BOTH runs at 40% — far enough under the knee that
+        # queueing noise doesn't dominate the p99s being compared
+        probe = await _bench_train_run(
+            max(2.0, secs / 3), train=False, paced_rate=10**9
+        )
+        paced_rate = max(2_000.0, 0.4 * probe["achieved_ev_s"])
+    twin = await _bench_train_run(secs, train=False, paced_rate=paced_rate)
+    lane = await _bench_train_run(secs, train=True, paced_rate=paced_rate)
+    p99_off = max(twin["p99_ms"], 1e-6)
+    import jax
+
+    note = None
+    if jax.devices()[0].platform == "cpu":
+        # device == host == 2 cores here: a train step STEALS the serve
+        # path's compute outright, so "overlap" cannot exist and the p99
+        # delta reads the train step's own duration, not the lane's
+        # chip-side cost. The ≤1.10 acceptance gate belongs to the real
+        # accelerator (µs-scale train steps under a 5 ms flush
+        # deadline); CPU headlines are never recorded as baselines.
+        note = (
+            "cpu rig: serve and train share 2 host cores — the p99 "
+            "delta measures train-step duration, not chip overlap; "
+            "gate on the real-chip baseline"
+        )
+    return {
+        **({"cpu_rig_note": note} if note else {}),
+        # the EFFECTIVE rate the runs executed at (the per-run clamp
+        # floors sub-round requests) — recording the requested figure
+        # would misstate the operating point the p99s were measured at
+        "paced_rate": twin["paced_rate"],
+        "twin_off": twin,
+        "lane_on": lane,
+        "train_ev_s": round(
+            lane["train_rows"] / max(lane["duration_s"], 1e-9), 1
+        ),
+        "serve_p99_train_delta": round(lane["p99_ms"] / p99_off, 4),
+        "serve_p99_on_ms": round(lane["p99_ms"], 2),
+        "serve_p99_off_ms": round(twin["p99_ms"], 2),
+        "swaps": lane["swaps"],
+        "train_steps": lane["train_steps"],
+        "mfu_lift_pct": round(
+            lane["mfu_with_train_pct"] - lane["mfu_serve_pct"], 4
+        ),
+    }
+
+
+def bench_train(secs: float, **kw) -> dict:
+    return asyncio.run(_bench_train(secs, **kw))
+
+
 def _run_bench_subprocess(
     flags: list, key: str, timeout_s: float, env=None
 ) -> dict:
@@ -1481,7 +1715,10 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--configs", default="all",
                    help="comma list: e2e,e2e-json,e2e-cpu,lstm,deepar,"
-                        "tenants32,vit,storage or all")
+                        "tenants32,vit,storage,mesh8,train or all")
+    p.add_argument("--train-rate", type=float, default=0.0,
+                   help="config 8 paced offered load in ev/s (0 = probe "
+                        "capacity with a training-off burst, pace at 40%%)")
     p.add_argument("--e2e-secs", type=float, default=10.0)
     p.add_argument("--vit-tiny", action="store_true",
                    help="config 5 with the tiny ViT (CPU-rig smoke: "
@@ -1530,7 +1767,7 @@ def main() -> None:
     args = p.parse_args()
     which = set(args.configs.split(",")) if args.configs != "all" else {
         "e2e", "e2e-json", "e2e-cpu", "e2e-32t", "lstm", "deepar",
-        "tenants32", "vit", "storage", "mesh8"
+        "tenants32", "vit", "storage", "mesh8", "train"
     }
 
     import jax
@@ -1715,6 +1952,24 @@ def main() -> None:
         else:
             log(f"  -> FAILED: {m8['error'][:300]}")
 
+    if "train" in which:
+        log("config 8: serve+train concurrency (continual-learning "
+            "lane vs training-off twin) ...")
+        try:
+            details["train_lane"] = bench_train(
+                min(args.e2e_secs, 8.0), paced_rate=args.train_rate
+            )
+            tl = details["train_lane"]
+            log(f"  -> train {tl['train_ev_s']:.0f} rows/s, serve p99 "
+                f"x{tl['serve_p99_train_delta']:.2f} vs twin "
+                f"({tl['serve_p99_on_ms']:.1f} vs "
+                f"{tl['serve_p99_off_ms']:.1f} ms), {tl['swaps']} swaps, "
+                f"MFU lift +{tl['mfu_lift_pct']:.4f}pp")
+        except Exception as exc:  # noqa: BLE001 - a bench config failing
+            # must not lose the other configs' results
+            details["train_lane"] = {"error": repr(exc)}
+            log(f"  -> FAILED: {exc!r}")
+
     if "e2e-cpu" in which:
         log("config 1c: E2E latency on the CPU backend (RTT=0) ...")
         details["e2e_pipeline_cpu"] = bench_e2e_cpu_subprocess(6.0)
@@ -1836,6 +2091,12 @@ def main() -> None:
         "storage_scan_ev_s": pick(details, "storage", "scan_ev_s"),
         "storage_replay_ev_s": pick(details, "storage", "replay_ev_s"),
         "storage_write_mbps": pick(details, "storage", "write_mbps"),
+        # continual-learning lane (ISSUE 13; both check_bench-gated):
+        # replay-fed train rows/s on serve headroom, and serve p99 with
+        # the lane active ÷ the training-off twin (≤1.10 acceptance)
+        "train_ev_s": pick(details, "train_lane", "train_ev_s"),
+        "serve_p99_train_delta": pick(
+            details, "train_lane", "serve_p99_train_delta", nd=4),
         "details": args.details_out,
     }
     line = json.dumps(out)

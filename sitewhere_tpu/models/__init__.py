@@ -51,6 +51,12 @@ class ModelSpec:
     # plane. None = family runs the legacy vmap-over-slots path only.
     score_stacked: Optional[Callable] = None
     loss: Optional[Callable] = None
+    # stacked training contract (models.common; parallel.sharded fused
+    # train step): (stacked_params, cfg, windows[S,B,W]) → per-row loss
+    # f32[S,B] through the same weight-stacked einsums as score_stacked,
+    # so grads lower slot-count-invariant too. None = family trains via
+    # the legacy per-slot vmap only (and never rides the train lane).
+    loss_stacked: Optional[Callable] = None
     forecast: Optional[Callable] = None
     apply: Optional[Callable] = None      # classifier contract (images)
     train_step: Optional[Callable] = None
@@ -73,6 +79,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         score=lstm_ad.score,
         score_stacked=lstm_ad.score_stacked,
         loss=lstm_ad.loss,
+        loss_stacked=lstm_ad.loss_stacked,
         train_step=lstm_ad.train_step,
         flops_per_row=lstm_ad_flops_per_row,
     ),
@@ -83,6 +90,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         score=deepar.score,
         score_stacked=deepar.score_stacked,
         loss=deepar.loss,
+        loss_stacked=deepar.loss_stacked,
         forecast=deepar.forecast,
         train_step=deepar.train_step,
         flops_per_row=deepar_flops_per_row,
@@ -94,6 +102,7 @@ MODEL_REGISTRY: Dict[str, ModelSpec] = {
         score=transformer.score,
         score_stacked=transformer.score_stacked,
         loss=transformer.loss,
+        loss_stacked=transformer.loss_stacked,
         forecast=transformer.forecast,
         train_step=transformer.train_step,
         flops_per_row=transformer_flops_per_row,

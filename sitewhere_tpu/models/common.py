@@ -172,6 +172,22 @@ def param_count(params: Params) -> int:
 # single-step score). tools/check_fusion.py lints that these entry points
 # actually lower to ≤2 dot_generals per scan step.
 
+# The stacked TRAINING contract (``parallel.sharded`` fused train step;
+# docs/PERFORMANCE.md "Continual learning lane") is the gradient twin of
+# ``score_stacked``: each trainable family also exposes
+#
+#     spec.loss_stacked(stacked_params, cfg, windows[S, B, W]) -> f32[S, B]
+#
+# the PER-ROW teacher-forced loss (mean over the window's W-1 next-step
+# predictions — exactly what vmapping the scalar ``spec.loss`` over
+# single-row windows computes), built from the same weight-stacked
+# einsums as scoring. Differentiating its masked per-slot mean therefore
+# runs the backward pass as wide stacked dots too — one dot_general
+# chain per scan step over the whole [S·B] tenant plane, slot-count-
+# invariant (tools/check_fusion.py lints the grad jaxpr the same way it
+# lints score_stacked). Slot s's loss depends only on slot s's param
+# slices, so the stacked gradient IS the per-slot gradients, bit-packed.
+
 PARAM_DTYPES = ("f32", "bf16", "int8")
 
 # Real MAC width of quantized weight matmuls against the bf16 peak the

@@ -162,6 +162,36 @@ def score_stacked(
     ).astype(jnp.float32)
 
 
+def loss_stacked(
+    params: Params,
+    cfg: DeepArConfig,
+    windows: jnp.ndarray,   # f32[S, B, W]
+) -> jnp.ndarray:
+    """Per-row teacher-forced Gaussian NLL over the stacked tenant plane
+    (``loss_stacked`` contract): f32[S, B], the same per-row mean the
+    scalar ``loss`` computes, with every GRU gate (forward AND backward)
+    as one wide stacked einsum."""
+    dtype = cfg.compute_dtype
+    normed, _, _ = normalize_windows(windows)
+    hs = _stacked_gru_scan(params, normed[..., :-1], dtype)   # [T,S,B,H]
+    w_mu = kernel_weight(params["mu"], dtype)                 # [S, H, 1]
+    w_sg = kernel_weight(params["sigma"], dtype)
+    mus = (
+        jnp.einsum("tsbh,sho->tsbo", hs, w_mu)[..., 0]
+        + params["mu"]["b"].astype(dtype)[..., 0][None, :, None]
+    ).astype(jnp.float32)                                     # [T, S, B]
+    raw = (
+        jnp.einsum("tsbh,sho->tsbo", hs, w_sg)[..., 0]
+        + params["sigma"]["b"].astype(dtype)[..., 0][None, :, None]
+    ).astype(jnp.float32)
+    sigmas = jax.nn.softplus(raw) + 1e-4
+    targets = jnp.moveaxis(normed[..., 1:], -1, 0)            # [T, S, B]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigmas**2) + (
+        targets - mus
+    ) ** 2 / (2 * sigmas**2)
+    return nll.mean(axis=0)                                   # [S, B]
+
+
 def loss(params: Params, cfg: DeepArConfig, windows: jnp.ndarray) -> jnp.ndarray:
     """Gaussian NLL of each next step given the prefix (teacher forcing)."""
     normed, _, _ = normalize_windows(windows)

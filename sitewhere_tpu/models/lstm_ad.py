@@ -199,6 +199,30 @@ def loss(params: Params, cfg: LstmAdConfig, windows: jnp.ndarray) -> jnp.ndarray
     return jnp.mean((preds - normed[:, 1:]) ** 2)
 
 
+def loss_stacked(
+    params: Params,
+    cfg: LstmAdConfig,
+    windows: jnp.ndarray,   # f32[S, B, W] — S stacked tenant slots
+) -> jnp.ndarray:
+    """Per-row teacher-forced MSE over the stacked tenant plane (the
+    ``loss_stacked`` contract — models.common). Returns f32[S, B]: row
+    (s, b)'s mean squared next-step error over its W-1 predictions —
+    the same number ``loss(params[s], cfg, windows[s, b][None])``
+    computes, but every gate matmul (and therefore every backward-pass
+    matmul under ``jax.grad``) runs as ONE wide einsum over [S·B]."""
+    dtype = cfg.compute_dtype
+    normed, _, _ = normalize_windows(windows)              # f32[S, B, W]
+    hs = _stacked_lstm_scan(params, normed[..., :-1], dtype)  # [T,S,B,H]
+    w_head = kernel_weight(params["head"], dtype)          # [S, H, 1]
+    b_head = params["head"]["b"].astype(dtype)             # [S, 1]
+    preds = (
+        jnp.einsum("tsbh,sho->tsbo", hs, w_head)[..., 0]
+        + b_head[..., 0][None, :, None]
+    ).astype(jnp.float32)                                  # [T, S, B]
+    targets = jnp.moveaxis(normed[..., 1:], -1, 0)         # [T, S, B]
+    return jnp.mean((preds - targets) ** 2, axis=0)        # [S, B]
+
+
 def train_step(
     params: Params, opt_state, windows: jnp.ndarray, cfg: LstmAdConfig, optimizer
 ) -> Tuple[Params, object, jnp.ndarray]:

@@ -269,6 +269,30 @@ def score_stacked(
     ).astype(jnp.float32)
 
 
+def loss_stacked(
+    params: Params,
+    cfg: TransformerForecasterConfig,
+    windows: jnp.ndarray,   # f32[S, B, W]
+) -> jnp.ndarray:
+    """Per-row causal next-step Gaussian NLL over the stacked tenant
+    plane (``loss_stacked`` contract): f32[S, B] — the scalar ``loss``'s
+    per-row mean, with every projection (forward and backward) lowered
+    as one weight-stacked einsum over [S·B]."""
+    dtype = cfg.compute_dtype
+    normed, _, _ = normalize_windows(windows)
+    feats = _backbone_stacked(params, normed[..., :-1], cfg)   # [S,B,T,D]
+    out = dense_stacked(params["head"], feats, dtype).astype(
+        jnp.float32
+    )                                                          # [S,B,T,2]
+    mu = out[..., 0]
+    sigma = jax.nn.softplus(out[..., 1]) + 1e-4
+    target = normed[..., 1:]
+    nll = 0.5 * jnp.log(2 * jnp.pi * sigma**2) + (
+        target - mu
+    ) ** 2 / (2 * sigma**2)
+    return nll.mean(axis=-1)                                   # [S, B]
+
+
 def score(params, cfg: TransformerForecasterConfig, windows, n_valid):
     """Anomaly-score adapter: last-step NLL (same contract as lstm_ad.score)."""
     normed, _, _ = normalize_windows(windows)

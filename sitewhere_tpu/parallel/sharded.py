@@ -46,6 +46,7 @@ from sitewhere_tpu.ops.windows import (
     init_window_state,
     update_and_gather,
     update_gather_ranked,
+    update_windows,
 )
 from sitewhere_tpu.parallel.mesh import AXIS_DATA, AXIS_TENANT, MeshManager
 
@@ -64,6 +65,14 @@ FUSED_STEP_ENABLED = True
 # in a device profile, and the bench's control twin for measuring the
 # sketch's step-time overhead (``scorehealth_pct``).
 SCORE_SKETCH_ENABLED = True
+
+# Continual-learning train lane kill switch (same pattern): flip to
+# False BEFORE scorer construction to disable the fused stacked train
+# step AND the service's async train lane — training then runs the
+# pre-lane path bitwise: the legacy per-slot vmap ``_build_train_step``
+# dispatched INLINE from the scoring loop every ``every_n_flushes``
+# (docs/PERFORMANCE.md "Continual learning lane" → rollback).
+TRAIN_LANE_ENABLED = True
 
 # After a param hot-swap (``activate(params=...)``) an armed canary
 # shadow-scores its configured fraction of the next this-many flushes, so
@@ -152,6 +161,21 @@ class ShardedScorer:
         # (both fused and legacy branches) and materialized by the result
         # reaper; edges are log-spaced over the family's declared score
         # range. Captured at BUILD time like the fused kill switch.
+        # -- continual-learning train lane (captured at BUILD time like
+        # the fused kill switch): the fused stacked train step + the
+        # replay-fed feed state only exist when the family has a
+        # loss_stacked contract AND the scorer runs the fused path —
+        # the lane's grads must lower through the SAME stacked einsums
+        # as scoring, or the MXU win evaporates. False ⇒ the service
+        # keeps the inline every_n_flushes path bitwise.
+        self.train_lane = bool(
+            TRAIN_LANE_ENABLED
+            and self.fused
+            and getattr(spec, "loss_stacked", None) is not None
+        )
+        self._train_fused = None       # built by init_optimizer
+        self._train_feed_state = None  # replay-fed windows (lazy)
+        self._ingest = None            # counts-mode feed scatter jit
         self.sketch = bool(SCORE_SKETCH_ENABLED)
         self.nbins = SKETCH_NBINS
         lo, hi = getattr(spec, "score_range", DEFAULT_SCORE_RANGE)
@@ -781,6 +805,14 @@ class ShardedScorer:
                 self._opt_state,
                 self._fresh_opt,
             )
+        if self._train_feed_state is not None:
+            # a recycled slot must not leak the previous tenant's
+            # replayed training windows either
+            self._train_feed_state = WindowState(
+                values=self._train_feed_state.values.at[global_slot].set(0.0),
+                pos=self._train_feed_state.pos.at[global_slot].set(0),
+                count=self._train_feed_state.count.at[global_slot].set(0),
+            )
 
     def slot_params(self, global_slot: int) -> Params:
         return unstack_slot(self.params, global_slot)
@@ -868,6 +900,18 @@ class ShardedScorer:
             self._train = self._build_train_step(
                 self._optimizer, self._lr_sign
             )
+            if self.train_lane:
+                self._train_fused = self._build_train_step_fused(
+                    self._optimizer, self._lr_sign
+                )
+        # the train lane's feed state may reference dead buffers too:
+        # drop it — replayed history re-accumulates from the feed (the
+        # same windows-rebuild-from-traffic posture as the serve state)
+        had_feed = self._train_feed_state is not None
+        self._train_feed_state = None
+        self._ingest = None
+        if had_feed:
+            self.init_train_feed()
 
     # -- training (per-tenant divergence) --------------------------------
     def init_optimizer(self, optimizer=None) -> None:
@@ -907,6 +951,10 @@ class ShardedScorer:
         self._fresh_opt = optimizer.init(self._base_params)  # for reset_slot
         self._lr_sign = lr_sign
         self._train = self._build_train_step(optimizer, lr_sign)
+        if self.train_lane:
+            self._train_fused = self._build_train_step_fused(
+                optimizer, lr_sign
+            )
 
     def _build_train_step(self, optimizer, lr_sign: float = 1.0) -> Callable:
         """Train every slot on its RESIDENT window state — the windows
@@ -999,3 +1047,244 @@ class ShardedScorer:
         # against a re-quantized sidecar (hot-swap between flushes)
         self._invalidate_kernel()
         return losses
+
+    # -- fused stacked training (the continual-learning train lane) -------
+    def _build_train_step_fused(
+        self, optimizer, lr_sign: float = 1.0
+    ) -> Callable:
+        """The train-lane twin of ``_build_train_step``: same masked-mean
+        loss semantics (psum'd num/den across data shards, per-slot lr,
+        inactive slots frozen), but the loss — and therefore the whole
+        BACKWARD pass — runs through the family's ``loss_stacked``
+        contract: one wide weight-stacked einsum chain over the [S·B]
+        tenant plane per scan step, slot-count-invariant, instead of S
+        per-slot vmapped matmuls (tools/check_fusion.py lints the grad
+        jaxpr). Slot s's loss depends only on slot s's param slices, so
+        the stacked gradient IS the per-slot gradients. The optax
+        transform is elementwise, so vmapping it over the slot axis
+        stays fused elementwise code — no dots re-enter. Params and opt
+        state are DONATED: the step updates HBM in place rather than
+        doubling resident weights for the training copy. Window state is
+        read-only (never donated), so one compiled step trains on EITHER
+        the resident serve windows or the replay-fed feed state."""
+        mesh = self.mm.mesh
+        spec, cfg, window = self.spec, self.cfg, self.window
+
+        def local_step(params, opt_state, values, pos, count, active, lr):
+            # params/opt [T_loc, ...], values [T_loc, S_loc, W]
+            def gather_one(vals, ps, cnt):
+                st = WindowState(values=vals, pos=ps, count=cnt)
+                ids = jnp.arange(vals.shape[0], dtype=jnp.int32)
+                return gather_windows(st, ids)
+
+            # window materialization is memory ops (gather/roll) — it
+            # stays vmapped per slot like the scoring step's scatter
+            windows, n = jax.vmap(gather_one)(values, pos, count)
+            act_f = active.astype(jnp.float32)
+            # same per-row gate as the legacy step: only streams with a
+            # full-enough history contribute, masked mean stays
+            # well-defined with 0 live streams
+            mask = (
+                (n >= jnp.minimum(window, 8)).astype(jnp.float32)
+                * act_f[:, None]
+            )
+
+            def stacked_loss(p):
+                per_row = spec.loss_stacked(p, cfg, windows)  # [T_loc, S_loc]
+                # psum numerator and denominator SEPARATELY across data
+                # shards (the legacy step's normalization, verbatim)
+                num = jax.lax.psum((per_row * mask).sum(-1), AXIS_DATA)
+                den = jnp.maximum(
+                    jax.lax.psum(mask.sum(-1), AXIS_DATA), 1.0
+                )
+                per_slot = num / den                          # [T_loc]
+                # sum over slots: grads of independent per-slot losses
+                # land in their own param slices — one backward pass
+                return per_slot.sum(), per_slot
+
+            (_total, per_slot_loss), grads = jax.value_and_grad(
+                stacked_loss, has_aux=True
+            )(params)
+            grads = jax.lax.psum(grads, AXIS_DATA)
+            updates, o2 = jax.vmap(
+                lambda g, o, p: optimizer.update(g, o, p)
+            )(grads, opt_state, params)
+            step_scale = lr_sign * lr                         # [T_loc]
+
+            def apply(a, u):
+                sc = step_scale.reshape(
+                    (-1,) + (1,) * (u.ndim - 1)
+                )
+                return (a + sc * u).astype(a.dtype)
+
+            p2 = jax.tree_util.tree_map(apply, params, updates)
+            # inactive slots keep pristine params AND optimizer state
+            # (same freeze as the legacy step)
+            def keep_active(new, old):
+                sel = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(sel, new, old)
+
+            p2 = jax.tree_util.tree_map(keep_active, p2, params)
+            o2 = jax.tree_util.tree_map(keep_active, o2, opt_state)
+            return p2, o2, per_slot_loss
+
+        smapped = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(
+                self.param_specs,            # params (per-leaf rules)
+                self._opt_specs,             # opt state (same rules)
+                P(AXIS_TENANT, AXIS_DATA),   # window values [T, S, W]
+                P(AXIS_TENANT, AXIS_DATA),   # pos
+                P(AXIS_TENANT, AXIS_DATA),   # count
+                P(AXIS_TENANT),              # active mask
+                P(AXIS_TENANT),              # per-slot lr
+            ),
+            out_specs=(self.param_specs, self._opt_specs, P(AXIS_TENANT)),
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1))
+
+    def init_train_feed(self) -> None:
+        """Allocate the replay-fed TRAIN window state — the same
+        [T, S, W] stacked rings as serving, fed by the train lane's
+        replayed microbatches instead of live traffic, so continual
+        learning sees windows BEYOND the resident serve state. Lazy:
+        only a slice with a replay-fed trainable tenant pays the HBM."""
+        if self._train_feed_state is not None:
+            return
+        state = init_stacked_state(
+            self.n_slots, self.max_streams, self.window
+        )
+        st_sharding = self.mm.sharding(AXIS_TENANT, AXIS_DATA)
+        self._train_feed_state = WindowState(
+            values=jax.device_put(state.values, st_sharding),
+            pos=jax.device_put(state.pos, st_sharding),
+            count=jax.device_put(state.count, st_sharding),
+        )
+        self._ingest = self._build_ingest_step()
+
+    def _build_ingest_step(self) -> Callable:
+        """Counts-mode window scatter WITHOUT scoring: replayed rows ride
+        the identical staging wire (ids/vals/counts through
+        ``stage_inputs``) into the train feed state. Donates the feed
+        state — in-place ring update, zero extra resident memory."""
+        mesh = self.mm.mesh
+
+        def local_ingest(state, ids, vals, validity):
+            m = (
+                jnp.arange(ids.shape[1], dtype=jnp.int32)[None, :]
+                < validity
+            )
+
+            def upd(st, i, v, m1):
+                return update_windows(
+                    st, i.astype(jnp.int32), v.astype(jnp.float32), m1
+                )
+
+            return jax.vmap(upd)(state, ids, vals, m)
+
+        smapped = shard_map(
+            local_ingest,
+            mesh=mesh,
+            in_specs=(
+                P(AXIS_TENANT, AXIS_DATA),   # feed window state
+                P(AXIS_TENANT, AXIS_DATA),   # stream ids (B over data)
+                P(AXIS_TENANT, AXIS_DATA),   # values
+                P(AXIS_TENANT, AXIS_DATA),   # lane counts
+            ),
+            out_specs=P(AXIS_TENANT, AXIS_DATA),
+        )
+        return jax.jit(smapped, donate_argnums=(0,))
+
+    def train_feed_ingest(self, stream_ids, values, counts) -> None:
+        """Scatter one staged replay microbatch into the train feed
+        windows (async dispatch; same wire/staging contract as
+        ``step_counts``)."""
+        self.init_train_feed()
+        self._train_feed_state = self._ingest(
+            self._train_feed_state, stream_ids, values, counts
+        )
+
+    def train_lane_step(
+        self,
+        slots_mask: Optional[jnp.ndarray] = None,
+        replay: bool = False,
+    ) -> jnp.ndarray:
+        """One FUSED optimizer step on the train lane: resident serve
+        windows (``replay=False`` — live adaptation) or the replay-fed
+        feed state (``replay=True`` — history beyond the resident
+        state). Async jit dispatch; returns the per-slot loss device
+        array the caller rides through the completion reaper.
+
+        Unlike ``train_resident`` this does NOT invalidate the serving
+        kernel sidecar: the lane's weight updates stay invisible to
+        scoring until ``commit_swap`` re-derives the kernel view every
+        ``swap_every`` steps — the zero-stall hot-swap boundary."""
+        if self._train_fused is None:
+            raise RuntimeError(
+                "train lane not built — call init_optimizer() on a "
+                "train_lane-capable scorer first"
+            )
+        mask = self.active & self.train_mask
+        if slots_mask is not None:
+            mask = mask & slots_mask
+        st = self._train_feed_state if replay else self.state
+        self.params, self._opt_state, losses = self._train_fused(
+            self.params, self._opt_state,
+            st.values, st.pos, st.count,
+            mask, self.slot_lr,
+        )
+        return losses
+
+    def prewarm_train_lane(self, lane_sizes=()) -> None:
+        """Compile the train lane's executables BEFORE traffic — the
+        same no-mid-loop-compile rule as ``prewarm``. Runs the REAL
+        programs with no observable effect: a zero-count ingest per
+        bucket size (scatter drops every row) and one all-False-mask
+        train step (the inactive-slot freeze passes params and opt
+        state through ``jnp.where`` bitwise). Requires
+        ``init_optimizer`` to have run."""
+        import numpy as _np
+
+        if self._train_fused is None:
+            raise RuntimeError(
+                "call init_optimizer() before prewarm_train_lane()"
+            )
+        self.init_train_feed()
+        t, d = self.n_slots, self.mm.n_data_shards
+        for b in sorted(set(int(x) for x in lane_sizes)) or [64]:
+            ids = _np.zeros((t, d * b), self.ids_np_dtype)
+            vals = _np.zeros((t, d * b), self.vals_np_dtype)
+            counts = _np.zeros((t, d), _np.int32)
+            self.train_feed_ingest(*self.stage_inputs(ids, vals, counts))
+        none = _np.zeros((self.n_slots,), bool)
+        for replay in (False, True):
+            _np.asarray(self.train_lane_step(none, replay=replay))
+
+    def commit_swap(self) -> None:
+        """The train lane's between-flush weight commit — the tail of
+        ``activate(params=...)``: the fused train steps already updated
+        the master stack in place (buffer donation), so committing means
+        re-deriving the serving kernel view (the quantized sidecar —
+        for bf16/int8 stacks scoring keeps the PREVIOUS weights until
+        this runs) and arming the PR 9 shadow canary so the freshly
+        swapped weights get immediate divergence coverage. f32 fused
+        stacks read the master directly (kernel view == master), so for
+        them the commit is the canary arm + observability cadence."""
+        self._invalidate_kernel()
+        self.arm_canary()
+
+    def train_flops_per_step(self) -> float:
+        """Analytic matmul FLOPs ONE fused train step executes: the full
+        padded stream plane (every slot × stream row gathers a window
+        and runs the teacher-forced loss, live or not) × per-row forward
+        FLOPs × 3 (the standard fwd+bwd multiplier: backward re-runs
+        ~2× the forward's matmul work). Feeds
+        ``tpu_train_flops_total{family}`` — kept OUT of the serving MFU
+        account (``tpu_mfu_pct`` means serving work), summed beside it
+        by the bench's overlap-MFU column."""
+        fn = getattr(self.spec, "flops_per_row", None)
+        if fn is None:
+            return 0.0
+        plane = self.n_slots * self.max_streams
+        return 3.0 * plane * float(fn(self.cfg, self.window))

@@ -280,6 +280,29 @@ class _LaneRing:
         return tuple(out)
 
 
+class _TrainLaneRing(_LaneRing):
+    """Replay-fed train-lane ring: pending TRAINING rows for one
+    (slot, data-shard), consumed from the tenant's ``replay-train-feed``
+    topic and packed into train microbatches through the same staging →
+    h2d wire as scoring flushes. Bounded by the train watermark
+    (2 × ``replay_microbatch``): past it the feed consumer stops pulling
+    (``tpu_inference.train_feed_backpressure``) and the backlog stays in
+    the bus topic, where retention bounds it and the replay pump's own
+    overload arbitration already parks the producer. Depth is the
+    ``tpu_inference_train_rows{family}`` gauge (tools/check_queues.py).
+    Same columnar ring mechanics as the serve lanes — distinct type so
+    the bounded-queue lint tracks the train lane as its own queue."""
+
+    __slots__ = ()
+
+
+def _empty_taken():
+    """A train-lane pending entry's ``taken`` placeholder: zero rows, so
+    every row-oriented resolve/teardown path (``_resolve_rows`` on the
+    seqs/rows columns) is a structural no-op without branching."""
+    return (None, None, np.empty((0,), np.int64), np.empty((0,), np.int32))
+
+
 class _StagingSet:
     """One reusable flush staging set: ids/vals ``[T, D*B]`` in the
     scorer's wire dtypes, lane counts ``[T, D]``, and a cached column
@@ -334,14 +357,14 @@ class _PendingFlush:
         "family", "sl", "scores", "taken", "moved", "gathered",
         "t_dispatch", "nbytes", "plane_nbytes", "host_future", "t_wait",
         "poisoned", "flops", "rec", "sketch", "shadow", "slot_override",
-        "resolved",
+        "resolved", "lane",
     )
 
     def __init__(
         self, family: str, scores, taken, moved: int, gathered: bool,
         nbytes: int, plane_nbytes: int, poisoned: bool = False,
         flops: float = 0.0, rec: Optional[dict] = None,
-        sketch=None, shadow=None, sl: int = 0,
+        sketch=None, shadow=None, sl: int = 0, lane: str = "serve",
     ) -> None:
         self.family = family
         # the mesh slice that ran this flush: reap queues, overlap
@@ -381,6 +404,14 @@ class _PendingFlush:
         # indices (rows then index row 0 of the slice); this remembers
         # the real slot so NaN attribution survives that path
         self.slot_override: Optional[int] = None
+        # which lane dispatched this entry: "serve" (a scoring flush —
+        # everything above applies) or "train" (a continual-learning
+        # train step riding the same per-slice in-flight window and
+        # reaper: ``scores`` holds the per-slot loss vector, ``taken``
+        # is empty, and resolution records training metrics instead of
+        # publishing batches). One FIFO per (family, slice) keeps the
+        # permit accounting and teardown drain uniform across lanes.
+        self.lane = lane
 
     @property
     def key(self) -> Tuple[str, int]:
@@ -539,6 +570,7 @@ class TpuInferenceEngine(TenantEngine):
         self.service = service
         self.placement = None
         self.streams: Optional[StreamRegistry] = None
+        self._feed_subscribed = False  # train-feed group registered
 
     async def on_start(self) -> None:
         svc = self.service
@@ -553,6 +585,25 @@ class TpuInferenceEngine(TenantEngine):
             svc.mm.n_data_shards, scorer.max_streams // svc.mm.n_data_shards
         )
         svc.bus.subscribe(svc.bus.naming.inbound_events(self.tenant), svc.group)
+        if (
+            self.config.training.enabled
+            and self.config.training.train_lane
+            and getattr(scorer, "train_lane", False)
+        ):
+            # replay-fed continual learning: scored history published by
+            # the replay engine's ``train`` target lands here and the
+            # scoring loop's low-priority intake pulls it into the train
+            # lane rings. Subscribed ONLY when something will actually
+            # consume it — a registered group engages the bus's publish
+            # backpressure, so subscribing with the lane off (tenant
+            # opt-out / TRAIN_LANE_ENABLED rollback / non-fused family)
+            # would wedge a replay train job forever once the topic
+            # fills; unsubscribed, the topic keeps its lossy retention
+            # tail exactly as before the lane existed.
+            svc.bus.subscribe(
+                svc.bus.naming.train_feed(self.tenant), svc.group
+            )
+            self._feed_subscribed = True
         # fair-queue registration: this tenant's intake is rationed by
         # its OverloadPolicy weight from the first poll
         svc.fair.configure(self.tenant, self.config.overload.weight)
@@ -651,10 +702,41 @@ class TpuInferenceEngine(TenantEngine):
                             seqs, rows, None, publish_nowait=True,
                             family=self.config.model,
                         )
+            # the tenant's pending TRAIN rows are droppable — they are
+            # replayed history the segment store still holds (a future
+            # replay train job re-feeds them); no loss accounting rides
+            # on the train lane
+            tl = svc._train_lanes.get((self.config.model, sl))
+            if tl is not None:
+                for key in [k for k in tl if k[0] == slot]:
+                    tl.pop(key)
+                svc._train_rows_gauge(self.config.model, sl)
+            # a recycled slot must not inherit this tenant's mature
+            # cadence tick either
+            svc._train_ticks.get((self.config.model, sl), {}).pop(
+                slot, None
+            )
+            # the train-feed cursor must leave with the tenant: a stale
+            # registered group never advances and would backpressure the
+            # topic forever — wedging any LATER replay train job exactly
+            # like the never-consumed case the subscribe gate avoids.
+            # Gated on the subscribe flag: bus.unsubscribe instantiates
+            # absent topics, and a never-subscribed tenant's stop must
+            # not litter the bus (and every checkpoint) with empty feeds
+            if self._feed_subscribed:
+                self._feed_subscribed = False
+                svc.bus.unsubscribe(
+                    svc.bus.naming.train_feed(self.tenant), svc.group
+                )
             svc.router.remove(self.tenant)
             self.placement = None
         svc.fair.remove(self.tenant)
         svc.scorehealth.remove(self.tenant)
+        # bounded label cardinality: the per-tenant train-lane ledger
+        # tracks LIVE tenants only (scoped sweep — see drop_labeled)
+        svc.metrics.drop_labeled(
+            families=["tpu_train_steps_total"], tenant=self.tenant
+        )
         svc._gates.pop(self.tenant, None)
 
 
@@ -761,8 +843,52 @@ class TpuInferenceService(MultitenantService):
         # batch registry: seq → [batch, rows_awaiting_scores]
         self._batches: Dict[int, list] = {}
         self._next_seq = 0
-        # live-training cadence: per-(family, slice) {slot: flush-tick}
+        # live-training cadence: per-(family, slice) {slot: flush-tick}.
+        # With the async train lane, a LANE slot's tick only accumulates
+        # here (maturity is checked — and reset — at lane dispatch, so a
+        # throttled slot keeps its mature tick until admitted); inline
+        # slots keep the legacy check-and-reset-per-flush semantics.
         self._train_ticks: Dict[Tuple[str, int], Dict[int, int]] = {}
+        # continual-learning train lane (docs/PERFORMANCE.md "Continual
+        # learning lane"): replay-fed training rows per (family, slice),
+        # keyed (slot, data-shard) like the serve lanes; steps since the
+        # last weight commit per slice; scratch columns for the packer
+        self._train_lanes: Dict[
+            Tuple[str, int], Dict[Tuple[int, int], _TrainLaneRing]
+        ] = {}
+        self._lane_swap: Dict[Tuple[str, int], int] = {}
+        # last dispatched lane source per slice ("replay" | "resident")
+        # — the alternation token when both sources are pending
+        self._lane_last_source: Dict[Tuple[str, int], str] = {}
+        self._train_scratch: Optional[tuple] = None
+        self.metrics.describe(
+            "tpu_train_skipped_total",
+            "training work skipped per family and reason (no_trainer/"
+            "optimizer_init/parked/throttled/saturated/capacity) — a "
+            "misconfigured or starved trainable tenant must not be dark",
+        )
+        self.metrics.describe(
+            "tpu_train_steps_total",
+            "train-lane optimizer steps that included the tenant's slot "
+            "(the overload arbiter's per-tenant ledger: a saturated "
+            "tenant reads exactly 0 while idle tenants train)",
+        )
+        self.metrics.describe(
+            "tpu_train_rows_total",
+            "replayed history rows ingested into train microbatches, "
+            "per family",
+        )
+        self.metrics.describe(
+            "tpu_train_flops_total",
+            "analytic FLOPs executed by train-lane steps per family — "
+            "kept OUT of tpu_flops_total/tpu_mfu_pct (serving work); "
+            "the bench's overlap-MFU column sums the two",
+        )
+        self.metrics.describe(
+            "tpu_train_swaps_total",
+            "train-lane weight commits (kernel-sidecar re-derivation + "
+            "canary arm) per family — one every swap_every lane steps",
+        )
         # per-(family, slice) last train losses (device arrays; string
         # lookup resolves while one slice serves the family)
         self.last_train_losses: _ScorerMap = _ScorerMap()
@@ -1022,6 +1148,16 @@ class TpuInferenceService(MultitenantService):
                         family=fence.family,
                     )
         self._fences.clear()
+        # pending train rows are droppable history (the segment store
+        # still holds them; a future replay train job re-feeds) — no
+        # unscored-resolve obligation on the train lane. Zero the depth
+        # gauges as the rings go: a stopped service must not report
+        # phantom pending training rows forever.
+        for fam in {f for (f, _sl) in self._train_lanes}:
+            self.metrics.gauge(
+                "tpu_inference_train_rows", family=fam
+            ).set(0)
+        self._train_lanes.clear()
         self._last_scores.clear()  # drop any pinned device score memory
         if self.mm.n_devices > 1:
             # cardinality guard (the drop_labeled pattern): a stopped
@@ -1545,6 +1681,7 @@ class TpuInferenceService(MultitenantService):
                 # (d2h/resolve/device timings) when the reaper resolves it
                 rec = self.flightrec.record(
                     "flush", family,
+                    lane="serve",
                     rows=moved, bucket=b_lane,
                     assembly_s=round(assembly_s, 6),
                     h2d_stage_s=round(h2d_stage_s, 6),
@@ -1626,6 +1763,7 @@ class TpuInferenceService(MultitenantService):
                 else:
                     err_rec = self.flightrec.record(
                         "flush", family,
+                        lane="serve",
                         rows=moved, bucket=b_lane,
                         assembly_s=round(assembly_s, 6),
                         h2d_stage_s=(
@@ -1815,6 +1953,19 @@ class TpuInferenceService(MultitenantService):
                 old_scorer.reset_slot(old_p.slot)
             except Exception as exc:  # noqa: BLE001 - slice may be dead
                 self._record_error("failover-reset", exc)
+        # the tenant's pending TRAIN rows stay keyed to the OLD
+        # (slot, data-shard): drop them (droppable history — the store
+        # re-feeds) or the next tenant placed on that slot would train
+        # on THIS tenant's replayed data; its cadence tick goes with it
+        # (a recycled slot must not inherit a mature tick either)
+        tl = self._train_lanes.get((family, old_p.shard))
+        if tl is not None:
+            for key in [k for k in tl if k[0] == old_p.slot]:
+                tl.pop(key)
+            self._train_rows_gauge(family, old_p.shard)
+        self._train_ticks.get((family, old_p.shard), {}).pop(
+            old_p.slot, None
+        )
         engine.placement = new_p
         new_scorer = self.scorer_for_slice(family, new_p.shard, engine.config)
         new_scorer.activate(
@@ -1927,13 +2078,20 @@ class TpuInferenceService(MultitenantService):
         self, family: str, sl: int, scorer: ShardedScorer,
         engine_cfgs: Dict[int, TenantEngineConfig],
     ) -> int:
-        """Live training cadence: every Nth scoring flush dispatches ONE
-        optimizer step for every active slot on its resident window state
-        (zero host<->device traffic — see ShardedScorer.train_resident).
-        The jit dispatch is async, so the scoring loop never blocks on the
-        gradient computation; tenants in the same family stack with
-        training disabled are excluded by the scorer's per-slot train
-        mask."""
+        """Per-flush training cadence bookkeeping, two regimes:
+
+        - **inline** slots (the pre-lane path — ``TRAIN_LANE_ENABLED``
+          off, a non-fused family, or ``training.train_lane=False``):
+          every Nth scoring flush dispatches ONE legacy optimizer step
+          for the mature slots on their resident window state, right
+          here on the flush path — bitwise the pre-lane behavior.
+        - **lane** slots: the tick only ACCUMULATES; maturity is checked
+          (and reset) by ``_train_lane_tick`` at dispatch, off the flush
+          critical path, so a throttled slot keeps its mature tick until
+          the overload arbiter admits it.
+
+        Either way the jit dispatch is async and tenants with training
+        disabled are excluded by the scorer's per-slot train mask."""
         enabled = {
             slot: c.training
             for slot, c in engine_cfgs.items()
@@ -1941,11 +2099,22 @@ class TpuInferenceService(MultitenantService):
         }
         if not enabled:
             return 0
+        if getattr(scorer.spec, "loss", None) is None:
+            # a tenant opted into training on a family with no loss
+            # contract: it would silently never train — surface it
+            self.metrics.counter(
+                "tpu_train_skipped_total", family=family, reason="no_trainer"
+            ).inc()
+            return 0
+        lane_on = bool(getattr(scorer, "train_lane", False))
         # per-TENANT cadence: each slot matures on its own every_n_flushes
         # (and trains at its own lr — see ShardedScorer.slot_lr)
         ticks = self._train_ticks.setdefault((family, sl), {})
         mature = []
         for slot, tc in enabled.items():
+            if lane_on and tc.train_lane:
+                ticks[slot] = ticks.get(slot, 0) + 1
+                continue
             n = ticks.get(slot, 0) + 1
             if n >= tc.every_n_flushes:
                 mature.append(slot)
@@ -1955,12 +2124,432 @@ class TpuInferenceService(MultitenantService):
         if not mature:
             return 0
         if getattr(scorer, "_train", None) is None:
-            scorer.init_optimizer()  # scale_by_adam + per-slot lr
+            try:
+                scorer.init_optimizer()  # scale_by_adam + per-slot lr
+            except Exception:
+                self.metrics.counter(
+                    "tpu_train_skipped_total", family=family,
+                    reason="optimizer_init",
+                ).inc()
+                raise
         mask = np.zeros((scorer.n_slots,), bool)
         mask[mature] = True
         self.last_train_losses[(family, sl)] = scorer.train_resident(mask)
         self.metrics.counter("tpu_inference.train_steps").inc()
+        if (
+            getattr(scorer, "train_lane", False)
+            and self._lane_swap.get((family, sl), 0) > 0
+        ):
+            # MIXED stack (inline + lane tenants): train_resident just
+            # invalidated the shared sidecar, which publishes the lane
+            # tenants' in-flight uncommitted weights to serving too —
+            # that IS a commit, so it must arm the canary and count as
+            # a swap instead of silently bypassing the swap contract
+            self._lane_swap[(family, sl)] = 0
+            scorer.arm_canary()
+            self.metrics.counter(
+                "tpu_train_swaps_total", family=family
+            ).inc()
+            if self.flightrec is not None:
+                self.flightrec.record(
+                    "swap", family,
+                    lane="train", mesh_slice=sl,
+                    device_label=scorer.device_label,
+                    inline=True,
+                    canary_armed=bool(scorer.canary_active()),
+                )
         return 1
+
+    # -- continual-learning train lane ------------------------------------
+    def _train_admit(self, tenant: str) -> bool:
+        """The serve/train arbitration: a tenant's training is admitted
+        only while live traffic leaves headroom — i.e. the tenant shows
+        NO overload signal (full credit, no degradation rung: the one
+        shared ``under_pressure`` definition, so the shed gates and the
+        train lane can never disagree about what pressure means). Live
+        traffic always wins; the hostile-tenant chaos suite pins this
+        at exactly 0 train steps under sustained pressure."""
+        ov = self.overload
+        return ov is None or not ov.under_pressure(tenant)
+
+    async def _consume_train_feed(
+        self, tenant: str, engine: "TpuInferenceEngine"
+    ) -> None:
+        """Low-priority intake from the tenant's replay-train-feed topic
+        into the train lane rings. Bounded: past the lane watermark
+        (2 × replay_microbatch) the consumer parks and the backlog stays
+        in the bus topic (counted; the replay pump's own overload
+        arbitration already throttles the producer). A throttled tenant
+        (credit < 1 / rung engaged) doesn't pull either — its feed waits
+        out the pressure. The feed topic is EXCLUDED from the overload
+        credit signal (runtime.overload._tenant_lag), so a parked train
+        backlog can never throttle the tenant's serve path."""
+        family = engine.config.model
+        sl = engine.placement.shard
+        scorer = self.scorers.get((family, sl))
+        if scorer is None or not getattr(scorer, "train_lane", False):
+            return
+        if not self._train_admit(tenant):
+            return
+        pin = self._family_cfg.get(family, engine.config).training
+        micro = max(1, int(getattr(pin, "replay_microbatch", 1024)))
+        tlanes = self._train_lanes.setdefault((family, sl), {})
+        slot = engine.placement.slot
+        depth = sum(
+            r.count for (s, _d), r in tlanes.items() if s == slot
+        )
+        if depth >= 2 * micro:
+            self.metrics.counter(
+                "tpu_inference.train_feed_backpressure"
+            ).inc()
+            return
+        items = await self.bus.consume(
+            self.bus.naming.train_feed(tenant), self.group,
+            self.poll_batch, timeout_s=0,
+        )
+        if not items:
+            return
+        if (
+            engine.state is not LifecycleState.STARTED
+            or engine.placement is None
+        ):
+            return  # stopped mid-consume: training rows are droppable
+        for b in items:
+            if isinstance(b, MeasurementBatch):
+                self._enqueue_train_batch(engine, b, tlanes)
+        self._train_rows_gauge(family, sl)
+
+    def _enqueue_train_batch(
+        self, engine: "TpuInferenceEngine", batch: MeasurementBatch,
+        tlanes: Dict[Tuple[int, int], _TrainLaneRing],
+    ) -> None:
+        """Route one replayed batch's rows into the train lane rings —
+        the train twin of ``_enqueue_batch``, minus every delivery
+        obligation: no seq registry, no score column, no publish (the
+        rows are already persisted history; training is their only
+        consumer). Stream routing shares the tenant's serve
+        StreamRegistry, so a replayed row's window lands in the SAME
+        (slot, data-shard, local-id) ring position its live twin would."""
+        slot = engine.placement.slot
+        dshards, locals_ = engine.streams.lookup_or_assign_bulk(batch)
+        skipped = int((dshards == -1).sum())
+        if skipped:
+            self.metrics.counter(
+                "tpu_train_skipped_total",
+                family=engine.config.model, reason="capacity",
+            ).inc(skipped)
+        for d in range(self.mm.n_data_shards):
+            sel = np.nonzero(dshards == d)[0]
+            if sel.size == 0:
+                continue
+            lane = tlanes.get((slot, d))
+            if lane is None:
+                lane = tlanes[(slot, d)] = _TrainLaneRing(4096)
+            # seq/row bookkeeping is vestigial on the train lane (rows
+            # never resolve back into a batch) — seq broadcasts 0
+            lane.push(locals_[sel], batch.values[sel], 0, sel)
+
+    def _train_rows_gauge(self, family: str, _sl: int = 0) -> None:
+        # the gauge is FAMILY-labeled, so it must sum every slice's
+        # rings — a per-slice sum would let slices of one family
+        # overwrite each other's depth (the last_train_losses keying
+        # lesson from the multi-chip review, applied to the gauge)
+        depth = sum(
+            r.count
+            for (f, _s), lanes in self._train_lanes.items()
+            if f == family
+            for r in lanes.values()
+        )
+        self.metrics.gauge("tpu_inference_train_rows", family=family).set(
+            depth
+        )
+
+    async def _train_lane_tick(
+        self, fam_cfgs: Dict[Tuple[str, int], Dict[int, TenantEngineConfig]]
+    ) -> int:
+        """One pass of the async low-priority train lane: for each
+        (family, slice) whose scorer carries the fused lane, dispatch at
+        most ONE train step — replay-fed when an admitted microbatch is
+        buffered, else resident-state when a slot's cadence matured —
+        and only when the slice has a FREE in-flight permit right now
+        (``sem.locked()`` ⇒ the serve path owns every slot: a saturated
+        slice trains exactly 0 steps) and the overload arbiter admits
+        the tenant. The dispatch rides the slice's semaphore + reap FIFO
+        as ``lane="train"``, so its completion, teardown drain, and
+        queue-depth accounting are the serve path's own machinery."""
+        steps = 0
+        for (family, sl), cfgs in fam_cfgs.items():
+            scorer = self.scorers.get((family, sl))
+            if scorer is None or not getattr(scorer, "train_lane", False):
+                continue
+            lane_cfgs = {
+                s: c for s, c in cfgs.items()
+                if c.training.enabled and c.training.train_lane
+            }
+            if not lane_cfgs:
+                continue
+            if family in self._parked:
+                self.metrics.counter(
+                    "tpu_train_skipped_total", family=family,
+                    reason="parked",
+                ).inc()
+                continue
+            pin = self._family_cfg.get(
+                family, next(iter(lane_cfgs.values()))
+            ).training
+            micro = max(1, int(getattr(pin, "replay_microbatch", 1024)))
+            admitted = {
+                s: c for s, c in lane_cfgs.items()
+                if self._train_admit(c.tenant)
+            }
+            throttled = len(lane_cfgs) - len(admitted)
+            if not admitted:
+                if throttled:
+                    self.metrics.counter(
+                        "tpu_train_skipped_total", family=family,
+                        reason="throttled",
+                    ).inc(throttled)
+                continue
+            ticks = self._train_ticks.get((family, sl), {})
+            tlanes = self._train_lanes.get((family, sl), {})
+            feed_rows = sum(
+                r.count for (s, _d), r in tlanes.items() if s in admitted
+            )
+            mature = [
+                s for s, c in admitted.items()
+                if ticks.get(s, 0) >= c.training.every_n_flushes
+            ]
+            replay = feed_rows >= micro
+            if not replay and not mature:
+                continue
+            if replay and mature and (
+                self._lane_last_source.get((family, sl)) == "replay"
+            ):
+                # both sources pending: ALTERNATE. A long replay
+                # backfill holding feed_rows ≥ micro for hours must not
+                # starve a co-tenant's mature resident cadence (the
+                # mature slot is admitted but never fed, so no skip
+                # counter would ever name its starvation)
+                replay = False
+            if throttled:
+                # mature-but-throttled siblings sat this dispatch out
+                self.metrics.counter(
+                    "tpu_train_skipped_total", family=family,
+                    reason="throttled",
+                ).inc(throttled)
+            sem = self._inflight_sem((family, sl))
+            q = self._reap.get((family, sl))
+            if sem.locked() or (q and any(p.lane != "train" for p in q)):
+                # the slice is busy SERVING — in-flight flushes hold the
+                # window (or every permit): training yields and waits
+                # for a genuinely idle gap. "Idle headroom" is literal:
+                # a train step only ever enters an EMPTY in-flight
+                # window, so a saturated slice trains exactly 0 steps
+                # and a serve flush never queues behind a train step it
+                # could have preceded.
+                self.metrics.counter(
+                    "tpu_train_skipped_total", family=family,
+                    reason="saturated",
+                ).inc()
+                continue
+            if q:
+                # only the lane's OWN previous step is in flight: lane
+                # steps self-serialize per slice — normal pacing, not
+                # starvation, so it must not pollute the "saturated"
+                # signal operators read as serve pressure
+                continue
+            steps += await self._dispatch_train(
+                family, sl, scorer, admitted, mature, replay, pin,
+            )
+        return steps
+
+    def _pack_train(
+        self, family: str, sl: int, scorer, admitted: Dict[int, object],
+    ) -> Tuple[int, List[int]]:
+        """Pack the admitted slots' pending train rows into a rotating
+        staging set (the SAME per-slice pool and wire dtypes as scoring
+        flushes), stage them h2d, and scatter them into the scorer's
+        train feed windows. Returns (rows moved, slots that contributed
+        rows — the only slots the replay step may train: an admitted
+        co-tenant with an empty feed must not take a zero-gradient Adam
+        step, which would drift its weights on stale momentum and skew
+        its bias-correction count). The ingest dispatch is async and
+        precedes the train step on the device queue."""
+        tlanes = self._train_lanes.get((family, sl), {})
+        mbcfg = self._family_cfg[family].microbatch
+        pending = max(
+            (r.count for (s, _d), r in tlanes.items() if s in admitted),
+            default=0,
+        )
+        if pending == 0:
+            return 0, []
+        b_lane = self._pick_bucket(
+            pending, tuple(mbcfg.buckets), mbcfg.max_batch
+        )
+        scratch = self._train_scratch
+        if scratch is None or len(scratch[0]) < b_lane:
+            # pop_into needs seqs/rows landing zones; train rows never
+            # resolve, so one reusable scratch pair serves every pack
+            scratch = self._train_scratch = (
+                np.empty((max(b_lane, mbcfg.max_batch),), np.int64),
+                np.empty((max(b_lane, mbcfg.max_batch),), np.int32),
+            )
+        sc_seqs, sc_rows = scratch
+        st = self._staging_set(family, sl, scorer, b_lane)
+        ids, vals, counts = st.ids, st.vals, st.counts
+        counts[:] = 0
+        moved = 0
+        fed: set = set()
+        for (slot, dshard), lane in sorted(tlanes.items()):
+            if slot not in admitted:
+                continue
+            k = min(lane.count, b_lane)
+            if k == 0:
+                continue
+            lane.pop_into(
+                k, ids[slot], vals[slot], dshard * b_lane,
+                sc_seqs, sc_rows, 0,
+            )
+            counts[slot, dshard] = k
+            fed.add(slot)
+            moved += k
+        self._train_rows_gauge(family, sl)
+        if moved == 0:
+            return 0, []
+        staged = scorer.stage_inputs(ids, vals, counts)
+        st.staged = staged
+        try:
+            self.metrics.counter("tpu_inference.staged_bytes").inc(
+                scorer.stage_nbytes(staged)
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+        scorer.train_feed_ingest(*staged)
+        self.metrics.counter(
+            "tpu_train_rows_total", family=family
+        ).inc(moved)
+        return moved, sorted(fed)
+
+    async def _dispatch_train(
+        self, family: str, sl: int, scorer, admitted: Dict[int, object],
+        mature: List[int], replay: bool, pin,
+    ) -> int:
+        """Dispatch one train-lane step and enqueue its completion on the
+        slice's reap FIFO. The permit is held until the reaper resolves
+        the entry — train steps count against the slice's in-flight
+        window exactly like flushes, which is what keeps them off the
+        serve critical path (a full window defers training, never
+        scoring)."""
+        sem = self._inflight_sem((family, sl))
+        # locked() was False with no await since: acquire returns now
+        await sem.acquire()
+        enqueued = False
+        try:
+            if getattr(scorer, "_train_fused", None) is None:
+                try:
+                    scorer.init_optimizer()
+                except Exception as exc:  # noqa: BLE001 - optimizer
+                    # construction is config-driven; surface, don't die
+                    self._record_error("train-init", exc)
+                    self.metrics.counter(
+                        "tpu_train_skipped_total", family=family,
+                        reason="optimizer_init",
+                    ).inc()
+                    return 0
+            shape_key = (family, sl, "train")
+            compiling = shape_key not in self._seen_shapes
+            rows_moved = 0
+            source = "resident"
+            ticks = self._train_ticks.setdefault((family, sl), {})
+            if replay:
+                source = "replay"
+                rows_moved, trained = self._pack_train(
+                    family, sl, scorer, admitted
+                )
+            else:
+                trained = sorted(mature)
+            # EVERY trained slot's cadence resets — a replay step IS the
+            # slot's training for this interval, so a feed oscillating
+            # around the microbatch threshold must not double the
+            # configured cadence with a back-to-back resident step
+            for s in trained:
+                ticks[s] = 0
+            if not trained:
+                return 0
+            self._lane_last_source[(family, sl)] = source
+            mask = np.zeros((scorer.n_slots,), bool)
+            mask[trained] = True
+            t_disp = time.perf_counter()
+            losses_dev = scorer.train_lane_step(mask, replay=replay)
+            dispatch_s = time.perf_counter() - t_disp
+            try:
+                losses_dev.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - test doubles
+                pass
+            if compiling:
+                self._seen_shapes.add(shape_key)
+                self.metrics.counter("tpu_inference.compiles").inc()
+            self.metrics.counter("tpu_inference.train_steps").inc()
+            for s in trained:
+                self.metrics.counter(
+                    "tpu_train_steps_total", tenant=admitted[s].tenant
+                ).inc()
+            # zero-stall hot-swap cadence: every swap_every lane steps
+            # the master weights commit to the serving kernel view (the
+            # activate(params=...) tail — sidecar re-derive + canary
+            # arm); between commits scoring runs the previous weights
+            swaps = self._lane_swap.get((family, sl), 0) + 1
+            swap_every = max(1, int(getattr(pin, "swap_every", 8)))
+            if swaps >= swap_every:
+                swaps = 0
+                scorer.commit_swap()
+                self.metrics.counter(
+                    "tpu_train_swaps_total", family=family
+                ).inc()
+                if self.flightrec is not None:
+                    self.flightrec.record(
+                        "swap", family,
+                        lane="train", mesh_slice=sl,
+                        device_label=scorer.device_label,
+                        steps=swap_every,
+                        canary_armed=bool(scorer.canary_active()),
+                    )
+            self._lane_swap[(family, sl)] = swaps
+            rec = None
+            if self.flightrec is not None:
+                rec = self.flightrec.record(
+                    "flush", family,
+                    lane="train", source=source,
+                    rows=rows_moved, slots=len(trained),
+                    dispatch_s=round(dispatch_s, 6),
+                    compiled=compiling,
+                    mesh_slice=sl,
+                    device_label=scorer.device_label,
+                    status="inflight",
+                )
+            flops_fn = getattr(scorer, "train_flops_per_step", None)
+            pf = _PendingFlush(
+                family, losses_dev, _empty_taken(), 0, False,
+                int(getattr(losses_dev, "nbytes", 0)), 0,
+                flops=float(flops_fn()) if flops_fn is not None else 0.0,
+                rec=rec, sl=sl, lane="train",
+            )
+            if not hasattr(losses_dev, "copy_to_host_async"):
+                pf.ensure_host_future(
+                    asyncio.get_running_loop(), self._deliver_pool
+                )
+            self._reap_enqueue(pf)
+            enqueued = True
+            return 1
+        except Exception as exc:  # noqa: BLE001 - the train lane is
+            # best-effort: a faulting step must not take serving down
+            # (the serve path's own flushes drive breaker/failover if
+            # the device is truly sick)
+            self._record_error("train", exc)
+            return 0
+        finally:
+            if not enqueued:
+                sem.release()
 
     def _deliver_gauge(self) -> None:
         self.metrics.gauge("tpu_inference_deliver_inflight").set(
@@ -2154,6 +2743,34 @@ class TpuInferenceService(MultitenantService):
         _slots, _cols, seqs, rows = pf.taken
         scattered = False  # did the (possibly unscored) write-back start?
         try:
+            if pf.lane == "train":
+                # train-lane completion: no rows to resolve — materialize
+                # the per-slot loss vector (same executor discipline as
+                # scores), publish it to last_train_losses, and attribute
+                # the step's device window + FLOPs to the TRAIN families
+                # (never the serving MFU account)
+                scattered = True  # nothing row-shaped to salvage on cancel
+                losses_np, _sk, _sh = await pf.ensure_host_future(
+                    asyncio.get_running_loop(), self._deliver_pool
+                )
+                now = time.perf_counter()
+                self.last_train_losses[pf.key] = losses_np
+                device_s = max(0.0, now - pf.t_dispatch)
+                self.metrics.histogram(
+                    "tpu_inference.train_step", unit="s"
+                ).record(device_s)
+                if pf.flops:
+                    self.metrics.counter(
+                        "tpu_train_flops_total", family=pf.family
+                    ).inc(pf.flops)
+                if pf.rec is not None:
+                    pf.rec["device_s"] = round(device_s, 6)
+                    finite = losses_np[np.isfinite(losses_np)]
+                    pf.rec["loss_max"] = (
+                        round(float(finite.max()), 6) if finite.size else None
+                    )
+                    pf.rec["status"] = "ok"
+                return
             if pf.poisoned:
                 # the dispatch itself failed (breaker/failover already
                 # recorded at the flush site): no transfer to wait for —
@@ -2301,10 +2918,12 @@ class TpuInferenceService(MultitenantService):
             if pf.rec is not None and not pf.poisoned:
                 pf.rec["status"] = "error"
                 pf.rec["error"] = repr(exc)
-            if not pf.poisoned:
+            if not pf.poisoned and pf.lane != "train":
                 # a poisoned flush's dispatch failure was already counted
                 # at the flush site — recording it again here would let a
-                # downstream bus hiccup double-pace failover/parking
+                # downstream bus hiccup double-pace failover/parking;
+                # train-lane faults are best-effort and must not pace
+                # breaker/failover either (serve flushes own that signal)
                 breaker = self.breakers.get(pf.key)
                 if breaker is not None:
                     breaker.record_failure()
@@ -2388,6 +3007,13 @@ class TpuInferenceService(MultitenantService):
                     fam_cfgs.setdefault(
                         (engine.config.model, engine.placement.shard), {}
                     )[engine.placement.slot] = engine.config
+                    tc = engine.config.training
+                    if tc.enabled and tc.train_lane:
+                        # replay-fed continual learning: low-priority
+                        # intake from the train feed topic into the
+                        # train lane rings (bounded + credit-gated —
+                        # never charged against the serve fair budget)
+                        await self._consume_train_feed(tenant, engine)
                 budget = self.fair.budget(tenant)
                 if budget <= 0:
                     throttled.inc()
@@ -2468,6 +3094,12 @@ class TpuInferenceService(MultitenantService):
                 full = any(l.count >= mb.max_batch for l in lanes.values())
                 if full or self._deadline_reached((family, sl), mb.deadline_ms):
                     moved += await self._flush_slice(cfgs, family, sl)
+            if fam_cfgs:
+                # the async train lane runs AFTER serve flushes, off the
+                # flush critical path: at most one low-priority train
+                # dispatch per (family, slice) per pass, and only into a
+                # free in-flight permit (a saturated slice trains 0)
+                moved += await self._train_lane_tick(fam_cfgs)
             if moved == 0:
                 await asyncio.sleep(0.001)
 
@@ -2521,10 +3153,34 @@ class TpuInferenceService(MultitenantService):
             wanted.setdefault(key, set()).update(
                 [min(b, mb.max_batch) for b in mb.buckets] + [mb.max_batch]
             )
+        lane_keys: set = set()
+        for tenant, engine in self.engines.items():
+            assert isinstance(engine, TpuInferenceEngine)
+            if engine.placement is None:
+                continue
+            tc = engine.config.training
+            if tc.enabled and tc.train_lane:
+                lane_keys.add(
+                    (engine.config.model, engine.placement.shard)
+                )
         for key, sizes in wanted.items():
             scorer = self.scorers.get(key)
             if scorer is not None:
                 scorer.prewarm(sorted(sizes))
+                if key in lane_keys and getattr(
+                    scorer, "train_lane", False
+                ):
+                    # the train lane's first step/ingest must not pay a
+                    # mid-traffic XLA compile either — same rule as the
+                    # scoring shapes above
+                    if getattr(scorer, "_train_fused", None) is None:
+                        scorer.init_optimizer()
+                    scorer.prewarm_train_lane(sorted(sizes))
+                    # the lane's executables are compiled now: the first
+                    # real dispatch must not report a (false) compile —
+                    # that would fire the steady_state_recompile
+                    # watchdog the moment a replay train job starts
+                    self._seen_shapes.add((key[0], key[1], "train"))
 
     def params_source(self, tenant: str):
         """A zero-arg callable yielding the tenant's CURRENT slot params
@@ -2576,6 +3232,7 @@ class TpuInferenceService(MultitenantService):
                     "n_slots": s.n_slots,
                     "max_streams": s.max_streams,
                     "device": s.device_label,
+                    "train_lane": bool(getattr(s, "train_lane", False)),
                 }
                 for (fam, sl), s in sorted(self.scorers.items())
             },

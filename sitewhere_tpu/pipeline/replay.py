@@ -16,7 +16,15 @@ A :class:`ReplayJob` names a tenant, a time/seq window, and a target:
   topic so the rule engine re-fires over it (alert backfill after a rule
   change).
 - ``train`` — scored history publishes to the tenant's replay-train-feed
-  topic: the feeder for on-device continual learning (ROADMAP item 3).
+  topic: the feeder for on-device continual learning. The scoring
+  loop's train-lane intake consumes it into per-(slot, data-shard)
+  train rings, packs ``replay_microbatch``-row microbatches through the
+  live staging → h2d wire, and runs fused stacked train steps over a
+  separate train window state — windows beyond the resident serve
+  state (docs/PERFORMANCE.md "Continual learning lane"). The feed
+  topic is deliberately EXCLUDED from the overload credit signal
+  (runtime.overload) — the consumer is itself credit-gated, so a
+  parked train backlog must never throttle the tenant's serve path.
 
 Mechanics:
 

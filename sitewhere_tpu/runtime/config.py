@@ -140,13 +140,35 @@ class TracingConfig:
 
 @dataclass(frozen=True)
 class TrainingConfig:
-    """Live on-device training cadence (rebuild-only: per-tenant models
-    diverge by training on their RESIDENT window state — zero bytes move
-    host<->device; see parallel.sharded.train_resident)."""
+    """Live on-device training knobs (rebuild-only; docs/PERFORMANCE.md
+    "Continual learning lane").
+
+    Resident-state steps train on windows that already live sharded on
+    device, so they move zero bytes host<->device; the REPLAY-FED lane
+    additionally streams scored history (the replay engine's ``train``
+    target) through the staging → h2d feed path into train microbatches
+    — windows beyond the resident state, at the same wire cost per row
+    as scoring. Training dispatches async at low priority off the flush
+    critical path (per-slice in-flight window + overload arbitration);
+    the ``parallel.sharded.TRAIN_LANE_ENABLED`` kill switch restores the
+    inline every_n_flushes path bitwise."""
 
     enabled: bool = False
     every_n_flushes: int = 50   # one optimizer step per N scoring flushes
     lr: float = 1e-3
+    # ride the async train lane when the family kernel supports it (fused
+    # stacked step + loss_stacked contract); False pins this tenant to
+    # the inline pre-lane cadence even while the lane is globally on
+    train_lane: bool = True
+    # zero-stall hot-swap cadence: every N lane steps the trained master
+    # weights commit to the serving kernel view (quantized-sidecar
+    # re-derive + PR 9 canary arm). Family-pinned (first tenant wins),
+    # like the fused-kernel knobs.
+    swap_every: int = 8
+    # replay-fed microbatch: buffered train-feed rows per ingest+train
+    # dispatch (the lane's unit of wire transfer; 2× this is the train
+    # ring watermark). Family-pinned.
+    replay_microbatch: int = 1024
 
 
 @dataclass(frozen=True)

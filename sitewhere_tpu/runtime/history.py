@@ -75,6 +75,13 @@ DEFAULT_ALLOWLIST: Tuple[str, ...] = (
     "score_quality_p99",
     "score_quality_nan_rate",
     "score_canary_mean_abs_delta",
+    # continual-learning train lane: step cadence, replay-fed volume,
+    # and weight-commit history — "when did training pause / swap"
+    # questions read these beside overload_credit
+    "tpu_inference.train_steps",
+    "tpu_train_rows_total",
+    "tpu_train_swaps_total",
+    "tpu_inference_train_rows",
 )
 
 # Families the Watchdog rules read from the history ring. A custom

@@ -588,6 +588,12 @@ class OverloadController:
                 continue
             if ".dead-letter." in topic or topic.endswith("expired-events"):
                 continue
+            if topic.endswith("replay-train-feed"):
+                # the train lane's backlog is low-priority history, not
+                # pipeline pressure — and its consumer is credit-GATED,
+                # so counting it would latch a feedback loop: throttled
+                # ⇒ feed unconsumed ⇒ lag ⇒ credit stays low forever
+                continue
             groups = info.get("groups", {})
             if groups:
                 worst = max(worst, max(groups.values()))

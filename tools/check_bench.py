@@ -70,13 +70,22 @@ _THROUGHPUT_EXACT = {
 # vit_fps / vit_pipeline_ratio.
 _INFO_EXACT = {"vit_wire_mbps"}
 
+# lower-is-better keys gated by NAME (ISSUE 13): serve_p99_train_delta =
+# serve p99 with the train lane active ÷ the training-off twin's, same
+# offered load — the train lane's whole contract is that this ratio
+# stays ~1.0 (acceptance: within 10%). Gated with the p99 tolerance
+# (the twins run back-to-back in one process, so common-mode rig drift
+# cancels in the ratio; chip baselines make it stable). train_ev_s (the
+# lane's replay-fed rows/s) gates via the _ev_s suffix rule.
+_P99_EXACT = {"serve_p99_train_delta"}
+
 
 def classify(key: str) -> str:
     """'throughput' (higher is better, gated), 'p99' (lower is better,
     gated), or 'info' (reported, never gates)."""
     if key in _INFO_EXACT:
         return "info"
-    if key.endswith("_p99_ms"):
+    if key.endswith("_p99_ms") or key in _P99_EXACT:
         return "p99"
     if (
         key == "value"

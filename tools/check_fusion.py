@@ -53,6 +53,12 @@ if _REPO_ROOT not in sys.path:
 
 MAX_DOTS_PER_SCAN_STEP = 2
 
+# the stacked TRAIN step's grad jaxpr gets one extra dot of budget per
+# scan body: the backward of a 1-dot recurrence is 2 dots (dL/dh through
+# wh^T + the dL/dwh accumulation), and the forward replay body keeps its
+# 1 — measured 1/2 for the LSTM/GRU families at ISSUE 13 time
+MAX_DOTS_PER_TRAIN_SCAN_STEP = 3
+
 # family → config overrides small enough to trace instantly; every entry
 # must exist in MODEL_REGISTRY with a score_stacked contract
 REGISTRY: Dict[str, dict] = {
@@ -60,6 +66,14 @@ REGISTRY: Dict[str, dict] = {
     "deepar": {"hidden": 8},
     "transformer": {"context": 8, "dim": 16, "depth": 1, "heads": 2},
 }
+
+# the continual-learning train lane's registry: every entry must also
+# carry a loss_stacked contract — its masked-mean GRADIENT is traced at
+# S=2 and S=4 with the same invariants (bounded scan-body dots, slot-
+# count-invariant total, zero collectives): a refactor that resurrects
+# the per-slot vmap in the backward pass would silently hand the MXU S
+# small matmul chains per train step again.
+TRAIN_REGISTRY: Dict[str, dict] = dict(REGISTRY)
 
 # media decode kernels (ops/dct.py): the compressed-wire ViT leg fuses
 # JPEG reconstruction into the classifier jit. Traced at B=2 and B=4
@@ -262,6 +276,113 @@ def lint_dct(registry: Optional[Dict[str, Tuple[int, int]]] = None) -> List[str]
     return findings
 
 
+def _trace_train_counts(
+    family: str, overrides: dict, n_slots: int
+) -> Tuple[int, List[Tuple[int, int]], List[str]]:
+    """(total dots, per-scan-body (dots, degenerate), collective names)
+    for the GRADIENT of one family's masked stacked train loss traced at
+    ``n_slots`` — the exact loss shape ``parallel.sharded``'s fused
+    train step differentiates (minus the data-axis psum, the sanctioned
+    exception that never appears in the per-shard grad program)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.models import get_model, make_config
+
+    spec = get_model(family)
+    cfg = make_config(family, {**overrides, "window": _W})
+    params = spec.init(jax.random.PRNGKey(0), cfg)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (n_slots,) + x.shape), params
+    )
+    wins = jnp.zeros((n_slots, _B, _W), jnp.float32)
+    mask = jnp.ones((n_slots, _B), jnp.float32)
+
+    def masked_loss(p):
+        per_row = spec.loss_stacked(p, cfg, wins)
+        num = (per_row * mask).sum(-1)
+        den = jnp.maximum(mask.sum(-1), 1.0)
+        return (num / den).sum()
+
+    closed = jax.make_jaxpr(jax.grad(masked_loss))(stacked)
+    jaxpr = closed.jaxpr
+    return (
+        _count_dots(jaxpr),
+        [
+            (_count_dots(b), _degenerate_contractions(b))
+            for b in _scan_bodies(jaxpr)
+        ],
+        collective_eqns(jaxpr),
+    )
+
+
+def lint_train_fusion(
+    registry: Optional[Dict[str, dict]] = None
+) -> List[str]:
+    """Trace every registered train-lane gradient; returns findings
+    (empty = clean)."""
+    from sitewhere_tpu.models import MODEL_REGISTRY
+
+    findings: List[str] = []
+    for family, overrides in (registry or TRAIN_REGISTRY).items():
+        spec = MODEL_REGISTRY.get(family)
+        if spec is None:
+            findings.append(
+                f"{family}: registered family not in MODEL_REGISTRY — "
+                "stale check_fusion TRAIN_REGISTRY"
+            )
+            continue
+        if getattr(spec, "loss_stacked", None) is None:
+            findings.append(
+                f"{family}: no loss_stacked contract — stale "
+                "TRAIN_REGISTRY (or the train-lane entry point was "
+                "dropped without updating the lint)"
+            )
+            continue
+        if _opted_out(spec.loss_stacked):
+            continue
+        try:
+            total2, bodies2, coll2 = _trace_train_counts(
+                family, overrides, 2
+            )
+            total4, _b4, coll4 = _trace_train_counts(family, overrides, 4)
+        except Exception as exc:  # noqa: BLE001 - a trace failure is a finding
+            findings.append(
+                f"{family}: stacked train grad failed to trace: {exc!r}"
+            )
+            continue
+        if coll2 or coll4:
+            findings.append(
+                f"{family}: stacked train grad contains collective "
+                f"primitive(s) {sorted(set(coll2 + coll4))} — the per-"
+                "shard grad program must stay collective-free (the one "
+                "data-axis psum lives in the shard_map wrapper, not here)"
+            )
+        for i, (n, deg) in enumerate(bodies2):
+            if n > MAX_DOTS_PER_TRAIN_SCAN_STEP:
+                findings.append(
+                    f"{family}: train grad scan body {i} lowers to {n} "
+                    f"dot_generals per step "
+                    f"(> {MAX_DOTS_PER_TRAIN_SCAN_STEP}) — the slot axis "
+                    "leaked out of a backward contraction (per-slot "
+                    "resurrection in the gradient)"
+                )
+            if deg:
+                findings.append(
+                    f"{family}: train grad scan body {i} has {deg} "
+                    "dot_general(s) with a size-1 contracting dim — an "
+                    "outer product dressed as a matmul in the backward "
+                    "pass"
+                )
+        if total2 != total4:
+            findings.append(
+                f"{family}: train grad dot_general count scales with "
+                f"stacked slots ({total2} at S=2 vs {total4} at S=4) — "
+                "a per-slot loop is unrolling the backward pass"
+            )
+    return findings
+
+
 def lint_fusion(registry: Optional[Dict[str, dict]] = None) -> List[str]:
     """Trace every registered fused entry point; returns findings
     (empty = clean)."""
@@ -318,11 +439,12 @@ def lint_fusion(registry: Optional[Dict[str, dict]] = None) -> List[str]:
 
 
 def main() -> int:
-    findings = lint_fusion() + lint_dct()
+    findings = lint_fusion() + lint_train_fusion() + lint_dct()
     for f in findings:
         print(f"check_fusion: {f}", file=sys.stderr)
     print(
         f"check_fusion: {len(REGISTRY)} fused entry point(s) + "
+        f"{len(TRAIN_REGISTRY)} train grad(s) + "
         f"{len(DCT_REGISTRY)} decode variant(s), {len(findings)} finding(s)"
     )
     return 1 if findings else 0

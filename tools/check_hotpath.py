@@ -62,6 +62,14 @@ HOT_PATHS: Dict[str, List[str]] = {
         "TpuInferenceService._resolve_flush",
         "TpuInferenceService._canary_compare",
         "TpuInferenceService._deliver_gauge",
+        # the continual-learning train lane: feed intake + microbatch
+        # packing + the per-pass lane tick all run at full ingest /
+        # loop rate — rows must stay columnar, and the loss device
+        # array must resolve via the reaper, never a blocking asarray
+        "TpuInferenceService._enqueue_train_batch",
+        "TpuInferenceService._pack_train",
+        "TpuInferenceService._train_lane_tick",
+        "TpuInferenceService._dispatch_train",
         "_LaneRing.push",
         "_LaneRing.pop_into",
         "_SliceFence.park",

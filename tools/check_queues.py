@@ -94,6 +94,17 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         # into the bus (where lag is a gauge and drives overload credit)
         "backpressure_counter": "tpu_inference.lane_backpressure",
     },
+    ("pipeline/inference.py", r"_TrainLaneRing\("): {
+        "queue": "continual-learning train lane rings (replay-fed "
+                 "training rows per (slot, data-shard); watermark "
+                 "2 × replay_microbatch)",
+        "depth_gauge": "tpu_inference_train_rows",
+        # the lane never sheds admitted rows: past the watermark the
+        # feed CONSUMER parks (counted) and the backlog stays in the bus
+        # topic, which the replay pump's overload arbitration already
+        # throttles at the producer side
+        "backpressure_counter": "tpu_inference.train_feed_backpressure",
+    },
     ("pipeline/replay.py", r"_ReplayRing\("): {
         "queue": "replay intake ring (prepared scan slices between the "
                  "segment scanner and the publish pump)",
@@ -132,7 +143,8 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
     r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\(|= _ReplayRing\("
-    r"|= _ByteRing\(|ThreadPoolExecutor\(|\[_StagingSet\()"
+    r"|= _ByteRing\(|= _TrainLaneRing\(|ThreadPoolExecutor\("
+    r"|\[_StagingSet\()"
 )
 
 
