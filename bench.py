@@ -656,6 +656,15 @@ def result_path_stats(metrics) -> dict:
         "deliver_backpressure": metrics.counter(
             "tpu_inference.deliver_backpressure"
         ).value,
+        # flush-supervisor activity during the run: any non-zero value
+        # means deadlines force-resolved flushes (a wedged/slow device
+        # mid-bench — the throughput row is then suspect evidence)
+        "flush_timeouts": sum(
+            v for v in metrics.snapshot_families(
+                ("tpu_flush_timeout_total",)
+            ).values()
+            if isinstance(v, (int, float))
+        ),
     }
 
 

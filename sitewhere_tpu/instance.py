@@ -885,9 +885,13 @@ class SiteWhereInstance(LifecycleComponent):
                 )
             await self.add_tenant(cfg)
         # relaunch replay jobs a crash interrupted: cursors committed
-        # after each published batch, so resume is exactly-once
+        # after each published batch, so resume is exactly-once; with
+        # replay_recover_unscored, a HARD-killed rescore job (file still
+        # says "running") also rewinds to re-cover the published-but-
+        # unscored NaN window its crash left (docs/STORAGE.md "Replay")
         self.replay.resume_jobs(
-            {t: rt.event_store for t, rt in self.tenants.items()}
+            {t: rt.event_store for t, rt in self.tenants.items()},
+            recover_unscored=self.config.replay_recover_unscored,
         )
         return len(manifest)
 

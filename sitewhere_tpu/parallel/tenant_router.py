@@ -49,6 +49,32 @@ class TenantRouter:
         self._placements: Dict[str, TenantPlacement] = {}
         # family → shard → set of used slots
         self._used: Dict[str, List[Set[int]]] = {}
+        # family → shards under quarantine (the flush supervisor's
+        # SUSPECT verdict): place/failover/rebalance route around them
+        # until probation re-admits the slice (docs/ROBUSTNESS.md
+        # "Device fault domains")
+        self._quarantined: Dict[str, Set[int]] = {}
+
+    # -- quarantine (fault-domain supervision) ---------------------------
+    def quarantine(self, family: str, shard: int) -> None:
+        """Mark one (family, shard) SUSPECT: no new placements, no
+        failover landings, no rebalance receivers until ``readmit``."""
+        self._quarantined.setdefault(family, set()).add(shard)
+
+    def readmit(self, family: str, shard: int) -> None:
+        """Probation passed (or an operator lifecycle event): the shard
+        serves the family again."""
+        q = self._quarantined.get(family)
+        if q is not None:
+            q.discard(shard)
+            if not q:
+                del self._quarantined[family]
+
+    def quarantined(self, family: str) -> Set[int]:
+        return set(self._quarantined.get(family, ()))
+
+    def _avoided(self, family: str) -> Set[int]:
+        return self._quarantined.get(family, set())
 
     # -- capacity --------------------------------------------------------
     @property
@@ -80,7 +106,15 @@ class TenantRouter:
         used = self._used.setdefault(
             family, [set() for _ in range(self.n_shards)]
         )
-        order = sorted(range(self.n_shards), key=lambda s: (len(used[s]), s))
+        avoid = self._avoided(family)
+        # quarantined shards sort last (never skipped entirely: a fleet
+        # with EVERY shard quarantined still places — degraded beats
+        # unplaceable, and the serving layer passes the slice's events
+        # through unscored until probation heals it)
+        order = sorted(
+            range(self.n_shards),
+            key=lambda s: (s in avoid, len(used[s]), s),
+        )
         if prefer_shard is not None:
             order = [prefer_shard] + [s for s in order if s != prefer_shard]
         for shard in order:
@@ -110,8 +144,18 @@ class TenantRouter:
         if old is None:
             raise PlacementError(f"tenant '{tenant}' is not placed")
         used = self._used[old.family]
+        avoid = self._avoided(old.family)
+        # a failover must LAND somewhere healthy — quarantined shards
+        # are excluded outright (moving a tenant from one sick slice to
+        # another is churn, not healing; with no healthy capacity the
+        # PlacementError below leaves the tenant in place, where the
+        # quarantined slice degrades it to unscored pass-through until
+        # probation re-admits)
         candidates = sorted(
-            (s for s in range(self.n_shards) if s != old.shard),
+            (
+                s for s in range(self.n_shards)
+                if s != old.shard and s not in avoid
+            ),
             key=lambda s: (len(used[s]), s),
         )
         for shard in candidates:
@@ -148,10 +192,18 @@ class TenantRouter:
             used = self._used.get(fam)
             if used is None:
                 continue
+            avoid = self._avoided(fam)
+            healthy = [s for s in range(self.n_shards) if s not in avoid]
+            if len(healthy) < 2:
+                continue  # nowhere to balance between
             while True:
                 load = [len(s) for s in used]
-                donor = max(range(self.n_shards), key=lambda s: (load[s], s))
-                recv = min(range(self.n_shards), key=lambda s: (load[s], s))
+                # quarantined shards neither donate (their tenants are
+                # the supervisor's job, moved through failover) nor
+                # receive (no landings while SUSPECT) — readmission is
+                # what triggers the rebalance-back
+                donor = max(healthy, key=lambda s: (load[s], s))
+                recv = min(healthy, key=lambda s: (load[s], s))
                 if load[donor] - load[recv] <= 1:
                     break
                 tenant = min(self.tenants_on(donor, fam))
@@ -175,6 +227,11 @@ class TenantRouter:
         return {
             "n_shards": self.n_shards,
             "slots_per_shard": self.slots_per_shard,
+            "quarantined": {
+                fam: sorted(shards)
+                for fam, shards in sorted(self._quarantined.items())
+                if shards
+            },
             "placements": {
                 t: {"family": p.family, "shard": p.shard, "slot": p.slot}
                 for t, p in sorted(self._placements.items())

@@ -83,7 +83,10 @@ from sitewhere_tpu.runtime.bus import (
     EventBus,
     publish_at_least_once,
 )
-from sitewhere_tpu.runtime.config import TenantEngineConfig
+from sitewhere_tpu.runtime.config import (
+    FaultTolerancePolicy,
+    TenantEngineConfig,
+)
 from sitewhere_tpu.runtime.lifecycle import (
     LifecycleState,
     SupervisedTask,
@@ -92,6 +95,7 @@ from sitewhere_tpu.runtime.lifecycle import (
 from sitewhere_tpu.runtime.metrics import (
     D2H_OVERLAP_EPS_S as _D2H_OVERLAP_EPS_S,
     MetricsRegistry,
+    RollingQuantile,
 )
 from sitewhere_tpu.runtime.tenant import MultitenantService, TenantEngine
 
@@ -357,7 +361,8 @@ class _PendingFlush:
         "family", "sl", "scores", "taken", "moved", "gathered",
         "t_dispatch", "nbytes", "plane_nbytes", "host_future", "t_wait",
         "poisoned", "flops", "rec", "sketch", "shadow", "slot_override",
-        "resolved", "lane",
+        "resolved", "lane", "deadline", "retried", "retry_rows",
+        "retry_from", "owns_permit",
     )
 
     def __init__(
@@ -412,10 +417,45 @@ class _PendingFlush:
         # publishing batches). One FIFO per (family, slice) keeps the
         # permit accounting and teardown drain uniform across lanes.
         self.lane = lane
+        # flush supervision (docs/ROBUSTNESS.md "Device fault domains"):
+        # the absolute perf_counter() moment by which this flush's
+        # transfer must have landed — past it the reaper force-resolves
+        # the rows unscored in this FIFO slot and quarantines the slice.
+        # None = unsupervised (flush_deadline_ms knob off, or poisoned
+        # entries that land immediately by construction).
+        self.deadline: Optional[float] = None
+        # poison-batch ejection: this pf IS the one-shot retry of a
+        # faulted flush's rows (``retry_from`` = the slice the FIRST
+        # failure happened on) — a second failure on a DIFFERENT slice
+        # attributes the fault to the data and ships the batches to the
+        # scorer-poison DLQ; a second failure on the SAME chip stays a
+        # chip signal (unscored resolve + breaker/failover pacing)
+        self.retried = False
+        self.retry_from: Optional[int] = None
+        # host copies of the staged (ids, vals, dshards) rows, kept so a
+        # TIMED-OUT flush can retry with the same bytes (the staging set
+        # recycles long before a deadline expires); populated only while
+        # the family's poison_retry knob is on
+        self.retry_rows: Optional[tuple] = None
+        # False for ORDERED host-only entries enqueued from inside a
+        # resolve task (per-tenant FIFO fallbacks of the poison-retry
+        # path): acquiring a permit there can deadlock against the very
+        # head whose resolution is enqueueing them, and a host-only
+        # poisoned entry holds no device resources for the in-flight
+        # window to meter — the resolve/teardown release sites skip it
+        self.owns_permit = True
 
     @property
     def key(self) -> Tuple[str, int]:
         return (self.family, self.sl)
+
+    def overdue(self, now: Optional[float] = None) -> bool:
+        """Deadline passed without resolution — the supervisor's
+        force-resolve trigger (poisoned entries land instantly and are
+        never overdue)."""
+        if self.deadline is None or self.poisoned:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
 
     def _materialize(self):
         """Worker-thread materialization of every device output riding
@@ -647,6 +687,10 @@ class TpuInferenceEngine(TenantEngine):
             if k[0] == self.config.model
         ]:
             breaker.reset()
+        # ...and the quarantine ledger: an explicit engine (re)start is
+        # the operator's heal signal, the same contract as the breaker
+        # resets above — probation probes are for UNATTENDED recovery
+        svc.clear_quarantine(self.config.model)
 
     async def on_stop(self) -> None:
         svc = self.service
@@ -932,6 +976,36 @@ class TpuInferenceService(MultitenantService):
         # teardown grace for in-flight transfers before they force-resolve
         # unscored (a dead device must not hang the stop cascade)
         self.deliver_drain_timeout_s = 10.0
+        # -- fault-domain supervision (docs/ROBUSTNESS.md) ---------------
+        # injectable device faults (runtime.faultplan — the chaos layer;
+        # None in production). Consulted at every dispatch: serve, train,
+        # shadow, and probation-probe lanes.
+        self.faultplan = None
+        # per-(family, slice) dispatch→transfer-landed history: the
+        # flush deadline is max(flush_deadline_ms, flush_deadline_x ×
+        # this window's p99) — the same samples the flightrec flush
+        # records carry as device_s
+        self._flush_p99: Dict[Tuple[str, int], RollingQuantile] = {}
+        # quarantined (family, slice)s: SUSPECT after a flush timeout or
+        # the failover escalation; the router routes around them, their
+        # lanes drain unscored (degraded, never lost), and a background
+        # probe re-admits after probation_probes consecutive landings
+        self._quarantined: Dict[Tuple[str, int], dict] = {}
+        self._probing: Dict[Tuple[str, int], asyncio.Task] = {}
+        # poison-batch ejection: batch seqs already granted their one
+        # retry — a second failure ships them to the scorer-poison DLQ
+        self._retried_seqs: set = set()
+        self.metrics.describe(
+            "tpu_flush_timeout_total",
+            "in-flight flushes force-resolved unscored because their "
+            "completion deadline expired, per family and mesh slice — "
+            "the flush supervisor's wedged-device signal",
+        )
+        self.metrics.describe(
+            "tpu_inference_quarantined_slices",
+            "(family, slice) scorers currently quarantined (SUSPECT) "
+            "and under probation probing",
+        )
 
     @property
     def group(self) -> str:
@@ -942,6 +1016,36 @@ class TpuInferenceService(MultitenantService):
         if sem is None:
             sem = self._inflight[key] = asyncio.Semaphore(self.max_inflight)
         return sem
+
+    # -- flush supervision -------------------------------------------------
+    def _family_ft(self, family: str) -> FaultTolerancePolicy:
+        """The family-pinned FaultTolerancePolicy (first tenant wins,
+        like every other family knob)."""
+        pin = self._family_cfg.get(family)
+        return pin.fault_tolerance if pin is not None else (
+            FaultTolerancePolicy()
+        )
+
+    def _flush_deadline_s(self, family: str, sl: int) -> Optional[float]:
+        """Seconds a newly dispatched flush gets before the supervisor
+        force-resolves it: max(floor, x × the (family, slice)'s observed
+        dispatch→landed p99). None = supervision off for the family
+        (``flush_deadline_ms = 0`` — the rollback knob)."""
+        ft = self._family_ft(family)
+        floor = ft.flush_deadline_ms / 1000.0
+        if floor <= 0:
+            return None
+        rq = self._flush_p99.get((family, sl))
+        p99 = rq.quantile() if rq is not None else None
+        if p99 is None:
+            return floor
+        return max(floor, ft.flush_deadline_x * p99)
+
+    def _note_device_s(self, key: Tuple[str, int], device_s: float) -> None:
+        rq = self._flush_p99.get(key)
+        if rq is None:
+            rq = self._flush_p99[key] = RollingQuantile()
+        rq.add(device_s)
 
     def _make_engine(self, cfg: TenantEngineConfig) -> TpuInferenceEngine:
         return TpuInferenceEngine(cfg, self)
@@ -1116,17 +1220,31 @@ class TpuInferenceService(MultitenantService):
                 pass
         self._resolving.clear()
         # force-resolve anything still stuck, unscored (zero loss even
-        # when a transfer never completes)
+        # when a transfer never completes) — the SAME accounting helper
+        # the supervisor's mid-run deadline path uses, so teardown and
+        # in-flight force-resolution cannot diverge
         for q in self._reap.values():
             while q:
                 pf = q.popleft()
-                _s, _c, seqs, rows = pf.taken
-                await self._resolve_rows(
-                    seqs, rows, None, publish_nowait=True, family=pf.family
-                )
+                await self._force_resolve(pf, nowait=True)
                 pf.resolved = True
-                self._inflight_sem(pf.key).release()
+                if pf.owns_permit:
+                    self._inflight_sem(pf.key).release()
         self._deliver_gauge()
+        # probation probes die with the service; a hung chaos plan must
+        # release its blocked worker threads or the deliver pool's
+        # shutdown below strands them past interpreter exit
+        for task in list(self._probing.values()):
+            task.cancel()
+        self._probing.clear()
+        if self.faultplan is not None:
+            self.faultplan.clear()
+        pool = getattr(self, "_probe_pool", None)
+        if pool is not None:
+            # wait=False: a probe thread parked inside a wedged chip's
+            # materialization must not hang the stop cascade
+            pool.shutdown(wait=False)
+            self._probe_pool = None
         # final sweep: rows can land in lanes (or slice-move fences)
         # AFTER their engine's own stop-drain (the scoring loop keeps
         # consuming during the stop cascade) — resolve them unscored so
@@ -1362,6 +1480,8 @@ class TpuInferenceService(MultitenantService):
 
     async def _publish_batch(self, seq: int, nowait: bool = False) -> None:
         batch, _ = self._batches.pop(seq)
+        # a retried batch that made it out scored is no longer suspect
+        self._retried_seqs.discard(seq)
         # inference span: start = lane enqueue, queue wait = bus time since
         # the inbound stage published; annotations carry the family's last
         # flush profile (dispatch time, whether it compiled a new shape)
@@ -1458,9 +1578,16 @@ class TpuInferenceService(MultitenantService):
         pool, no shared completion stream."""
         scorer = self.scorers[(family, sl)]
         lanes = self._lanes[(family, sl)]
-        if family in self._parked:
+        if family in self._parked or (family, sl) in self._quarantined:
             # degraded mode: resolve pending rows unscored so events keep
-            # flowing to persistence/rules while the scorer is parked
+            # flowing to persistence/rules while the scorer is parked —
+            # or while THIS slice is quarantined and its tenants could
+            # not fail over (fleet at capacity): the slice passes its
+            # events through unscored until probation re-admits it
+            if family not in self._parked:
+                self.metrics.counter(
+                    "tpu_inference.quarantine_passthrough"
+                ).inc()
             drained = 0
             for key in list(lanes):
                 lane = lanes.pop(key)
@@ -1621,6 +1748,14 @@ class TpuInferenceService(MultitenantService):
                     shadow_dev = scorer.gather_rows(
                         shadow_plane, staged[2], moved
                     )
+                    if self.faultplan is not None:
+                        # chaos: the shadow lane is a fault domain too —
+                        # a hung shadow transfer blocks the flush's
+                        # materialization triple, and the same deadline
+                        # must catch it
+                        shadow_dev = self.faultplan.wrap(
+                            shadow_dev, family, sl, "shadow"
+                        )
                     shadow_dev.copy_to_host_async()
                     self.metrics.counter("tpu_inference.canary_flushes").inc()
                     self.metrics.counter(
@@ -1630,6 +1765,11 @@ class TpuInferenceService(MultitenantService):
                     # advisory: it must never take scoring down with it
                     self._record_error("canary", exc)
                     shadow_dev = None
+            if self.faultplan is not None:
+                # fail_dispatch injection (the poison-batch scenario) —
+                # raises through the fault path below like a real
+                # kernel crash on this batch's data
+                self.faultplan.maybe_raise(family, sl, "serve")
             t_disp = time.perf_counter()
             with _profiler_annotation(self.profile_annotations, family):
                 scores_dev = scorer.step_counts(*staged)  # async dispatch
@@ -1732,6 +1872,13 @@ class TpuInferenceService(MultitenantService):
                 scores_dev = scores_dev[np.full((1,), only, np.int32)]
                 slots_cat[:] = 0  # rows now index row 0 of the slice
                 slot_override = only  # keep NaN attribution honest
+            if self.faultplan is not None:
+                # hang/corrupt/slow/late-fail injection: the proxy
+                # applies the fault exactly where the reaper's executor
+                # materialization touches a real wedged device
+                scores_dev = self.faultplan.wrap(
+                    scores_dev, family, sl, "serve"
+                )
             # overlap probe for the NEXT flush — now holds the gathered
             # rows (a few KB), not a full flush of plane memory; the
             # reaper drops it when the family goes idle
@@ -1782,15 +1929,33 @@ class TpuInferenceService(MultitenantService):
                         trace_id=self._flush_trace_id(seqs_cat),
                         status="error", error=repr(exc),
                     )
-            # resolve the rows unscored THROUGH the reap FIFO, not
-            # inline: an earlier flush of this family may still be in
-            # flight, and publishing these batches first would hand a
-            # tenant its later batch before its earlier one. The permit
-            # stays held until the reaper resolves the entry.
-            self._reap_enqueue(_PendingFlush(
-                family, None, taken, moved, False, 0, 0, poisoned=True,
-                rec=err_rec, sl=sl,
-            ))
+            # poison-batch ejection, first strike: the staging set is
+            # still intact in this synchronous handler, so the staged
+            # bytes can be copied for ONE retry — a transient chip fault
+            # recovers the rows scored; a deterministic data fault fails
+            # again and ships the batch to the scorer-poison DLQ instead
+            # of burning more breaker/failover capacity on it.
+            ft = self._family_ft(family)
+            retry_rows = None
+            if (
+                ft.poison_retry
+                and moved > 0
+                and not self._seqs_already_retried(seqs_cat)
+            ):
+                retry_rows = self._copy_retry_rows(
+                    st, slots_cat, cols_cat, b_lane
+                )
+            if retry_rows is None:
+                # resolve the rows unscored THROUGH the reap FIFO, not
+                # inline: an earlier flush of this family may still be
+                # in flight, and publishing these batches first would
+                # hand a tenant its later batch before its earlier one.
+                # The permit stays held until the reaper resolves the
+                # entry.
+                self._reap_enqueue(_PendingFlush(
+                    family, None, taken, moved, False, 0, 0, poisoned=True,
+                    rec=err_rec, sl=sl,
+                ))
             if (
                 self.flightrec is not None
                 and breaker is not None
@@ -1804,6 +1969,17 @@ class TpuInferenceService(MultitenantService):
                     trace_id=err_rec.get("trace_id") if err_rec else None,
                 )
             await self._note_scorer_error(family, sl)
+            if retry_rows is not None:
+                # the rows leave through the retry dispatch's OWN permit
+                # (possibly on another slice) — this flush's permit goes
+                # back now, not via a pf resolution
+                sem.release()
+                # AFTER the failover pacing above: if the fault also
+                # crossed the failover threshold, the retry lands on the
+                # tenants' NEW slices (where a second failure confirms
+                # the data owns the fault); below it, on the original
+                # slice (where a second failure stays a chip signal)
+                await self._retry_poison(family, sl, retry_rows, taken, exc)
             return moved
         try:
             self._train_tick(family, sl, scorer, engine_cfgs)
@@ -1819,6 +1995,18 @@ class TpuInferenceService(MultitenantService):
             rec=rec, sketch=sketch_dev, shadow=shadow_dev, sl=sl,
         )
         pf.slot_override = slot_override
+        # flush supervision: the completion deadline the reaper races
+        # (family p99-derived, floored by flush_deadline_ms; None = off)
+        dl = self._flush_deadline_s(family, sl)
+        if dl is not None:
+            pf.deadline = pf.t_dispatch + dl
+            if self._family_ft(family).poison_retry:
+                # staged-byte copies for the one-shot poison retry: a
+                # TIMED-OUT flush needs them long after the staging set
+                # recycled — the price of retry-with-identical-bytes.
+                pf.retry_rows = self._copy_retry_rows(
+                    st, slots_cat, cols_cat, b_lane
+                )
         if not hasattr(scores_dev, "copy_to_host_async"):
             # no async copy available (test doubles): materialize eagerly
             # on the pool so fallback flushes still overlap each other
@@ -1827,6 +2015,30 @@ class TpuInferenceService(MultitenantService):
             )
         self._reap_enqueue(pf)
         return moved
+
+    @staticmethod
+    def _copy_retry_rows(
+        st, slots_cat: np.ndarray, cols_cat: np.ndarray, b_lane: int
+    ) -> tuple:
+        """Staged-byte copies for the one-shot poison retry (~6 B/row,
+        two vectorized gathers). BOTH capture sites — the dispatch-fault
+        handler and the supervised healthy dispatch — go through here so
+        the retry-with-identical-bytes guarantee can't silently diverge
+        between them."""
+        return (
+            st.ids[slots_cat, cols_cat].copy(),
+            st.vals[slots_cat, cols_cat].astype(np.float32),
+            (cols_cat // b_lane).astype(np.int32),
+        )
+
+    def _seqs_already_retried(self, seqs: np.ndarray) -> bool:
+        """True when any packed batch already spent its ONE poison
+        retry — every retry-granting site must consult this, or a batch
+        whose rows span multiple flushes gets a retry per flush."""
+        return any(
+            int(s) in self._retried_seqs
+            for s in np.unique(seqs).tolist()
+        )
 
     def _flush_trace_id(self, seqs_cat: np.ndarray) -> Optional[str]:
         """The first packed batch's trace id — links a flight-recorder
@@ -1850,6 +2062,350 @@ class TpuInferenceService(MultitenantService):
         q.append(pf)
         self._deliver_gauge()
         self._reap_event.set()
+
+    # -- poison-batch ejection ---------------------------------------------
+    def _tenants_in_flight(
+        self, family: str, sl: int, exclude: Optional[_PendingFlush]
+    ) -> set:
+        """Tenants with unresolved serve flushes queued on (family,
+        slice) — the poison-retry FIFO guard reads this: a cross-slice
+        retry for such a tenant could overtake (or be overtaken by) its
+        other in-flight batches, so its rows take an ORDERED fallback
+        instead."""
+        out: set = set()
+        for p in self._reap.get((family, sl), ()):
+            if p is exclude or p.resolved or p.lane != "serve":
+                continue
+            for s in np.unique(p.taken[2]).tolist():
+                entry = self._batches.get(int(s))
+                if entry is not None:
+                    out.add(entry[0].tenant)
+        return out
+
+    def _enqueue_ordered_unscored(
+        self, family: str, sl: int, taken_sel: tuple
+    ) -> None:
+        """Append one host-only poisoned entry at a slice's FIFO tail
+        WITHOUT a permit (``owns_permit=False``): the ordered fallback
+        when rows must resolve after that queue's in-flight flushes but
+        the caller may BE that queue's resolve task — acquiring there
+        deadlocks against the head it is resolving."""
+        pf = _PendingFlush(
+            family, None, taken_sel, int(len(taken_sel[2])), False, 0, 0,
+            poisoned=True, sl=sl,
+        )
+        pf.owns_permit = False
+        self._reap_enqueue(pf)
+
+    async def _retry_poison(
+        self, family: str, sl_first: int, retry_rows: tuple, taken: tuple,
+        exc: BaseException, inline: bool = False,
+        exclude: Optional[_PendingFlush] = None,
+    ) -> None:
+        """First strike handled: re-dispatch the faulted flush's rows
+        ONCE with the same staged host bytes — one solo flush per
+        affected tenant, on the tenant's CURRENT placement (stream →
+        data-shard routing is placement-independent, so the bytes are
+        valid anywhere the tenant lands; after a quarantine/threshold
+        failover that IS the failover slice). A second failure on a
+        DIFFERENT slice than ``sl_first`` means two chips agreed — the
+        data owns the fault and the batches ship to the DLQ
+        (``_eject_poison``); a second failure on the SAME chip stays
+        chip-attributed (unscored resolve + failover pacing).
+
+        ``inline=True`` marks the resolve-task callers (deadline
+        timeout / deliver fault of the queue HEAD, passed as
+        ``exclude``): ordered fallbacks there resolve rows directly —
+        resolves are sequential per (family, slice), so the head's own
+        task runs before every queued entry — and never await a permit
+        on the first slice (the head still holds one; waiting would
+        deadlock the queue against itself).
+
+        Per-tenant FIFO guard: a tenant with OTHER unresolved serve
+        flushes on the first slice does not cross-slice retry at all —
+        its rows resolve unscored in order (inline, or an ordered
+        permit-less FIFO entry) rather than racing its own in-flight
+        batches on two slices."""
+        slots_cat, _cols_cat, seqs_cat, rows_cat = taken
+        ids_rows, vals_rows, dshards = retry_rows
+        uniq = np.unique(seqs_cat).tolist()
+        by_tenant: Dict[str, list] = {}
+        for s in uniq:
+            entry = self._batches.get(int(s))
+            if entry is not None:
+                by_tenant.setdefault(entry[0].tenant, []).append(int(s))
+        busy = self._tenants_in_flight(family, sl_first, exclude)
+        for tenant, seq_list in sorted(by_tenant.items()):
+            sel = np.isin(seqs_cat, np.asarray(seq_list, np.int64))
+            engine = self.engines.get(tenant)
+            if (
+                not isinstance(engine, TpuInferenceEngine)
+                or engine.placement is None
+            ):
+                # stopped mid-fault: no placement to retry on — resolve
+                # unscored (its bus cursor already advanced; per-tenant
+                # order is moot for a stopped tenant)
+                await self._resolve_rows(
+                    seqs_cat[sel], rows_cat[sel], None, family=family
+                )
+                continue
+            p = engine.placement
+            if (family, p.shard) in self._quarantined or tenant in busy:
+                # capacity-stranded (retrying on a known-sick slice is
+                # pointless) or FIFO-guarded (other in-flight batches
+                # of this tenant on the first slice): ordered unscored
+                # resolution instead of a retry
+                if inline:
+                    # the head's own resolve task: runs before every
+                    # queued entry by construction
+                    await self._resolve_rows(
+                        seqs_cat[sel], rows_cat[sel], None, family=family
+                    )
+                else:
+                    # FIFO guard outranks the quarantine shortcut: a
+                    # busy tenant's rows must queue behind its earlier
+                    # in-flight flushes on the FIRST slice even when
+                    # its new placement is also quarantined — p.shard's
+                    # (likely empty) queue would publish them ahead
+                    self._enqueue_ordered_unscored(
+                        family,
+                        sl_first if tenant in busy else p.shard,
+                        tuple(a[sel] for a in taken),
+                    )
+                continue
+            self._retried_seqs.update(seq_list)
+            self.metrics.counter("tpu_inference.poison_retries").inc()
+            await self._dispatch_retry(
+                engine, family, sl_first,
+                ids_rows[sel], vals_rows[sel], dshards[sel],
+                seqs_cat[sel], rows_cat[sel], exc, inline=inline,
+            )
+
+    async def _dispatch_retry(
+        self, engine: "TpuInferenceEngine", family: str, sl_first: int,
+        ids_r: np.ndarray, vals_r: np.ndarray, dsh: np.ndarray,
+        seqs: np.ndarray, rows: np.ndarray, orig_exc: BaseException,
+        inline: bool = False,
+    ) -> None:
+        """One tenant's poison-retry flush: identical bytes, the
+        tenant's current (slice, slot), the normal reap FIFO. A second
+        dispatch failure here either ejects to the DLQ (different slice
+        than the first strike — two chips agreed on the data) or stays
+        a chip fault (same slice: unscored resolve through the FIFO,
+        breaker + failover pacing — exactly what an un-retried faulted
+        flush would have done).
+
+        ``inline=True`` + a retry landing back on ``sl_first`` means
+        the caller IS that queue's resolve task with the head's permit
+        still held — the retry entry rides permit-less
+        (``owns_permit=False``) instead of awaiting a permit the head
+        may be the last holder of."""
+        p = engine.placement
+        sl2, slot2 = p.shard, p.slot
+        try:
+            scorer = self.scorers.get((family, sl2))
+            if scorer is None:
+                scorer = self.scorer_for_slice(family, sl2, engine.config)
+            mb = engine.config.microbatch
+            # stable per-dshard regrouping keeps each lane's rows in
+            # their original FIFO order (= the device gather's pack
+            # order)
+            order = np.argsort(dsh, kind="stable")
+            ids_r, vals_r, dsh = ids_r[order], vals_r[order], dsh[order]
+            seqs, rows = seqs[order], rows[order]
+            lane_counts = np.bincount(
+                dsh, minlength=self.mm.n_data_shards
+            )
+            b_lane = self._pick_bucket(
+                int(lane_counts.max()), tuple(mb.buckets), mb.max_batch
+            )
+            t, d = scorer.n_slots, self.mm.n_data_shards
+            ids_st = np.zeros((t, d * b_lane), scorer.ids_np_dtype)
+            vals_st = np.zeros((t, d * b_lane), scorer.vals_np_dtype)
+            counts = np.zeros((t, d), np.int32)
+            cols = np.empty((len(seqs),), np.int32)
+            off = 0
+            for dd in range(d):
+                k = int(lane_counts[dd])
+                if not k:
+                    continue
+                base = dd * b_lane
+                ids_st[slot2, base : base + k] = ids_r[off : off + k]
+                vals_st[slot2, base : base + k] = vals_r[off : off + k]
+                cols[off : off + k] = np.arange(
+                    base, base + k, dtype=np.int32
+                )
+                counts[slot2, dd] = k
+                off += k
+            slots2 = np.full((len(seqs),), slot2, np.int32)
+            taken2 = (slots2, cols, seqs, rows)
+        except Exception as exc2:  # noqa: BLE001 - retry infra failed
+            # BEFORE dispatch (scorer build on a degraded fleet /
+            # staging alloc): chip-attributed, never poison — and the
+            # rows must still resolve (unscored, permit-less, through
+            # the retry slice's FIFO) or the zero-loss invariant breaks
+            self._record_error("poison-retry-setup", exc2)
+            for s in np.unique(seqs).tolist():
+                self._retried_seqs.discard(int(s))
+            pf2 = _PendingFlush(
+                family, None,
+                (
+                    np.full((len(seqs),), slot2, np.int32),
+                    np.zeros((len(seqs),), np.int32), seqs, rows,
+                ),
+                len(seqs), False, 0, 0, poisoned=True, sl=sl2,
+            )
+            pf2.owns_permit = False
+            self._reap_enqueue(pf2)
+            await self._note_scorer_error(family, sl2)
+            return
+        sem = self._inflight_sem((family, sl2))
+        own_permit = not (inline and sl2 == sl_first)
+        if own_permit:
+            await sem.acquire()
+        enqueued = False
+        try:
+            stage = getattr(scorer, "stage_inputs", None)
+            staged = (
+                stage(ids_st, vals_st, counts) if stage is not None
+                else (ids_st, vals_st, counts)
+            )
+            if self.faultplan is not None:
+                # the retry carries its OWN lane so chaos plans can
+                # target the second strike deterministically (a "serve"
+                # selector would race other tenants' regular flushes on
+                # the retry slice for the fault budget)
+                self.faultplan.maybe_raise(family, sl2, "retry")
+            shape_key = (family, sl2, b_lane)
+            if shape_key not in self._seen_shapes:
+                self._seen_shapes.add(shape_key)
+                self.metrics.counter("tpu_inference.compiles").inc()
+            scores_dev = scorer.step_counts(*staged)
+            gathered = False
+            gather = getattr(scorer, "gather_rows", None)
+            if gather is not None and hasattr(scores_dev, "is_ready"):
+                scores_dev = gather(scores_dev, staged[2], len(seqs))
+                gathered = True
+            if self.faultplan is not None:
+                scores_dev = self.faultplan.wrap(
+                    scores_dev, family, sl2, "retry"
+                )
+            try:
+                scores_dev.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - test doubles
+                pass
+            rec = None
+            if self.flightrec is not None:
+                rec = self.flightrec.record(
+                    "flush", family,
+                    lane="serve", retry=True,
+                    rows=len(seqs), bucket=b_lane,
+                    mesh_slice=sl2,
+                    device_label=getattr(scorer, "device_label", "?"),
+                    trace_id=self._flush_trace_id(seqs),
+                    status="inflight",
+                )
+            pf = _PendingFlush(
+                family, scores_dev, taken2, len(seqs), gathered,
+                int(getattr(scores_dev, "nbytes", 0)), 0,
+                rec=rec, sl=sl2,
+            )
+            pf.retried = True
+            pf.retry_from = sl_first
+            pf.owns_permit = own_permit
+            if not gathered:
+                pf.slot_override = slot2
+            dl = self._flush_deadline_s(family, sl2)
+            if dl is not None:
+                pf.deadline = pf.t_dispatch + dl
+            if not hasattr(scores_dev, "copy_to_host_async"):
+                pf.ensure_host_future(
+                    asyncio.get_running_loop(), self._deliver_pool
+                )
+            self._reap_enqueue(pf)
+            enqueued = True
+        except Exception as exc2:  # noqa: BLE001 - second strike
+            self._record_error("poison-retry", exc2)
+            if self._poison_confirmed(family, sl2, sl_first):
+                # two DIFFERENT chips failed the same staged bytes: the
+                # DATA is the fault — eject the batches, keep the tenant
+                await self._eject_poison(family, seqs, exc2)
+            else:
+                # same chip twice (or the retry slice is already known-
+                # sick): a chip signal — resolve the rows unscored
+                # through the FIFO on the permit we hold, and pace
+                # breaker/failover exactly like an un-retried fault
+                for s in np.unique(seqs).tolist():
+                    self._retried_seqs.discard(int(s))
+                breaker = self.breakers.get((family, sl2))
+                if breaker is not None:
+                    breaker.record_failure()
+                pf2 = _PendingFlush(
+                    family, None, taken2, len(seqs), False, 0, 0,
+                    poisoned=True, sl=sl2,
+                )
+                pf2.owns_permit = own_permit
+                self._reap_enqueue(pf2)
+                enqueued = True  # the poisoned entry inherits the permit
+                await self._note_scorer_error(family, sl2)
+        finally:
+            if own_permit and not enqueued:
+                sem.release()
+
+    def _poison_confirmed(
+        self, family: str, sl_retry: int, sl_first: int
+    ) -> bool:
+        """Is a retry failure DATA-attributable? Only when the second
+        strike ran on a different slice than the first (two independent
+        chips) and that slice isn't itself already suspect — a parked
+        family or quarantined retry slice means the fleet, not the
+        batch, is sick."""
+        return (
+            sl_retry != sl_first
+            and family not in self._parked
+            and (family, sl_retry) not in self._quarantined
+        )
+
+    async def _eject_poison(
+        self, family: str, seqs: np.ndarray, error: BaseException
+    ) -> int:
+        """Second strike: attribute the fault to the data. Each affected
+        batch leaves the scoring pipeline for its tenant's
+        ``scorer-poison`` dead-letter topic (trace-linked, requeue-able
+        over the existing DLQ REST surface) and its registry entry is
+        popped so no later resolve can publish it — exactly-once
+        accounting moves the batch from 'store' to 'DLQ'. The tenant
+        keeps serving: no breaker outcome, no failover pacing."""
+        from sitewhere_tpu.runtime.bus import RetryingConsumer
+
+        uniq = sorted({int(s) for s in np.asarray(seqs).tolist()})
+        ejected = 0
+        consumers: Dict[str, RetryingConsumer] = {}
+        for s in uniq:
+            entry = self._batches.pop(s, None)
+            self._retried_seqs.discard(s)
+            if entry is None:
+                continue
+            batch = entry[0]
+            rc = consumers.get(batch.tenant)
+            if rc is None:
+                rc = consumers[batch.tenant] = RetryingConsumer(
+                    self.bus, batch.tenant, "scorer-poison", self.group,
+                    metrics=self.metrics, tracer=self.tracer,
+                )
+            await rc.dead_letter(
+                batch, self.bus.naming.inbound_events(batch.tenant),
+                attempts=2, error=error,
+            )
+            ejected += 1
+            self.metrics.counter("tpu_inference.poison_ejected").inc()
+            if self.flightrec is not None:
+                self.flightrec.record(
+                    "poison", family,
+                    tenant=batch.tenant, seq=s, rows=batch.n,
+                    error=repr(error),
+                )
+        return ejected
 
     # -- auto-failover ----------------------------------------------------
     async def _note_scorer_error(self, family: str, sl: int = 0) -> None:
@@ -1892,6 +2448,44 @@ class TpuInferenceService(MultitenantService):
                 }
             except Exception as exc:  # noqa: BLE001 - device may be gone
                 self._record_error("rebuild", exc)
+        # SUSPECT: quarantine the slice (router avoids it, tenants fail
+        # over off it, probation probes re-admit it once it heals) —
+        # failed-over tenants RETURN to a healed slice instead of the
+        # pre-supervision one-way door
+        await self._quarantine_slice(family, sl, reason="scorer-errors")
+
+    # -- quarantine & probation (slice re-adoption) ------------------------
+    async def _quarantine_slice(
+        self, family: str, sl: int, reason: str
+    ) -> None:
+        """Mark one (family, mesh-slice) SUSPECT: the router routes
+        around it, its tenants fail over to healthy slices (those that
+        can't — fleet at capacity — degrade to unscored pass-through on
+        the quarantined slice), and a background probe re-dispatches
+        synthetic flushes until ``probation_probes`` consecutive
+        landings re-admit it. Idempotent per (family, slice)."""
+        key = (family, sl)
+        if key in self._quarantined:
+            return
+        ft = self._family_ft(family)
+        self._quarantined[key] = {
+            "reason": reason,
+            "since_ms": time.time() * 1000.0,
+            "ok_probes": 0,
+            "next_probe": time.monotonic() + ft.probe_interval_s,
+        }
+        self.metrics.counter("tpu_inference.quarantined").inc()
+        self.metrics.gauge("tpu_inference_quarantined_slices").set(
+            len(self._quarantined)
+        )
+        self.router.quarantine(family, sl)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "quarantine", family,
+                event="quarantine", mesh_slice=sl, reason=reason,
+            )
+        moved = 0
+        stranded = 0
         for tenant, engine in list(self.engines.items()):
             if (
                 isinstance(engine, TpuInferenceEngine)
@@ -1899,7 +2493,209 @@ class TpuInferenceService(MultitenantService):
                 and engine.config.model == family
                 and engine.placement.shard == sl
             ):
-                await self._failover_tenant(engine)
+                if await self._failover_tenant(engine):
+                    moved += 1
+                else:
+                    stranded += 1
+        if stranded and not moved:
+            healthy = [
+                s2 for s2 in range(self.router.n_shards)
+                if (family, s2) in self.scorers
+                and s2 not in self.router.quarantined(family)
+            ]
+            if not healthy:
+                # every serving slice of the family is quarantined and
+                # no tenant could move: that IS the park condition —
+                # events pass through unscored family-wide, and either
+                # probation (slice heals) or a tenant lifecycle event
+                # (operator) unparks
+                self._parked.add(family)
+                self._record_error(
+                    "park", RuntimeError(
+                        f"family '{family}' parked: every serving slice "
+                        f"quarantined and no failover capacity"
+                    ),
+                )
+                self.metrics.counter("tpu_inference.parked").inc()
+
+    def clear_quarantine(self, family: str) -> int:
+        """Re-admit every quarantined slice of ``family`` without
+        probation — the operator-lifecycle escape hatch (engine
+        (re)start), mirroring the breaker resets it rides beside."""
+        n = 0
+        for key in [k for k in self._quarantined if k[0] == family]:
+            self._quarantined.pop(key, None)
+            self.router.readmit(family, key[1])
+            task = self._probing.pop(key, None)
+            if task is not None:
+                task.cancel()
+            n += 1
+        if n:
+            self.metrics.gauge("tpu_inference_quarantined_slices").set(
+                len(self._quarantined)
+            )
+        return n
+
+    def _probe_quarantined(self) -> None:
+        """Scoring-loop tick: launch (at most one per slice) probation
+        probes for quarantined slices whose probe interval elapsed.
+        Probes defer while live traffic is under overload pressure —
+        recovery bookkeeping never contends with shedding traffic."""
+        if not self._quarantined:
+            return
+        now = time.monotonic()
+        for key, qs in list(self._quarantined.items()):
+            if key in self._probing or now < qs["next_probe"]:
+                continue
+            if self.overload is not None and self.overload.any_pressure():
+                qs["next_probe"] = now + self._family_ft(
+                    key[0]
+                ).probe_interval_s
+                continue
+            task = asyncio.get_running_loop().create_task(
+                self._probe_slice(key)
+            )
+            self._probing[key] = task
+
+            def _done(t: asyncio.Task, k=key) -> None:
+                if self._probing.get(k) is t:
+                    del self._probing[k]
+                if not t.cancelled() and t.exception() is not None:
+                    self._record_error("probe", t.exception())
+
+            task.add_done_callback(_done)
+
+    async def _probe_slice(self, key: Tuple[str, int]) -> None:
+        """One probation probe: a synthetic prewarmed-shape flush on the
+        quarantined slice, supervised by its own deadline. N consecutive
+        landings re-admit the slice; any failure restarts the count."""
+        family, sl = key
+        ft = self._family_ft(family)
+        scorer = self.scorers.get(key)
+        ok = False
+        if scorer is not None:
+            try:
+                ok = await self._dispatch_probe(scorer, family, sl)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - a probe fault IS
+                # the verdict, never a crash
+                self._record_error("probe", exc)
+                ok = False
+        qs = self._quarantined.get(key)
+        if qs is None:
+            return  # re-admitted/cleared while the probe was in flight
+        if ok:
+            qs["ok_probes"] += 1
+            self.metrics.counter("tpu_inference.probe_flushes").inc()
+            if qs["ok_probes"] >= max(1, ft.probation_probes):
+                await self._readmit_slice(family, sl)
+                return
+        else:
+            qs["ok_probes"] = 0
+            self.metrics.counter("tpu_inference.probe_failures").inc()
+        qs["next_probe"] = time.monotonic() + ft.probe_interval_s
+
+    def _probe_executor(self):
+        """The dedicated single-thread probe pool. Probes materialize
+        against a possibly GENUINELY wedged chip — a blocked np.asarray
+        there never returns, and running it on the shared deliver pool
+        would leak one worker per timed-out probe until the pool
+        starved HEALTHY slices' deliveries (the fleet-wide wedge this
+        layer exists to prevent). One dedicated thread bounds the
+        damage: a stuck probe blocks only later probes, which queue
+        behind it and time out as failures."""
+        pool = getattr(self, "_probe_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._probe_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-probe"
+            )
+        return pool
+
+    async def _dispatch_probe(
+        self, scorer, family: str, sl: int
+    ) -> bool:
+        """Run one zero-row synthetic flush through the REAL wire
+        (staging → step → gather → materialization) with its own
+        deadline, entirely ON the probe thread — a quarantined slice's
+        jit cache may have been wiped by the failover rebuild, and the
+        recompile (tens of seconds on a real chip) must stall the probe
+        thread, never the scoring loop. Zero counts leave window state
+        untouched (scatter mode=drop — the prewarm contract), so
+        probing a quarantined slice cannot corrupt anything a returning
+        tenant would see."""
+        import numpy as _np
+
+        t, d = scorer.n_slots, scorer.mm.n_data_shards
+        # smallest shape the slice already compiled; a wiped cache
+        # (failover rebuild) recompiles on the probe thread
+        seen = sorted(
+            k[2] for k in self._seen_shapes
+            if k[:2] == (family, sl) and isinstance(k[2], int)
+        )
+        b = seen[0] if seen else 64
+        ids = _np.zeros((t, d * b), scorer.ids_np_dtype)
+        vals = _np.zeros((t, d * b), scorer.vals_np_dtype)
+        counts = _np.zeros((t, d), _np.int32)
+        plan = self.faultplan
+
+        def _probe_flush():
+            stage = getattr(scorer, "stage_inputs", None)
+            staged = (
+                stage(ids, vals, counts) if stage else (ids, vals, counts)
+            )
+            if plan is not None:
+                plan.maybe_raise(family, sl, "probe")
+            out = scorer.step_counts(*staged)
+            gather = getattr(scorer, "gather_rows", None)
+            if gather is not None and hasattr(out, "is_ready"):
+                out = gather(out, staged[2], 1)
+            if plan is not None:
+                out = plan.wrap(out, family, sl, "probe")
+            return np.asarray(out)
+
+        deadline = self._flush_deadline_s(family, sl) or (
+            self.deliver_drain_timeout_s
+        )
+        fut = asyncio.get_running_loop().run_in_executor(
+            self._probe_executor(), _probe_flush
+        )
+        try:
+            await asyncio.wait_for(fut, timeout=deadline)
+        except asyncio.TimeoutError:
+            # NOT tpu_flush_timeout_total: no in-flight flush was
+            # force-resolved (that counter's contract) — the caller's
+            # probe_failures counter carries this outcome
+            return False
+        return True
+
+    async def _readmit_slice(self, family: str, sl: int) -> None:
+        """Probation passed: the slice rejoins the router, its breaker
+        and escalation history clear, the family unparks, and tenants
+        REBALANCE BACK through the same FIFO-preserving fences every
+        slice move rides."""
+        key = (family, sl)
+        self._quarantined.pop(key, None)
+        self.metrics.gauge("tpu_inference_quarantined_slices").set(
+            len(self._quarantined)
+        )
+        self.router.readmit(family, sl)
+        self._consec_errors.pop(key, None)
+        self._failover_rounds.pop(family, None)
+        self._parked.discard(family)
+        breaker = self.breakers.get(key)
+        if breaker is not None:
+            breaker.reset()
+        self.metrics.counter("tpu_inference.readmitted").inc()
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "quarantine", family, event="readmit", mesh_slice=sl,
+            )
+        # capacity self-heal: tenants displaced by the quarantine come
+        # home (load-gap-driven, so a balanced fleet moves nothing)
+        await self.apply_rebalance(family)
 
     async def _failover_tenant(self, engine: "TpuInferenceEngine") -> bool:
         """Re-place one tenant onto another shard (usually a different
@@ -2479,9 +3275,18 @@ class TpuInferenceService(MultitenantService):
             self._lane_last_source[(family, sl)] = source
             mask = np.zeros((scorer.n_slots,), bool)
             mask[trained] = True
+            if self.faultplan is not None:
+                self.faultplan.maybe_raise(family, sl, "train")
             t_disp = time.perf_counter()
             losses_dev = scorer.train_lane_step(mask, replay=replay)
             dispatch_s = time.perf_counter() - t_disp
+            if self.faultplan is not None:
+                # the train lane is a supervised fault domain too: a
+                # hung train step must not wedge the slice's in-flight
+                # window forever
+                losses_dev = self.faultplan.wrap(
+                    losses_dev, family, sl, "train"
+                )
             try:
                 losses_dev.copy_to_host_async()
             except Exception:  # noqa: BLE001 - test doubles
@@ -2534,6 +3339,9 @@ class TpuInferenceService(MultitenantService):
                 flops=float(flops_fn()) if flops_fn is not None else 0.0,
                 rec=rec, sl=sl, lane="train",
             )
+            dl = self._flush_deadline_s(family, sl)
+            if dl is not None:
+                pf.deadline = pf.t_dispatch + dl
             if not hasattr(losses_dev, "copy_to_host_async"):
                 pf.ensure_host_future(
                     asyncio.get_running_loop(), self._deliver_pool
@@ -2647,17 +3455,36 @@ class TpuInferenceService(MultitenantService):
                 self._reap_event.clear()
                 await self._reap_event.wait()
                 continue
-            pf = next((h for h in heads if h.landed()), None)
+            # landed heads resolve first; an OVERDUE head (its flush
+            # deadline expired without the transfer landing) resolves
+            # too — _resolve_flush's bounded wait turns it into the
+            # force-resolve + quarantine path within one grace tick
+            pf = next(
+                (h for h in heads if h.landed() or h.overdue()), None
+            )
             if pf is not None:
                 self._spawn_resolve(pf)
                 continue
             # no head has landed: race every eligible family's head (plus
             # the enqueue/resolve-done event — a NEW family's flush must
             # be able to join the race and win, or one family's slow
-            # transfer would head-of-line block every other family)
+            # transfer would head-of-line block every other family — and
+            # a timer for the SOONEST flush deadline, so a transfer that
+            # never lands wakes the supervisor instead of parking it)
             self._reap_event.clear()
             waiter = asyncio.ensure_future(self._reap_event.wait())
             now = time.perf_counter()
+            soonest = min(
+                (h.deadline for h in heads if h.deadline is not None),
+                default=None,
+            )
+            timer = (
+                asyncio.ensure_future(
+                    asyncio.sleep(max(0.0, soonest - now))
+                )
+                if soonest is not None
+                else None
+            )
             futs = []
             for h in heads:
                 if h.t_wait is None:
@@ -2665,11 +3492,15 @@ class TpuInferenceService(MultitenantService):
                 # one future per in-flight FAMILY (a handful), not per row
                 futs.append(h.ensure_host_future(loop, self._deliver_pool))  # hotpath: ok
             try:
-                await asyncio.wait(
-                    [*futs, waiter], return_when=asyncio.FIRST_COMPLETED
+                await asyncio.wait(  # supervised: ok(flush-deadline timer races in futs)
+                    [*futs, waiter]
+                    + ([timer] if timer is not None else []),
+                    return_when=asyncio.FIRST_COMPLETED,
                 )
             finally:
                 waiter.cancel()
+                if timer is not None:
+                    timer.cancel()
             pf = next((h for h, f in zip(heads, futs) if f.done()), None)
             if pf is not None:
                 self._spawn_resolve(pf)
@@ -2742,6 +3573,14 @@ class TpuInferenceService(MultitenantService):
         ``checkpoint.host_copy_params`` for the full invariant)."""
         _slots, _cols, seqs, rows = pf.taken
         scattered = False  # did the (possibly unscored) write-back start?
+        # flush supervision: every materialization await below is bounded
+        # by the flush's remaining deadline (None = supervision off). An
+        # already-overdue head gets one short grace tick so the timeout
+        # path — not a 0s race — decides.
+        budget = (
+            None if pf.deadline is None
+            else max(0.05, pf.deadline - time.perf_counter())
+        )
         try:
             if pf.lane == "train":
                 # train-lane completion: no rows to resolve — materialize
@@ -2750,12 +3589,19 @@ class TpuInferenceService(MultitenantService):
                 # the step's device window + FLOPs to the TRAIN families
                 # (never the serving MFU account)
                 scattered = True  # nothing row-shaped to salvage on cancel
-                losses_np, _sk, _sh = await pf.ensure_host_future(
-                    asyncio.get_running_loop(), self._deliver_pool
+                losses_np, _sk, _sh = await asyncio.wait_for(
+                    pf.ensure_host_future(
+                        asyncio.get_running_loop(), self._deliver_pool
+                    ),
+                    timeout=budget,
                 )
                 now = time.perf_counter()
                 self.last_train_losses[pf.key] = losses_np
                 device_s = max(0.0, now - pf.t_dispatch)
+                # train steps feed the same deadline history as serve
+                # flushes (they share the in-flight window): mixing only
+                # RAISES the p99-derived deadline — conservative-safe
+                self._note_device_s(pf.key, device_s)
                 self.metrics.histogram(
                     "tpu_inference.train_step", unit="s"
                 ).record(device_s)
@@ -2780,8 +3626,11 @@ class TpuInferenceService(MultitenantService):
                 await self._resolve_rows(seqs, rows, None, family=pf.family)
                 return
             t0 = time.perf_counter()
-            scores_np, sketch_np, shadow_np = await pf.ensure_host_future(
-                asyncio.get_running_loop(), self._deliver_pool
+            scores_np, sketch_np, shadow_np = await asyncio.wait_for(
+                pf.ensure_host_future(
+                    asyncio.get_running_loop(), self._deliver_pool
+                ),
+                timeout=budget,
             )
             now = time.perf_counter()
             # cumulative wait: from the FIRST time the reaper waited on
@@ -2852,6 +3701,10 @@ class TpuInferenceService(MultitenantService):
             # this flush's executed FLOPs (padded plane; see
             # ShardedScorer.flops_per_flush)
             device_s = max(0.0, now - pf.t_dispatch)
+            # ...and the flush supervisor's deadline history: the next
+            # flush's deadline tracks this (family, slice)'s observed
+            # dispatch→landed p99
+            self._note_device_s(pf.key, device_s)
             if pf.flops:
                 self._mfu_account(pf.family).record(pf.flops, device_s)
                 if self.mm.n_devices > 1:
@@ -2906,6 +3759,12 @@ class TpuInferenceService(MultitenantService):
                     seqs, rows, None, publish_nowait=True, family=pf.family
                 )
             raise
+        except asyncio.TimeoutError:
+            # the flush deadline expired with the transfer unlanded: the
+            # supervisor's SUSPECT path — force-resolve unscored in this
+            # FIFO slot (or retry/eject the rows), trip the breaker,
+            # snapshot the blackbox, quarantine the slice
+            await self._on_flush_timeout(pf, scattered)
         except Exception as exc:  # noqa: BLE001 - a poisoned transfer
             # must not strand the batches: resolve rows unscored — but
             # only if the write-back never ran (same double-decrement
@@ -2913,12 +3772,42 @@ class TpuInferenceService(MultitenantService):
             # non-transient publish error, already flushed the remaining
             # completed batches inside _resolve_rows)
             self._record_error("deliver", exc)
+            poison = pf.retried and self._poison_confirmed(
+                pf.family, pf.sl, pf.retry_from
+            )
             if not scattered:
-                await self._resolve_rows(seqs, rows, None, family=pf.family)
+                if poison:
+                    # a cross-slice retry faulted AGAIN: two chips
+                    # agreed — eject to the scorer-poison DLQ
+                    await self._eject_poison(pf.family, seqs, exc)
+                elif (
+                    pf.retry_rows is not None
+                    and not pf.retried
+                    and pf.lane != "train"
+                    and not self._seqs_already_retried(pf.taken[2])
+                ):
+                    # first strike at materialize time (late device
+                    # error): same one-shot retry as a dispatch fault —
+                    # inline because this IS the queue head's resolve
+                    # task (its permit is still held; exclude it from
+                    # the FIFO guard)
+                    await self._retry_poison(
+                        pf.family, pf.sl, pf.retry_rows, pf.taken, exc,
+                        inline=True, exclude=pf,
+                    )
+                else:
+                    if pf.retried:
+                        # same-chip second strike: chip-attributed —
+                        # the rows leave unscored, unmarked
+                        for s in np.unique(seqs).tolist():
+                            self._retried_seqs.discard(int(s))
+                    await self._resolve_rows(
+                        seqs, rows, None, family=pf.family
+                    )
             if pf.rec is not None and not pf.poisoned:
                 pf.rec["status"] = "error"
                 pf.rec["error"] = repr(exc)
-            if not pf.poisoned and pf.lane != "train":
+            if not pf.poisoned and not poison and pf.lane != "train":
                 # a poisoned flush's dispatch failure was already counted
                 # at the flush site — recording it again here would let a
                 # downstream bus hiccup double-pace failover/parking;
@@ -2949,7 +3838,8 @@ class TpuInferenceService(MultitenantService):
             if q and q[0] is pf:
                 q.popleft()
             self._deliver_gauge()
-            self._inflight_sem(pf.key).release()
+            if pf.owns_permit:
+                self._inflight_sem(pf.key).release()
             if (
                 self._last_scores.get(pf.key) is pf.scores
                 and not self._reap.get(pf.key)
@@ -2959,6 +3849,85 @@ class TpuInferenceService(MultitenantService):
                 # flush — by now the probe is ready, so dropping it
                 # can't change the next overlap verdict
                 self._last_scores.pop(pf.key, None)
+
+    async def _on_flush_timeout(
+        self, pf: _PendingFlush, scattered: bool
+    ) -> None:
+        """One flush blew its completion deadline: the supervisor's
+        SUSPECT verdict. The rows force-resolve UNSCORED in this FIFO
+        slot (exact PR 5 poisoned-flush semantics — zero loss, per-
+        tenant order preserved) unless the poison-retry path takes
+        ownership of them; the breaker trips (a hung device yields no
+        raised outcome for its window to count), the blackbox freezes,
+        and the slice enters quarantine + probation. Runs inside
+        ``_resolve_flush``'s try — its ``finally`` still pops the queue
+        head and releases the permit exactly once."""
+        family, sl = pf.key
+        self.metrics.counter(
+            "tpu_flush_timeout_total", family=family, slice=str(sl)
+        ).inc()
+        if pf.rec is not None:
+            pf.rec["status"] = "timeout"
+        # decide attribution BEFORE quarantining: a confirmed-poison
+        # verdict (cross-slice retry that ALSO failed) means the DATA,
+        # not this chip, owns the fault — quarantining/tripping the
+        # retry slice would churn tenants for a data bug, exactly the
+        # capacity drain poison ejection exists to stop
+        poison = pf.retried and self._poison_confirmed(
+            family, sl, pf.retry_from
+        )
+        if self.flightrec is not None:
+            # evidence first: the snapshot carries the wedged flush's
+            # own record (timings, kernel variant, slice, trace_id)
+            self.flightrec.snapshot(
+                f"flush-timeout:{family}", family=family, mesh_slice=sl,
+                lane=pf.lane,
+                trace_id=pf.rec.get("trace_id") if pf.rec else None,
+            )
+        _s, _c, seqs, rows = pf.taken
+        err = TimeoutError(f"flush deadline expired ({family}@s{sl})")
+        if poison:
+            await self._eject_poison(family, seqs, err)
+            return
+        breaker = self.breakers.get(pf.key)
+        if breaker is not None:
+            breaker.trip()
+        await self._quarantine_slice(family, sl, reason="flush-timeout")
+        if scattered or pf.lane == "train":
+            return  # no rows to salvage (train) / already written back
+        if pf.retried:
+            # same-chip (or fleet-sick) second timeout: chip-attributed
+            for s in np.unique(seqs).tolist():
+                self._retried_seqs.discard(int(s))
+            await self._force_resolve(pf)
+        elif (
+            pf.retry_rows is not None
+            and not self._seqs_already_retried(seqs)
+        ):
+            # first strike: the tenants just failed over (quarantine
+            # above) — retry the same staged bytes on their new slices
+            # (inline: this runs inside the head's own resolve task)
+            await self._retry_poison(
+                family, sl, pf.retry_rows, pf.taken, err,
+                inline=True, exclude=pf,
+            )
+        else:
+            await self._force_resolve(pf)
+
+    async def _force_resolve(
+        self, pf: _PendingFlush, nowait: bool = False
+    ) -> None:
+        """THE force-resolve accounting path: one pending flush's rows
+        resolve unscored (NaN, counted via tpu_scores_unscored_total +
+        per-tenant note_unscored inside ``_resolve_rows``). Shared by
+        the supervisor's deadline timeout (normal backpressure) and
+        service teardown (``nowait`` — the consumer may be gone), so
+        the two can never diverge on accounting."""
+        _s, _c, seqs, rows = pf.taken
+        if pf.lane != "train":
+            await self._resolve_rows(
+                seqs, rows, None, publish_nowait=nowait, family=pf.family
+            )
 
     # -- legacy object path (low-volume / tests) --------------------------
     async def _enqueue_events(self, engine: TpuInferenceEngine, events: List) -> List:
@@ -2997,6 +3966,10 @@ class TpuInferenceService(MultitenantService):
                 # slice moves in flight: release any whose old-slice
                 # snapshot fully resolved (parked rows re-enter lanes)
                 self._lift_fences()
+            if self._quarantined:
+                # probation: launch due probes for quarantined slices
+                # (no-op dict check on the healthy path)
+                self._probe_quarantined()
             for tenant, engine in list(self.engines.items()):
                 if engine.state is not LifecycleState.STARTED:
                     continue
@@ -3227,6 +4200,12 @@ class TpuInferenceService(MultitenantService):
         return {
             "mesh": self.mm.describe(),
             "router": self.router.describe(),
+            "quarantined": {
+                f"{fam}@{sl}": {
+                    k: v for k, v in qs.items() if k != "next_probe"
+                }
+                for (fam, sl), qs in sorted(self._quarantined.items())
+            },
             "families": {
                 f"{fam}@{sl}": {
                     "n_slots": s.n_slots,

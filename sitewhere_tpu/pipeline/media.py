@@ -318,6 +318,14 @@ class MediaClassificationPipeline(LifecycleComponent):
         ring_bytes: Optional[int] = None,
         decode_workers: int = 4,
         flightrec=None,
+        # flush supervision (docs/ROBUSTNESS.md "Device fault domains"):
+        # every classify readback is bounded by max(flush_deadline_ms,
+        # flush_deadline_x × this tenant's observed dispatch→landed
+        # p99); an overdue batch's frames drop (media is lossy by
+        # design — shed-oldest already governs the intake side) and
+        # tpu_flush_timeout_total counts it. 0 disables supervision.
+        flush_deadline_ms: float = 5000.0,
+        flush_deadline_x: float = 8.0,
     ) -> None:
         super().__init__(f"media-pipeline[{tenant}]")
         self.tenant = tenant
@@ -389,6 +397,25 @@ class MediaClassificationPipeline(LifecycleComponent):
         self.flightrec = flightrec
         self._mfu = None
         self._flops_per_frame = 0.0
+        # flush supervision: injectable device faults (runtime.faultplan;
+        # None in production) + the classify deadline's p99 history
+        self.faultplan = None
+        self.flush_deadline_ms = float(flush_deadline_ms)
+        self.flush_deadline_x = float(flush_deadline_x)
+        from sitewhere_tpu.runtime.metrics import RollingQuantile
+
+        self._classify_p99 = RollingQuantile()
+
+    def _classify_deadline_s(self) -> Optional[float]:
+        """The current classify completion budget (None = supervision
+        off): the media twin of TpuInferenceService._flush_deadline_s."""
+        floor = self.flush_deadline_ms / 1000.0
+        if floor <= 0:
+            return None
+        p99 = self._classify_p99.quantile()
+        if p99 is None:
+            return floor
+        return max(floor, self.flush_deadline_x * p99)
 
     def _warn_native_absent(self) -> None:
         if self._native_warned:
@@ -1079,16 +1106,59 @@ class MediaClassificationPipeline(LifecycleComponent):
         adds < 0.04% and is reported by bench config 5)."""
         loop = asyncio.get_running_loop()
         n = len(metas_sst)
+        fn = self.media.topk_results
+        if self.faultplan is not None:
+            # chaos: the classify readback is a supervised fault domain
+            # like the scoring lanes (hang/slow/late-fail inject here)
+            fn = self.faultplan.wrap_callable(
+                fn, f"vit_b16[{self.tenant}]", 0, "media"
+            )
         t_wait = time.perf_counter()
-        results = await loop.run_in_executor(
-            None, self.media.topk_results, pv, iv, n
-        )
+        try:
+            results = await asyncio.wait_for(
+                loop.run_in_executor(None, fn, pv, iv, n),
+                timeout=self._classify_deadline_s(),
+            )
+        except asyncio.TimeoutError:
+            # classify deadline expired: drop the batch's frames (media
+            # is lossy by design — intake already sheds oldest), count
+            # the timeout against this tenant's classify lane, and
+            # freeze the blackbox. The inflight permit releases in the
+            # caller's finally, so the pipeline keeps classifying.
+            key = f"vit_b16[{self.tenant}]"
+            self.metrics.counter(
+                "tpu_flush_timeout_total", family=key, slice="media"
+            ).inc()
+            self.metrics.counter("media.classify_timeouts").inc()
+            if self.flightrec is not None:
+                self.flightrec.record(
+                    "flush", key,
+                    ts_ms=disp_end_wall_ms,
+                    rows=n, bucket=bucket, codec=codec,
+                    wire_bytes=wire_bytes,
+                    dispatch_s=round(dispatch_s, 6),
+                    status="timeout",
+                )
+                self.flightrec.snapshot(
+                    f"flush-timeout:{key}", family=key, lane="media",
+                )
+            self._record_error(
+                "classify-timeout",
+                TimeoutError(
+                    f"classify readback blew its deadline "
+                    f"({n} frames dropped)"
+                ),
+            )
+            return
         waited_s = time.perf_counter() - t_wait
         self.metrics.histogram("media.d2h_wait", unit="s").record(waited_s)
         overlapped = waited_s < D2H_OVERLAP_EPS_S
         if overlapped:
             self.metrics.counter("media.d2h_overlapped").inc()
         device_s = time.perf_counter() - t_disp1
+        # deadline history: the next classify's budget tracks this
+        # tenant's observed dispatch→landed p99 (flush supervision)
+        self._classify_p99.add(device_s)
         if self._mfu is not None and self._flops_per_frame:
             self._mfu.record(self._flops_per_frame * bucket, device_s)
         if self.flightrec is not None:

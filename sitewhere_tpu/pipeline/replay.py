@@ -279,6 +279,12 @@ class ReplayEngine:
             "prepared replay batches queued between segment scanner and "
             "publish pump, per tenant",
         )
+        m.describe(
+            "replay_recovered_windows_total",
+            "rescore jobs whose cursor was rewound on resume to re-cover "
+            "a hard kill's published-but-unscored NaN window "
+            "(resume_jobs recover_unscored=True)",
+        )
 
     # -- job control -------------------------------------------------------
     def start_job(
@@ -452,11 +458,27 @@ class ReplayEngine:
             for j in finished[: len(finished) - self.max_finished]:
                 self.jobs.pop(j.job_id, None)
 
-    def resume_jobs(self, stores: Dict[str, object]) -> int:
+    def resume_jobs(
+        self, stores: Dict[str, object], recover_unscored: bool = False
+    ) -> int:
         """Relaunch unfinished jobs from their persisted cursors (called
         by the instance after tenants restore). A mid-replay crash loses
         nothing: scanning restarts at the committed cursor, and rows
-        before it were already published exactly once."""
+        before it were already published exactly once.
+
+        ``recover_unscored`` closes the documented guarantee-boundary
+        gap (module doc: the cursor marks PUBLISHED, not scored-and-
+        written-back): a NON-graceful restore — the job file still says
+        "running"; a graceful stop persists "paused" — can leave rows
+        before the cursor published but never written back (the NaN
+        window). Opting in REWINDS a resumed rescore job's cursor to
+        its window start, which IS the auto-enqueued ``only_unscored``
+        rescore of that window: dedupe skips every row whose score
+        landed, so only the NaN window re-publishes. (The recovered
+        window's rows count into ``replayed`` a second time — the
+        accounting trade for exactly-once scoring coverage; forced
+        jobs are excluded, a rewind would re-publish their whole
+        prefix.)"""
         if self.state_dir is None:
             return 0
         n = 0
@@ -475,6 +497,17 @@ class ReplayEngine:
             store = stores.get(job.tenant)
             if store is None:
                 continue
+            if (
+                recover_unscored
+                and job.status == "running"   # hard kill, not stop()
+                and job.target == "rescore"
+                and not job.force
+                and job.cursor > job.seq_lo
+            ):
+                job.cursor = job.seq_lo
+                self.metrics.counter(
+                    "replay_recovered_windows_total", tenant=job.tenant
+                ).inc()
             self.start_job(job.tenant, store, job=job)
             n += 1
         return n

@@ -818,6 +818,14 @@ class CircuitBreaker:
         self._outcomes.clear()
         self._set_state("open")
 
+    def trip(self) -> None:
+        """Force-open NOW — the flush supervisor's SUSPECT verdict. A
+        hung device produces no raised outcome for the rolling window to
+        count, so a deadline timeout trips the breaker directly instead
+        of waiting out a sample window the wedged slice would fill with
+        more force-resolved flushes."""
+        self._trip()
+
     def release_trial(self) -> None:
         """Return an unused half-open trial slot (the caller passed
         ``allow()`` but ended up making no call, so no outcome will be

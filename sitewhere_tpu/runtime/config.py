@@ -68,6 +68,31 @@ class FaultTolerancePolicy:
     # first-line healing is never starved of failure outcomes). Set False
     # in chaos/testing configs to let the scorer breaker act first.
     breaker_defer_to_failover: bool = True
+    # -- flush supervisor (docs/ROBUSTNESS.md "Device fault domains") ----
+    # Every dispatched flush (serve/train/shadow lanes; media classify
+    # carries its own copy of these knobs) gets a completion deadline:
+    # max(flush_deadline_ms, flush_deadline_x × the (family, slice)'s
+    # observed dispatch→landed p99). An overdue flush force-resolves
+    # UNSCORED in its FIFO slot (zero loss, per-tenant order preserved),
+    # the slice goes SUSPECT (breaker trip + quarantine + probation),
+    # and tpu_flush_timeout_total{family,slice} counts it. 0 disables
+    # supervision for the family (the rollback knob). Family-pinned
+    # (first tenant wins), like the breaker policy itself.
+    flush_deadline_ms: float = 5000.0
+    flush_deadline_x: float = 8.0
+    # consecutive synthetic probe flushes that must land before a
+    # quarantined slice is re-admitted to the router (and its tenants
+    # rebalanced back)
+    probation_probes: int = 3
+    # seconds between probation probes on a quarantined slice
+    probe_interval_s: float = 0.5
+    # poison-batch ejection: a flush whose dispatch faults is retried
+    # ONCE with the same staged host rows (on the tenant's current —
+    # post-failover, if the fault also moved it — slice); a second
+    # failure attributes the fault to the DATA and ships the offending
+    # batch to the per-tenant DLQ (stage "scorer-poison") so the tenant
+    # keeps serving instead of burning breaker/failover capacity on it
+    poison_retry: bool = True
 
 
 @dataclass(frozen=True)
@@ -289,6 +314,14 @@ class InstanceConfig:
     metrics_history_allowlist: Optional[List[str]] = None
     history_resolution_s: float = 1.0
     watchdog_enabled: bool = True
+    # hard-kill replay recovery (pipeline/replay.py): when resuming
+    # replay jobs after a NON-graceful restore (job file still says
+    # "running" — a graceful stop persists "paused"), rewind a resumed
+    # rescore job's cursor to its window start so the only_unscored plan
+    # re-covers the published-but-not-written-back NaN window the crash
+    # left behind (already-scored rows dedupe away). Opt-in: the rewind
+    # re-publishes the recovered window's unscored rows.
+    replay_recover_unscored: bool = False
 
 
 # -- tenant templates (reference: tenant templates + datasets bootstrap

@@ -272,7 +272,8 @@ def chrome_flush_events(rings: Dict[str, dict]) -> List[dict]:
                 args = {
                     k: rec[k]
                     for k in ("rows", "bucket", "compiled", "trace_id",
-                              "error", "status")
+                              "error", "status", "lane", "mesh_slice",
+                              "device_label")
                     if rec.get(k) is not None
                 }
                 out.append({

@@ -82,6 +82,11 @@ DEFAULT_ALLOWLIST: Tuple[str, ...] = (
     "tpu_train_rows_total",
     "tpu_train_swaps_total",
     "tpu_inference_train_rows",
+    # fault-domain supervision: flush-deadline timeouts per (family,
+    # slice) and the quarantine population — "when did the slice wedge
+    # / heal" questions read these beside the d2h series
+    "tpu_flush_timeout_total",
+    "tpu_inference_quarantined_slices",
 )
 
 # Families the Watchdog rules read from the history ring. A custom
@@ -99,6 +104,7 @@ WATCHDOG_REQUIRED: Tuple[str, ...] = (
     "overload_credit",
     "score_quality_psi",
     "score_quality_nan_rate",
+    "tpu_flush_timeout_total",
 )
 
 # PSI verdict boundary the score_drift rule shares with the REST health
@@ -318,6 +324,7 @@ class Watchdog:
         drift_window: float = 30.0,    # score-rule sustained hold, seconds
         psi_threshold: float = SCORE_PSI_THRESHOLD,
         nan_rate_threshold: float = 0.10,
+        flush_timeout_min: int = 3,    # timeouts per window to alert
         force_retain_s: float = 60.0,
         clock=time.monotonic,
     ) -> None:
@@ -357,6 +364,7 @@ class Watchdog:
         )
         self.psi_threshold = float(psi_threshold)
         self.nan_rate_threshold = float(nan_rate_threshold)
+        self.flush_timeout_min = int(flush_timeout_min)
         self.cooldown_s = cooldown_s
         self.min_flushes = min_flushes
         self.overlap_healthy = overlap_healthy
@@ -557,6 +565,52 @@ class Watchdog:
             **self._score_meta(first),
         }
 
+    def _rule_flush_timeout(self):
+        """A (family, slice)'s flush-deadline timeouts moved at a
+        sustained rate over the rule window — a device (or its link) is
+        wedging in-flight flushes faster than one-off noise. The
+        supervisor already force-resolved each one and quarantined the
+        slice; this alert is the operator-facing escalation, and its
+        snapshot names the slice AND the kernel variant that was
+        running (a timeout storm right after a variant rollout reads
+        very differently from one on steady state)."""
+        hits = []
+        first: Optional[Dict[str, str]] = None
+        for name in self.history.children("tpu_flush_timeout_total"):
+            d = self.history.delta(name, self.window)
+            if d is None:
+                # the child is YOUNGER than the rule window (it is born
+                # by its first timeout, so a storm in its first window_s
+                # is exactly the case a None-delta skip would go dark
+                # on): its whole cumulative count sits inside the window
+                d = self.history.latest(name)
+            if d is None or d < self.flush_timeout_min:
+                continue
+            labels = _child_labels(name)
+            hits.append(
+                f"{labels.get('family', name)}@s{labels.get('slice', '?')}"
+                f" (+{int(d)})"
+            )
+            if first is None:
+                first = labels
+        if not hits:
+            return None
+        meta: Dict[str, object] = {
+            "family": first.get("family") if first else None,
+            "slice": first.get("slice") if first else None,
+        }
+        if self.scorehealth is not None and meta.get("family"):
+            meta["variant"] = self.scorehealth.variant_for_family(
+                str(meta["family"])
+            )
+        return {
+            "detail": (
+                f">= {self.flush_timeout_min} flush timeouts in "
+                f"{self.window_s:g}s: " + ", ".join(hits)
+            ),
+            **meta,
+        }
+
     RULES = (
         ("steady_state_recompile", "_rule_steady_state_recompile"),
         ("h2d_overlap_collapse", "_rule_h2d_overlap_collapse"),
@@ -565,6 +619,7 @@ class Watchdog:
         ("d2h_wait_spike", "_rule_d2h_wait_spike"),
         ("score_drift", "_rule_score_drift"),
         ("nan_rate_spike", "_rule_nan_rate_spike"),
+        ("flush_timeout", "_rule_flush_timeout"),
     )
 
     # -- evaluation ------------------------------------------------------

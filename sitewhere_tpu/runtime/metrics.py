@@ -56,6 +56,54 @@ BREAKER_STATE_VALUES: Dict[str, float] = {
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
+class RollingQuantile:
+    """Bounded sample window with a cheap cached quantile read.
+
+    The flush supervisor's deadline source: each (family, mesh-slice)
+    feeds its dispatch→transfer-landed seconds here, and the deadline
+    for the NEXT flush is ``max(floor, x × quantile(0.99))`` — the
+    deadline tracks the family's OWN recent latency instead of a global
+    constant (docs/ROBUSTNESS.md "Device fault domains"). ``add`` is
+    O(1) on the hot path; the sort amortizes over ``refresh_every``
+    adds (the p99 of a 128-sample window moves slowly by construction,
+    so a slightly stale read is fine — and the floor knob bounds the
+    blast radius of any staleness)."""
+
+    __slots__ = ("_buf", "_q", "_cached", "_since_sort", "refresh_every")
+
+    MIN_SAMPLES = 8  # below this the caller's floor rules alone
+
+    def __init__(
+        self, window: int = 128, q: float = 0.99, refresh_every: int = 16
+    ) -> None:
+        from collections import deque
+
+        self._buf = deque(maxlen=max(self.MIN_SAMPLES, int(window)))
+        self._q = float(q)
+        self._cached: Optional[float] = None
+        self._since_sort = 0
+        self.refresh_every = max(1, int(refresh_every))
+
+    def add(self, v: float) -> None:
+        self._buf.append(float(v))
+        self._since_sort += 1
+        if self._cached is None or self._since_sort >= self.refresh_every:
+            self._recompute()
+
+    def _recompute(self) -> None:
+        self._since_sort = 0
+        n = len(self._buf)
+        if n < self.MIN_SAMPLES:
+            self._cached = None
+            return
+        s = sorted(self._buf)
+        self._cached = s[min(n - 1, int(self._q * n))]
+
+    def quantile(self) -> Optional[float]:
+        """The cached window quantile, or None under MIN_SAMPLES."""
+        return self._cached
+
+
 def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 

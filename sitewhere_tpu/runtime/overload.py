@@ -550,6 +550,18 @@ class OverloadController:
             return True  # unregistered (standalone gates): shed freely
         return st.credit < 1.0 or st.level > 0
 
+    def any_pressure(self) -> bool:
+        """True while ANY registered tenant shows overload signals —
+        the probation prober's defer gate: a synthetic probe flush on a
+        quarantined slice is pure recovery bookkeeping and must not
+        contend for device time while live traffic is already shedding
+        (the same live-traffic-wins posture as the replay pump and the
+        train lane)."""
+        return any(
+            st.credit < 1.0 or st.level > 0
+            for st in self._tenants.values()
+        )
+
     def degraded(self, tenant: str, feature: str) -> bool:
         st = self._tenants.get(tenant)
         if st is None or not st.policy.enabled or st.level == 0:

@@ -348,6 +348,17 @@ class ScoreHealth:
         th = self._tenants.get(tenant)
         return dict(th.variant) if th is not None else {}
 
+    def variant_for_family(self, family: str) -> Dict[str, object]:
+        """Any registered tenant's kernel variant for ``family`` — the
+        knobs are family-pinned (first tenant wins, parallel.sharded),
+        so every tenant of the family reports the same variant. Lets
+        slice-scoped incident paths (the flush_timeout watchdog rule)
+        name the kernel variant without a tenant in hand."""
+        for th in self._tenants.values():
+            if th.family == family and th.variant:
+                return dict(th.variant)
+        return {}
+
     # -- ingest (the resolve-path hot feed) ------------------------------
     def ingest_sketch(
         self,

@@ -351,7 +351,13 @@ async def test_chaos_sustained_soak_zero_loss():
         for round_i in range(20):
             vals = set(range(base, base + 200))
             if round_i % 4 == 1:
-                inst.inference.scorers["lstm_ad"].fault_steps = 5
+                # every slice of the family: the supervision layer may
+                # have failed acme over to another slice by now (bare
+                # family-name access raises AmbiguousFamilyError then)
+                for _sl, sc in inst.inference.scorers.family_items(
+                    "lstm_ad"
+                ):
+                    sc.fault_steps = 5
             await _send_values(rt, vals, wave_sleep=0.001)
             sent |= vals
             base += 200

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Deadline-supervision lint (AST).
+
+The flush supervisor's contract (docs/ROBUSTNESS.md "Device fault
+domains") is that NO hot-path await on a device future can wedge a
+tenant's delivery forever: every such await either races a deadline
+(``asyncio.wait_for``) or is covered by a named watchdog that will
+force-resolve it. PR 12's review history shows how these awaits
+accrete — a new lane adds one more ``ensure_host_future`` /
+``run_in_executor`` materialization and nothing guarantees it got a
+deadline. This lint keeps the invariant structural:
+
+- every ``await`` inside a function registered in ``SUPERVISED_PATHS``
+  whose expression touches a watched call — ``ensure_host_future``
+  (the reaper's materialization), ``run_in_executor`` (executor
+  materializations), or ``asyncio.wait`` (the reaper's completion
+  race) — must be DIRECTLY wrapped in ``asyncio.wait_for(...)``, or
+- carry a trailing ``# supervised: ok(<owning watchdog>)`` opt-out
+  NAMING the mechanism that bounds it (e.g. the flush-deadline timer
+  that rides inside the reaper's race). An empty opt-out is a finding
+  — "trust me" is exactly what this lint exists to ban.
+
+A registry entry whose function disappeared is itself a finding (stale
+registries rot lints — the check_hotpath rule).
+
+Used two ways, exactly like ``check_queues.py``: standalone
+(``python tools/check_supervised.py`` → exit 1 on findings) and
+imported by the tier-1 suite (``lint_supervised()``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+
+# module (relative to sitewhere_tpu/) → hot-path functions whose device
+# awaits must be deadline-supervised ("Class.method" or bare name).
+SUPERVISED_PATHS: Dict[str, List[str]] = {
+    "pipeline/inference.py": [
+        # the completion reaper's race over in-flight heads
+        "TpuInferenceService._reap_loop",
+        # per-flush materialization (serve + train lanes)
+        "TpuInferenceService._resolve_flush",
+        # probation probes on quarantined slices
+        "TpuInferenceService._dispatch_probe",
+    ],
+    "pipeline/media.py": [
+        # the classify readback (media lane)
+        "MediaClassificationPipeline._finish_classify",
+    ],
+}
+
+# call names whose await is a device-future / reap wait
+WATCHED_NAMES = ("ensure_host_future", "run_in_executor")
+
+OPT_OUT_RE = re.compile(r"#\s*supervised:\s*ok\(([^)]*)\)")
+
+
+def _is_asyncio_wait(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "wait"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "asyncio"
+    )
+
+
+def _mentions_watched(node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            if sub.attr in WATCHED_NAMES:
+                return sub.attr
+            if _is_asyncio_wait(sub):
+                return "asyncio.wait"
+        elif isinstance(sub, ast.Name) and sub.id in WATCHED_NAMES:
+            return sub.id
+    return None
+
+
+def _is_wait_for(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    return (
+        isinstance(f, ast.Attribute) and f.attr == "wait_for"
+    ) or (isinstance(f, ast.Name) and f.id == "wait_for")
+
+
+def _functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+
+def lint_source(text: str, functions: List[str], rel: str) -> List[str]:
+    """Lint one module's source for the registered functions; returns
+    findings. Split out so tests can exercise the rule on synthetic
+    sources."""
+    findings: List[str] = []
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as exc:
+        return [f"{rel}: unparseable ({exc})"]
+    lines = text.splitlines()
+    defs = _functions(tree)
+    for fname in functions:
+        fn = defs.get(fname)
+        if fn is None:
+            findings.append(
+                f"{rel}: registered function '{fname}' not found — stale "
+                f"tools/check_supervised.py registry"
+            )
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Await):
+                continue
+            watched = _mentions_watched(node.value)
+            if watched is None:
+                continue
+            if _is_wait_for(node.value):
+                continue  # deadline-supervised at the await itself
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            m = OPT_OUT_RE.search(line)
+            if m is None:
+                findings.append(
+                    f"{rel}:{node.lineno}: {fname} awaits {watched} "
+                    f"without a deadline — wrap in asyncio.wait_for(...) "
+                    f"or name the owning watchdog with "
+                    f"'# supervised: ok(<watchdog>)'"
+                )
+            elif not m.group(1).strip():
+                findings.append(
+                    f"{rel}:{node.lineno}: {fname} opt-out names no "
+                    f"watchdog — '# supervised: ok()' is not a guarantee"
+                )
+    return findings
+
+
+def lint_supervised() -> List[str]:
+    findings: List[str] = []
+    for rel, functions in SUPERVISED_PATHS.items():
+        path = SRC_ROOT / rel
+        if not path.exists():
+            findings.append(
+                f"registry entry for {rel} matches no file — stale registry"
+            )
+            continue
+        findings.extend(lint_source(path.read_text(), functions, rel))
+    return findings
+
+
+def main() -> int:
+    findings = lint_supervised()
+    for f in findings:
+        print(f"check_supervised: {f}", file=sys.stderr)
+    n_fns = sum(len(v) for v in SUPERVISED_PATHS.values())
+    print(
+        f"check_supervised: {n_fns} registered function(s), "
+        f"{len(findings)} finding(s)"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

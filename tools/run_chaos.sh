@@ -11,10 +11,19 @@
 #
 # Usage: tools/run_chaos.sh [extra pytest args...]
 #   OVERLOAD_ONLY=1 tools/run_chaos.sh   # just the overload scenario
+#   MESH_ONLY=1 tools/run_chaos.sh       # just the device-fault suite
+#     (tests/test_device_chaos.py: hang/fail/corrupt/slow faults on one
+#     slice of a 4x2 mesh with live traffic — exact store∪DLQ∪expired∪
+#     unscored accounting, healthy-slice p99 bound, flush-deadline
+#     force-resolve, probation re-admission, poison-batch ejection)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 if [[ "${OVERLOAD_ONLY:-}" == "1" ]]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_overload_chaos.py \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+fi
+if [[ "${MESH_ONLY:-}" == "1" ]]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_device_chaos.py \
         -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
