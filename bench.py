@@ -2005,6 +2005,28 @@ def main() -> None:
                 "6 ms-2.5 s)"
             )
 
+    # static-analysis cost (ISSUE 15, info-class — check_bench never
+    # gates it): wall time of the pure-AST lint suite, the exact
+    # configuration tier-1 and the dev loop run (tools/lint_all.py
+    # --fast). A jump here means an analyzer's cost regressed — e.g. the
+    # astlib parse cache stopped hitting
+    try:
+        import os
+
+        _tools_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools")
+        if _tools_dir not in sys.path:
+            sys.path.insert(0, _tools_dir)
+        import lint_all as _lint_all
+
+        _t0 = time.perf_counter()
+        _lint_all.run_all(fast=True)
+        details["lint_wall_s"] = round(time.perf_counter() - _t0, 3)
+    except Exception as exc:  # noqa: BLE001 - the bench must not die on
+        # a lint-suite crash; the analyzers' own tier-1 wiring gates that
+        details["lint_wall_s"] = None
+        details["lint_wall_error"] = repr(exc)
+
     # headline: the north-star metric — device events/sec anomaly-scored
     # through the 32-tenant stacked engine (BASELINE.json:5,10)
     headline = details.get("tenants32_engine", details.get("lstm_engine"))
@@ -2106,6 +2128,10 @@ def main() -> None:
         "train_ev_s": pick(details, "train_lane", "train_ev_s"),
         "serve_p99_train_delta": pick(
             details, "train_lane", "serve_p99_train_delta", nd=4),
+        # static-analysis suite cost (ISSUE 15): info-class by
+        # check_bench's classify() — no suffix rule matches, so it
+        # reports but never gates
+        "lint_wall_s": pick(details, "lint_wall_s", nd=2),
         "details": args.details_out,
     }
     line = json.dumps(out)

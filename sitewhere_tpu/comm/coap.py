@@ -142,6 +142,15 @@ class CoapIngestServer(LifecycleComponent):
         self.host, self.port = host, port
         self.bound_port: Optional[int] = None
         self._transport = None
+        # per-datagram handler tasks: held here so an exception surfaces
+        # through _task_done (not a vanished fire-and-forget task) and
+        # on_stop can cancel in-flight handlers instead of leaking them
+        self._handlers: set = set()
+
+    def _task_done(self, task: "asyncio.Task") -> None:
+        self._handlers.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            self._record_error("handle", task.exception())
 
     async def on_start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -152,7 +161,11 @@ class CoapIngestServer(LifecycleComponent):
                 self.transport = transport
 
             def datagram_received(self, data, addr):
-                asyncio.ensure_future(server._handle(data, addr, self.transport))
+                task = asyncio.ensure_future(
+                    server._handle(data, addr, self.transport)
+                )
+                server._handlers.add(task)
+                task.add_done_callback(server._task_done)
 
         self._transport, _ = await loop.create_datagram_endpoint(
             _Proto, local_addr=(self.host, self.port)
@@ -163,6 +176,19 @@ class CoapIngestServer(LifecycleComponent):
         if self._transport is not None:
             self._transport.close()
             self._transport = None
+        for task in list(self._handlers):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                if not task.cancelled():
+                    # the CancelledError is on_stop's OWN cancellation
+                    # (the handler task isn't done-cancelled) — propagate
+                    raise
+            except Exception:  # noqa: BLE001 - handler errors already
+                # surfaced via _task_done; teardown just drains
+                pass
+        self._handlers.clear()
 
     async def _handle(self, data: bytes, addr, transport) -> None:
         try:

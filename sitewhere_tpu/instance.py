@@ -552,7 +552,12 @@ class SiteWhereInstance(LifecycleComponent):
             raise ValueError(f"tenant '{cfg.tenant}' already running")
         # lift any tombstone from a previous removal of this tenant token
         self.bus.undrop(self.bus.naming.tenant_topic(cfg.tenant, ""))
-        rt = self._build_tenant(cfg)
+        # tenant build (incl. checkpoint/store recovery: open+mmap+fsync)
+        # stays ON the loop by design: it registers broker handlers and
+        # tracer/overload policies that loop-side publishers read, so an
+        # executor hop would race live traffic — and it is control-plane
+        # work that runs once per tenant add, before this tenant serves
+        rt = self._build_tenant(cfg)  # async: ok(cold control-plane path; build mutates loop-owned routing state)
         self.tenants[cfg.tenant] = rt
         self._shared_targets = None
         for comp in rt.components():

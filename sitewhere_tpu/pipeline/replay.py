@@ -437,7 +437,11 @@ class ReplayEngine:
         if path is None:
             return
         tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(job.to_dict()))
+        # deliberately sync ON the loop: the publish→commit pair must be
+        # await-free (cancellation-atomicity; the zero-dup contract
+        # above) — an executor hop here would reopen the window this
+        # function exists to close. The payload is a ~300-byte JSON blob.
+        tmp.write_text(json.dumps(job.to_dict()))  # async: ok(await-free cursor commit; tiny write)
         tmp.replace(path)
 
     def _retire(self, job: ReplayJob) -> None:

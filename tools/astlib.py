@@ -1,0 +1,580 @@
+#!/usr/bin/env python
+"""Shared AST analysis core for the ``tools/check_*`` lints.
+
+Every analyzer in ``tools/`` grew its own package walker, function
+indexer, opt-out parser, and stale-registry check — six copies of the
+same scaffolding, each drifting its own way. This module is the single
+implementation they all import:
+
+- **per-file AST cache** (``get_module`` / ``walk_package``): one parse
+  per file per process, keyed by (path, mtime, size) so repeated lints
+  inside the tier-1 suite or ``lint_all.py`` never re-parse;
+- **function index** (``ModuleInfo.functions``): ``"name"`` for
+  module-level defs, ``"Class.method"`` for methods — the registry
+  addressing scheme every tool shares;
+- **``Finding``**: one structured finding record with the common
+  ``rel:lineno: [qual] msg`` rendering, a machine-readable rule tag,
+  and JSON serialization for ``lint_all.py``;
+- **unified opt-out grammar** (``opt_out``): a line opts out of
+  namespace ``ns`` with a trailing ``# <ns>: ok`` or
+  ``# <ns>: ok(<reason>)`` comment. Tools that demand a reason
+  (``supervised``, ``async``) get empty-parens detection for free;
+- **registry staleness** (``stale_registry``): a registry entry whose
+  module or function disappeared is itself a finding naming the
+  missing symbol — stale registries rot lints (the check_hotpath
+  rule, now shared);
+- **call graph** (``CallGraph``): a conservative whole-package call
+  graph used by ``check_async.py``'s blocking-call reachability.
+  Resolution is deliberately precise-over-complete: same-module
+  calls, ``self.method`` (through base classes), and explicitly
+  imported module/symbol calls resolve; dynamic dispatch through
+  arbitrary objects does not (a missed edge is a missed finding, a
+  fabricated edge is a false positive that erodes trust in the lint).
+  Functions handed to ``run_in_executor`` / ``asyncio.to_thread`` /
+  ``pool.submit`` are recorded as **executor targets**, not call
+  edges — they leave the event loop, which is exactly the escape
+  hatch the async lints must honor.
+
+Import pattern (works standalone, from tests' importlib loading, and
+from ``lint_all.py``)::
+
+    _TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+    if _TOOLS_DIR not in sys.path:
+        sys.path.insert(0, _TOOLS_DIR)
+    import astlib
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+PACKAGE = "sitewhere_tpu"
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+# --------------------------------------------------------------- findings
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``str(f)`` renders the established
+    ``rel:lineno: [qual] msg`` shape the legacy tools print."""
+
+    tool: str
+    rule: str
+    rel: str
+    lineno: int
+    msg: str
+    qual: str = ""
+
+    def __str__(self) -> str:
+        loc = f"{self.rel}:{self.lineno}" if self.lineno else (self.rel or "-")
+        q = f" [{self.qual}]" if self.qual else ""
+        return f"{loc}:{q} {self.msg}"
+
+    def to_json(self) -> dict:
+        return {
+            "tool": self.tool, "rule": self.rule, "file": self.rel,
+            "line": self.lineno, "function": self.qual, "msg": self.msg,
+        }
+
+
+# ---------------------------------------------------------- module cache
+@dataclass
+class ModuleInfo:
+    """One parsed source module plus the derived indexes every tool
+    needs. Produced by ``get_module`` (cached) or ``from_source``
+    (synthetic fixtures in tests)."""
+
+    rel: str
+    path: Optional[Path]
+    text: str
+    lines: List[str]
+    tree: ast.Module
+    functions: Dict[str, FunctionNode]
+    classes: Dict[str, ast.ClassDef]
+    # names (module-level "NAME" / class-attr "Class.attr") bound to
+    # threading.Lock()/RLock()/Condition()/Event()/Semaphore() — the
+    # lock-identity index rules 1/2 key off
+    thread_objects: Dict[str, str]
+
+    @classmethod
+    def from_source(cls, text: str, rel: str,
+                    path: Optional[Path] = None) -> "ModuleInfo":
+        tree = ast.parse(text)
+        functions, classes = function_index(tree)
+        return cls(
+            rel=rel, path=path, text=text, lines=text.splitlines(),
+            tree=tree, functions=functions, classes=classes,
+            thread_objects=_thread_objects(tree),
+        )
+
+
+_CACHE: Dict[Path, Tuple[float, int, ModuleInfo]] = {}
+
+
+def get_module(path: Path, rel: Optional[str] = None) -> ModuleInfo:
+    """Parse ``path`` with (mtime, size) caching. ``rel`` defaults to
+    the path relative to SRC_ROOT when under it, else the basename."""
+    path = Path(path)
+    st = path.stat()
+    hit = _CACHE.get(path)
+    if hit is not None and hit[0] == st.st_mtime and hit[1] == st.st_size:
+        return hit[2]
+    if rel is None:
+        try:
+            rel = str(path.relative_to(SRC_ROOT))
+        except ValueError:
+            rel = path.name
+    info = ModuleInfo.from_source(path.read_text(), rel, path)
+    _CACHE[path] = (st.st_mtime, st.st_size, info)
+    return info
+
+
+def walk_package(src_root: Optional[Path] = None) -> List[ModuleInfo]:
+    """Every ``*.py`` module under ``src_root`` (default: the
+    ``sitewhere_tpu`` package), parsed and cached, sorted by rel path."""
+    root = Path(src_root) if src_root is not None else SRC_ROOT
+    out: List[ModuleInfo] = []
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        try:
+            rel = str(p.relative_to(root))
+        except ValueError:
+            rel = p.name
+        out.append(get_module(p, rel))
+    return out
+
+
+def function_index(
+    tree: ast.Module,
+) -> Tuple[Dict[str, FunctionNode], Dict[str, ast.ClassDef]]:
+    """(functions, classes): module-level defs as ``"name"``, methods as
+    ``"Class.method"`` — the registry addressing scheme."""
+    functions: Dict[str, FunctionNode] = {}
+    classes: Dict[str, ast.ClassDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    functions[f"{node.name}.{sub.name}"] = sub
+    return functions, classes
+
+
+_THREAD_FACTORIES = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+                     "BoundedSemaphore", "Barrier"}
+
+
+def _thread_factory_kind(value: ast.AST) -> Optional[str]:
+    """'Lock' / 'Event' / ... when ``value`` is a
+    ``threading.<factory>()`` call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "threading"
+        and f.attr in _THREAD_FACTORIES
+    ):
+        return f.attr
+    return None
+
+
+def _thread_objects(tree: ast.Module) -> Dict[str, str]:
+    """Names bound to threading synchronization objects anywhere in the
+    module: module-level ``NAME`` and instance-attr ``Class.attr``
+    (assigned as ``self.attr = threading.X()`` in any method)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            kind = _thread_factory_kind(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = kind
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    kind = _thread_factory_kind(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            out[f"{node.name}.{t.attr}"] = kind
+    return out
+
+
+# ------------------------------------------------------- opt-out grammar
+_OPT_RE: Dict[str, re.Pattern] = {}
+
+OPT_OUT_MISSING = "missing"   # no opt-out comment on the line
+OPT_OUT_EMPTY = "empty"       # "# ns: ok" / "# ns: ok()" with no reason
+OPT_OUT_REASON = "reason"     # "# ns: ok(<non-empty reason>)"
+
+
+def opt_out(lines: Sequence[str], lineno: int, ns: str) -> Tuple[str, str]:
+    """Parse the unified opt-out grammar on ``lines[lineno-1]``.
+
+    Returns ``(status, reason)`` where status is one of
+    ``OPT_OUT_MISSING`` / ``OPT_OUT_EMPTY`` / ``OPT_OUT_REASON``.
+    Grammar: a trailing ``# <ns>: ok`` or ``# <ns>: ok(<reason>)``.
+    """
+    if not (1 <= lineno <= len(lines)):
+        return OPT_OUT_MISSING, ""
+    pat = _OPT_RE.get(ns)
+    if pat is None:
+        pat = _OPT_RE[ns] = re.compile(
+            rf"#\s*{re.escape(ns)}:\s*ok(?:\(([^)]*)\))?"
+        )
+    m = pat.search(lines[lineno - 1])
+    if m is None:
+        return OPT_OUT_MISSING, ""
+    reason = (m.group(1) or "").strip()
+    return (OPT_OUT_REASON if reason else OPT_OUT_EMPTY), reason
+
+
+def allowed(lines: Sequence[str], lineno: int, ns: str,
+            require_reason: bool = False) -> bool:
+    """True when the line opts out of ``ns`` (and, when
+    ``require_reason``, actually names one)."""
+    status, _ = opt_out(lines, lineno, ns)
+    if require_reason:
+        return status == OPT_OUT_REASON
+    return status != OPT_OUT_MISSING
+
+
+# ----------------------------------------------------- registry staleness
+def stale_registry(
+    tool: str,
+    registry: Dict[str, Sequence[str]],
+    modules: Dict[str, ModuleInfo],
+    registry_name: str = "registry",
+) -> Tuple[List[Finding], List[Tuple[ModuleInfo, str]]]:
+    """Check a ``{rel: [qual, ...]}`` registry against parsed modules.
+
+    Returns ``(findings, live)``: staleness findings naming the missing
+    module or symbol, plus the (module, qual) pairs that resolved and
+    are safe to lint."""
+    findings: List[Finding] = []
+    live: List[Tuple[ModuleInfo, str]] = []
+    for rel, quals in registry.items():
+        info = modules.get(rel)
+        if info is None:
+            findings.append(Finding(
+                tool, "stale-registry", rel, 0,
+                f"registered module does not exist — stale {registry_name}",
+            ))
+            continue
+        for qual in quals:
+            if qual not in info.functions:
+                findings.append(Finding(
+                    tool, "stale-registry", rel, 0,
+                    f"registered function '{qual}' not found — stale "
+                    f"{registry_name} (missing symbol: {qual})",
+                    qual=qual,
+                ))
+            else:
+                live.append((info, qual))
+    return findings, live
+
+
+def walk_stmts(stmts: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk a statement list WITHOUT descending into nested function /
+    lambda bodies (the nested def itself is still yielded): a nested
+    def runs somewhere else (an executor job, a callback) — charging
+    its body to the enclosing code fabricates edges the runtime never
+    takes on this thread."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def walk_body(fn: FunctionNode) -> Iterator[ast.AST]:
+    """``walk_stmts`` over a function's own body."""
+    return walk_stmts(fn.body)
+
+
+# ------------------------------------------------------------ call graph
+@dataclass
+class FuncInfo:
+    key: str                     # "rel::qual"
+    rel: str
+    qual: str
+    node: FunctionNode
+    is_async: bool
+    cls: Optional[str] = None    # enclosing class name, if a method
+
+
+@dataclass
+class _ImportMap:
+    """Per-module import resolution: local name → package module rel,
+    or → (module rel, symbol)."""
+
+    modules: Dict[str, str] = field(default_factory=dict)
+    symbols: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+
+def _mod_to_rel(dotted: str, known: Set[str]) -> Optional[str]:
+    """``sitewhere_tpu.pipeline.media`` → ``pipeline/media.py`` (or the
+    package's ``__init__.py``) when that module exists in ``known``."""
+    if dotted == PACKAGE:
+        return "__init__.py" if "__init__.py" in known else None
+    if not dotted.startswith(PACKAGE + "."):
+        return None
+    tail = dotted[len(PACKAGE) + 1:].replace(".", "/")
+    for cand in (f"{tail}.py", f"{tail}/__init__.py"):
+        if cand in known:
+            return cand
+    return None
+
+
+def _resolve_relative(rel: str, level: int, module: str) -> str:
+    """Absolute dotted path for a ``from .x import y`` in module
+    ``rel`` (path relative to SRC_ROOT)."""
+    parts = rel.split("/")
+    pkg_parts = [PACKAGE] + parts[:-1]  # drop the filename
+    if parts[-1] == "__init__.py":
+        pass  # the package dir IS this module's package
+    # level=1 → current package, each extra level pops one
+    base = pkg_parts[: len(pkg_parts) - (level - 1)] if level > 1 else pkg_parts
+    return ".".join(base + ([module] if module else []))
+
+
+class CallGraph:
+    """Conservative whole-package call graph.
+
+    ``functions``: key → FuncInfo. ``edges``: caller key →
+    [(callee key, call lineno)]. ``executor_targets``: keys of package
+    functions handed to an executor hop (run_in_executor / to_thread /
+    pool.submit) anywhere, with the submitting (caller key, lineno).
+    """
+
+    EXECUTOR_ATTRS = {"run_in_executor": 1, "submit": 0, "to_thread": 0}
+
+    def __init__(self, modules: List[ModuleInfo]) -> None:
+        self.modules: Dict[str, ModuleInfo] = {m.rel: m for m in modules}
+        known = set(self.modules)
+        self.functions: Dict[str, FuncInfo] = {}
+        self._imports: Dict[str, _ImportMap] = {}
+        for info in modules:
+            for qual, node in info.functions.items():
+                cls = qual.split(".")[0] if "." in qual else None
+                key = f"{info.rel}::{qual}"
+                self.functions[key] = FuncInfo(
+                    key, info.rel, qual, node,
+                    isinstance(node, ast.AsyncFunctionDef), cls,
+                )
+            self._imports[info.rel] = self._import_map(info, known)
+        self.edges: Dict[str, List[Tuple[str, int]]] = {}
+        self.executor_targets: Dict[str, List[Tuple[str, int]]] = {}
+        for info in modules:
+            for qual in info.functions:
+                self._extract_edges(info, qual)
+
+    # -- import resolution -------------------------------------------------
+    def _import_map(self, info: ModuleInfo, known: Set[str]) -> _ImportMap:
+        imap = _ImportMap()
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    rel = _mod_to_rel(alias.name, known)
+                    if rel:
+                        imap.modules[alias.asname or alias.name.split(".")[-1]] = rel
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    dotted = _resolve_relative(
+                        info.rel, node.level, node.module or ""
+                    )
+                else:
+                    dotted = node.module or ""
+                base_rel = _mod_to_rel(dotted, known)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # "from pkg.mod import sym": sym may itself be a module
+                    sub_rel = _mod_to_rel(f"{dotted}.{alias.name}", known)
+                    if sub_rel:
+                        imap.modules[local] = sub_rel
+                    elif base_rel:
+                        imap.symbols[local] = (base_rel, alias.name)
+        return imap
+
+    # -- per-function edge extraction -------------------------------------
+    def _extract_edges(self, info: ModuleInfo, qual: str) -> None:
+        key = f"{info.rel}::{qual}"
+        node = info.functions[qual]
+        cls = qual.split(".")[0] if "." in qual else None
+        edges: List[Tuple[str, int]] = []
+        for call in (n for n in walk_body(node) if isinstance(n, ast.Call)):
+            hop = self._executor_arg(call)
+            if hop is not None:
+                tgt = self._resolve_ref(info, cls, hop)
+                if tgt:
+                    self.executor_targets.setdefault(tgt, []).append(
+                        (key, call.lineno)
+                    )
+                continue
+            tgt = self._resolve_ref(info, cls, call.func)
+            if tgt:
+                edges.append((tgt, call.lineno))
+        if edges:
+            self.edges[key] = edges
+
+    def _executor_arg(self, call: ast.Call) -> Optional[ast.AST]:
+        """The function reference handed to an executor hop, if this
+        call is one (``loop.run_in_executor(pool, fn, ...)``,
+        ``pool.submit(fn, ...)``, ``asyncio.to_thread(fn, ...)``)."""
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        idx = self.EXECUTOR_ATTRS.get(f.attr)
+        if idx is None or len(call.args) <= idx:
+            return None
+        ref = call.args[idx]
+        # functools.partial(fn, ...) wrapping the real target
+        if isinstance(ref, ast.Call):
+            rf = ref.func
+            if (
+                isinstance(rf, ast.Name) and rf.id == "partial"
+                or isinstance(rf, ast.Attribute) and rf.attr == "partial"
+            ) and ref.args:
+                return ref.args[0]
+            return None
+        return ref
+
+    def _resolve_ref(
+        self, info: ModuleInfo, cls: Optional[str], ref: ast.AST
+    ) -> Optional[str]:
+        """Resolve a call/function reference to a graph key, or None."""
+        if isinstance(ref, ast.Name):
+            if ref.id in info.functions:
+                return f"{info.rel}::{ref.id}"
+            sym = self._imports[info.rel].symbols.get(ref.id)
+            if sym:
+                mod_rel, name = sym
+                mod = self.modules.get(mod_rel)
+                if mod is not None:
+                    if name in mod.functions:
+                        return f"{mod_rel}::{name}"
+                    if name in mod.classes and f"{name}.__init__" in mod.functions:
+                        return f"{mod_rel}::{name}.__init__"
+            return None
+        if isinstance(ref, ast.Attribute):
+            v = ref.value
+            if isinstance(v, ast.Name):
+                if v.id == "self" and cls is not None:
+                    return self._resolve_method(info, cls, ref.attr)
+                if v.id == "cls" and cls is not None:
+                    return self._resolve_method(info, cls, ref.attr)
+                mod_rel = self._imports[info.rel].modules.get(v.id)
+                if mod_rel:
+                    mod = self.modules.get(mod_rel)
+                    if mod is not None and ref.attr in mod.functions:
+                        return f"{mod_rel}::{ref.attr}"
+                # Class.method / Class() static reference in same module
+                if v.id in info.classes:
+                    q = f"{v.id}.{ref.attr}"
+                    if q in info.functions:
+                        return f"{info.rel}::{q}"
+            return None
+        return None
+
+    def _resolve_method(
+        self, info: ModuleInfo, cls: str, attr: str, depth: int = 0
+    ) -> Optional[str]:
+        """``self.attr`` → the defining class's method, walking base
+        classes (within the package) up to a small depth."""
+        q = f"{cls}.{attr}"
+        if q in info.functions:
+            return f"{info.rel}::{q}"
+        if depth >= 5:
+            return None
+        cnode = info.classes.get(cls)
+        if cnode is None:
+            return None
+        for base in cnode.bases:
+            binfo: Optional[ModuleInfo] = None
+            bname: Optional[str] = None
+            if isinstance(base, ast.Name):
+                bname = base.id
+                if bname in info.classes:
+                    binfo = info
+                else:
+                    sym = self._imports[info.rel].symbols.get(bname)
+                    if sym:
+                        binfo = self.modules.get(sym[0])
+                        bname = sym[1]
+            elif isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name
+            ):
+                mod_rel = self._imports[info.rel].modules.get(base.value.id)
+                if mod_rel:
+                    binfo = self.modules.get(mod_rel)
+                    bname = base.attr
+            if binfo is not None and bname is not None:
+                found = self._resolve_method(binfo, bname, attr, depth + 1)
+                if found:
+                    return found
+        return None
+
+    # -- traversal ---------------------------------------------------------
+    def walk_sync_reachable(
+        self, root: str
+    ) -> Iterator[Tuple[str, List[Tuple[str, int]]]]:
+        """Yield ``(key, path)`` for every function reachable from
+        ``root`` through call edges, never descending INTO an async
+        callee (an async callee is its own analysis root). ``path`` is
+        the [(caller key, call lineno), ...] chain from root. The root
+        itself is yielded with an empty path."""
+        seen: Set[str] = {root}
+        stack: List[Tuple[str, List[Tuple[str, int]]]] = [(root, [])]
+        while stack:
+            key, path = stack.pop()
+            yield key, path
+            for callee, lineno in self.edges.get(key, ()):
+                if callee in seen:
+                    continue
+                fi = self.functions.get(callee)
+                if fi is None or fi.is_async:
+                    continue  # async callee analyzed as its own root
+                seen.add(callee)
+                stack.append((callee, path + [(key, lineno)]))
+
+
+_GRAPH_CACHE: Dict[Tuple[Tuple[str, float, int], ...], CallGraph] = {}
+
+
+def get_call_graph(src_root: Optional[Path] = None) -> CallGraph:
+    """Build (or reuse) the package call graph. Cached on the exact
+    (rel, mtime, size) set of the walked files, so tier-1's repeated
+    lints share one build."""
+    modules = walk_package(src_root)
+    sig = tuple(
+        (m.rel, m.path.stat().st_mtime, m.path.stat().st_size)
+        for m in modules if m.path is not None
+    )
+    graph = _GRAPH_CACHE.get(sig)
+    if graph is None:
+        _GRAPH_CACHE.clear()  # one live graph per tree state
+        graph = _GRAPH_CACHE[sig] = CallGraph(modules)
+    return graph

@@ -1,0 +1,671 @@
+#!/usr/bin/env python
+"""Whole-program async-safety analyzer (AST + call graph).
+
+Every review round since PR 5 has re-found the same *class* of bug by
+hand: blocking work reachable from event-loop coroutines, locks held
+across awaits, awaits splitting a commit pair, fire-and-forget tasks,
+and executor threads racing loop-side state. This analyzer makes the
+class structural, on the shared ``tools/astlib.py`` core:
+
+1. **blocking-in-coroutine** — call-graph reachability from any
+   ``async def`` under ``registries.ASYNC_ROOT_DIRS`` to a blocking
+   primitive (``time.sleep``, ``os.fsync``, sync file I/O,
+   ``threading.Lock.acquire`` / ``Event.wait`` on known lock objects,
+   and the ``registries.BLOCKING_LEAVES`` package functions — ctypes
+   decode, PIL, WAL fsync). A function handed to ``run_in_executor`` /
+   ``asyncio.to_thread`` / ``pool.submit`` leaves the loop and is
+   exempt by construction (the call graph records it as an executor
+   target, not a call edge).
+2. **lock-across-await** — an ``await`` inside a *sync* ``with`` block
+   whose context manager is a known ``threading`` lock: the loop
+   parks while holding a lock executor threads contend on — the
+   classic loop↔pool deadlock shape.
+3. **cancellation-atomicity** — ``registries.COMMIT_SECTIONS`` pairs
+   (replay publish→cursor-commit, reap pop→permit-release, DLQ
+   move, manifest commit→delete) must contain no ``await`` between
+   their paired operations, and ``registries.COUNTER_PAIRS``
+   decrements (permit release, in-flight counts) must sit in a
+   ``finally`` so no raise/cancel path leaks them.
+4. **unsupervised-task** — every ``asyncio.create_task`` /
+   ``ensure_future`` result must be stored, awaited, or handed to a
+   supervisor (the PR 13 pattern); a bare expression statement drops
+   the only reference — exceptions vanish and shutdown can't cancel
+   it.
+5. **cross-thread-mutation** — ``registries.THREAD_SHARED`` classes
+   split work across executor pools: attributes that BOTH an
+   executor-side and a loop-side registered function mutate must be
+   protected by one of the entry's named locks on both sides.
+
+A line opts out with a trailing ``# async: ok(<reason>)`` — the reason
+is REQUIRED and should name the supervisor, executor hop, or contract
+that makes the site safe ("trust me" is exactly what this lint bans).
+An empty opt-out is itself a finding. A registry entry whose module or
+function disappeared is a finding naming the missing symbol.
+
+Used two ways, exactly like ``check_hotpath.py``: standalone
+(``python tools/check_async.py`` → exit 1 on findings) and imported by
+the tier-1 suite / ``lint_all.py`` (``lint_async()``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+import astlib  # noqa: E402
+import registries  # noqa: E402
+from astlib import Finding, FunctionNode, ModuleInfo  # noqa: E402
+
+TOOL = "check_async"
+NS = "async"  # the opt-out namespace: "# async: ok(<reason>)"
+
+# direct blocking primitives recognized syntactically (module.attr form)
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"): "time.sleep blocks the event loop",
+    ("os", "fsync"): "os.fsync blocks on disk",
+    ("os", "sync"): "os.sync blocks on disk",
+    ("mmap", "mmap"): "mmap.mmap is sync file I/O",
+    ("shutil", "copyfile"): "shutil.copyfile is sync file I/O",
+    ("shutil", "copytree"): "shutil.copytree is sync file I/O",
+    ("subprocess", "run"): "subprocess.run blocks until exit",
+    ("subprocess", "check_output"): "subprocess.check_output blocks",
+}
+
+# attribute calls that are sync file I/O wherever they appear (pathlib
+# spelling is unambiguous; bare .read()/.write() are not and stay out)
+_BLOCKING_PATH_ATTRS = {
+    "read_text": "Path.read_text is sync file I/O",
+    "write_text": "Path.write_text is sync file I/O",
+    "read_bytes": "Path.read_bytes is sync file I/O",
+    "write_bytes": "Path.write_bytes is sync file I/O",
+}
+
+# methods on known threading objects that park the calling thread
+_BLOCKING_THREAD_METHODS = {"acquire", "wait", "join"}
+
+
+def _is_root_rel(rel: str, root_dirs: Sequence[str]) -> bool:
+    if "*" in root_dirs:
+        return True
+    head = rel.split("/", 1)[0]
+    return head in root_dirs or rel in root_dirs
+
+
+walk_own_body = astlib.walk_body
+
+
+def _self_thread_kind(
+    info: ModuleInfo, cls: Optional[str], node: ast.AST
+) -> Optional[str]:
+    """'Lock'/'Event'/... when ``node`` refers to a known threading
+    object: a module-level name or a ``self.attr`` of ``cls``."""
+    if isinstance(node, ast.Name):
+        return info.thread_objects.get(node.id)
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and cls is not None
+    ):
+        return info.thread_objects.get(f"{cls}.{node.attr}")
+    return None
+
+
+def _blocking_sites(
+    info: ModuleInfo, qual: str
+) -> List[Tuple[int, str]]:
+    """(lineno, description) for every syntactically-recognizable
+    blocking primitive in the function's own body."""
+    fn = info.functions[qual]
+    cls = qual.split(".")[0] if "." in qual else None
+    out: List[Tuple[int, str]] = []
+    for node in walk_own_body(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            desc = _BLOCKING_MODULE_CALLS.get((f.value.id, f.attr))
+            if desc:
+                out.append((node.lineno, desc))
+                continue
+        if isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_PATH_ATTRS:
+                out.append((node.lineno, _BLOCKING_PATH_ATTRS[f.attr]))
+                continue
+            if f.attr in _BLOCKING_THREAD_METHODS:
+                kind = _self_thread_kind(info, cls, f.value)
+                if kind:
+                    out.append((
+                        node.lineno,
+                        f"threading.{kind}.{f.attr}() parks the thread",
+                    ))
+                    continue
+        if isinstance(f, ast.Name) and f.id == "open":
+            out.append((node.lineno, "open() is sync file I/O"))
+    return out
+
+
+# ------------------------------------------------- rule 1: blocking reach
+def _via(graph: astlib.CallGraph, path) -> str:
+    chain = " → ".join(graph.functions[k].qual for k, _ in path)
+    return f" (via {chain})" if chain else ""
+
+
+def _rule_blocking(
+    graph: astlib.CallGraph,
+    root_dirs: Sequence[str],
+    blocking_leaves: Dict[str, str],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    # one finding per blocking SITE (dedup across roots: the fix — an
+    # executor hop or an opt-out — lives at the site, not per caller)
+    seen_sites: Set[Tuple[str, int, str]] = set()
+    seen_leaf_edges: Set[Tuple[str, int]] = set()
+    # a function's blocking sites don't depend on the root reaching it —
+    # memoize so N roots × M reachable functions costs M body walks
+    site_cache: Dict[str, List[Tuple[int, str]]] = {}
+    for root_key, fi in sorted(graph.functions.items()):
+        if not fi.is_async or not _is_root_rel(fi.rel, root_dirs):
+            continue
+        for key, path in graph.walk_sync_reachable(root_key):
+            target = graph.functions.get(key)
+            if target is None:
+                continue
+            info = graph.modules[target.rel]
+            if key != root_key and key in blocking_leaves:
+                # anchor at the first hop out of the coroutine — the
+                # line the developer can reroute or opt out
+                edge_rel, edge_line = fi.rel, path[0][1] if path else 0
+                if (edge_rel, edge_line) in seen_leaf_edges:
+                    continue
+                seen_leaf_edges.add((edge_rel, edge_line))
+                lines = graph.modules[edge_rel].lines
+                status, _r = astlib.opt_out(lines, edge_line, NS)
+                if status == astlib.OPT_OUT_REASON:
+                    continue
+                if status == astlib.OPT_OUT_EMPTY:
+                    findings.append(Finding(
+                        TOOL, "blocking-in-coroutine", edge_rel, edge_line,
+                        f"opt-out names no reason — '# async: ok()' is "
+                        f"not a contract (reaches {target.qual}: "
+                        f"{blocking_leaves[key]})",
+                        qual=fi.qual,
+                    ))
+                    continue
+                findings.append(Finding(
+                    TOOL, "blocking-in-coroutine", edge_rel, edge_line,
+                    f"coroutine reaches {target.qual} "
+                    f"[{blocking_leaves[key]}]{_via(graph, path)} without "
+                    f"an executor hop — route through "
+                    f"run_in_executor/to_thread or "
+                    f"annotate '# async: ok(<why>)'",
+                    qual=fi.qual,
+                ))
+                continue
+            sites = site_cache.get(key)
+            if sites is None:
+                sites = site_cache[key] = _blocking_sites(info, target.qual)
+            for lineno, desc in sites:
+                site = (target.rel, lineno, desc)
+                if site in seen_sites:
+                    continue
+                status, _r = astlib.opt_out(info.lines, lineno, NS)
+                if status == astlib.OPT_OUT_REASON:
+                    # site-level opt-out: suppressed for EVERY root
+                    seen_sites.add(site)
+                    continue
+                if path:
+                    # boundary-level opt-out: the first hop out of this
+                    # coroutine is where the executor-vs-loop decision
+                    # lives — an annotated hop clears every site behind
+                    # it for THIS root only (other roots still check)
+                    edge_rel, edge_line = fi.rel, path[0][1]
+                    est, _er = astlib.opt_out(
+                        graph.modules[edge_rel].lines, edge_line, NS
+                    )
+                    if est == astlib.OPT_OUT_REASON:
+                        continue
+                seen_sites.add(site)
+                if status == astlib.OPT_OUT_EMPTY:
+                    findings.append(Finding(
+                        TOOL, "blocking-in-coroutine", target.rel, lineno,
+                        f"opt-out names no reason — '# async: ok()' is "
+                        f"not a contract ({desc})",
+                        qual=target.qual,
+                    ))
+                    continue
+                where = (
+                    "in coroutine" if key == root_key
+                    else f"reachable from async {fi.qual}"
+                         f"{_via(graph, path)}"
+                )
+                findings.append(Finding(
+                    TOOL, "blocking-in-coroutine", target.rel, lineno,
+                    f"{desc} — {where}; route through "
+                    f"run_in_executor/to_thread, annotate the site or "
+                    f"the first hop with '# async: ok(<why>)'",
+                    qual=target.qual,
+                ))
+    return findings
+
+
+# --------------------------------------------- rule 2: lock-across-await
+def _rule_lock_across_await(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in modules:
+        for qual, fn in info.functions.items():
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            cls = qual.split(".")[0] if "." in qual else None
+            for node in walk_own_body(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                kinds = [
+                    _self_thread_kind(info, cls, item.context_expr)
+                    for item in node.items
+                ]
+                kind = next((k for k in kinds if k), None)
+                if kind is None:
+                    continue
+                # pruned walk: a nested def/lambda body runs off-loop
+                # (executor job, callback), so its awaits don't hold
+                # this lock — but the REST of the statement still must
+                # be scanned (ast.walk + break would abort it)
+                for sub in astlib.walk_stmts(node.body):
+                    if not isinstance(sub, ast.Await):
+                        continue
+                    if astlib.allowed(
+                        info.lines, sub.lineno, NS, require_reason=True
+                    ) or astlib.allowed(
+                        info.lines, node.lineno, NS, require_reason=True
+                    ):
+                        continue
+                    findings.append(Finding(
+                        TOOL, "lock-across-await", info.rel, sub.lineno,
+                        f"await inside 'with <threading.{kind}>' "
+                        f"(held at line {node.lineno}): the loop "
+                        f"parks holding a lock executor threads "
+                        f"contend on — narrow the critical section "
+                        f"or switch to asyncio.Lock",
+                        qual=qual,
+                    ))
+    return findings
+
+
+# ------------------------------------- rule 3: cancellation-atomicity
+def _match_call(node: ast.AST, op: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute) and f.attr == op
+        or isinstance(f, ast.Name) and f.id == op
+    )
+
+
+def _rule_commit_sections(
+    modules: Dict[str, ModuleInfo],
+    commit_sections: Dict[str, List[Dict[str, str]]],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, entries in commit_sections.items():
+        info = modules.get(rel)
+        if info is None:
+            findings.append(Finding(
+                TOOL, "stale-registry", rel, 0,
+                "COMMIT_SECTIONS entry matches no module — stale registry",
+            ))
+            continue
+        for entry in entries:
+            qual, name = entry["function"], entry["name"]
+            begin, end = entry["begin"], entry["end"]
+            fn = info.functions.get(qual)
+            if fn is None:
+                findings.append(Finding(
+                    TOOL, "stale-registry", rel, 0,
+                    f"COMMIT_SECTIONS function '{qual}' not found — "
+                    f"stale registry (missing symbol: {qual})",
+                    qual=qual,
+                ))
+                continue
+            begin_line = min(
+                (n.lineno for n in walk_own_body(fn)
+                 if _match_call(n, begin)),
+                default=None,
+            )
+            if begin_line is None:
+                findings.append(Finding(
+                    TOOL, "stale-registry", rel, fn.lineno,
+                    f"commit section '{name}': begin op '{begin}' not "
+                    f"found in {qual} — stale registry "
+                    f"(missing symbol: {begin})",
+                    qual=qual,
+                ))
+                continue
+            end_line = min(
+                (n.lineno for n in walk_own_body(fn)
+                 if _match_call(n, end) and n.lineno > begin_line),
+                default=None,
+            )
+            if end_line is None:
+                findings.append(Finding(
+                    TOOL, "stale-registry", rel, fn.lineno,
+                    f"commit section '{name}': end op '{end}' not found "
+                    f"after '{begin}' in {qual} — stale registry "
+                    f"(missing symbol: {end})",
+                    qual=qual,
+                ))
+                continue
+            for node in walk_own_body(fn):
+                if not isinstance(node, ast.Await):
+                    continue
+                if not (begin_line < node.lineno < end_line):
+                    continue
+                if astlib.allowed(
+                    info.lines, node.lineno, NS, require_reason=True
+                ):
+                    continue
+                findings.append(Finding(
+                    TOOL, "cancellation-atomicity", rel, node.lineno,
+                    f"await inside commit section '{name}' "
+                    f"({begin}@{begin_line} → {end}@{end_line}): a "
+                    f"cancellation here splits the pair — move the "
+                    f"await outside or make the section await-free",
+                    qual=qual,
+                ))
+    return findings
+
+
+def _finally_nodes(fn: FunctionNode) -> Set[int]:
+    """ids of every AST node under any ``finally`` block in the
+    function's own body."""
+    out: Set[int] = set()
+    for node in walk_own_body(fn):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    out.add(id(sub))
+    return out
+
+
+def _rule_counter_pairs(
+    modules: Dict[str, ModuleInfo],
+    counter_pairs: Dict[str, List[Dict[str, str]]],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, entries in counter_pairs.items():
+        info = modules.get(rel)
+        if info is None:
+            findings.append(Finding(
+                TOOL, "stale-registry", rel, 0,
+                "COUNTER_PAIRS entry matches no module — stale registry",
+            ))
+            continue
+        for entry in entries:
+            qual, name, op = entry["function"], entry["name"], entry["op"]
+            kind = entry.get("kind", "call")
+            fn = info.functions.get(qual)
+            if fn is None:
+                findings.append(Finding(
+                    TOOL, "stale-registry", rel, 0,
+                    f"COUNTER_PAIRS function '{qual}' not found — stale "
+                    f"registry (missing symbol: {qual})",
+                    qual=qual,
+                ))
+                continue
+            protected = _finally_nodes(fn)
+            sites: List[ast.AST] = []
+            for node in walk_own_body(fn):
+                if kind == "call" and _match_call(node, op):
+                    sites.append(node)
+                elif (
+                    kind == "augassign"
+                    and isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.target, ast.Attribute)
+                    and node.target.attr == op
+                ):
+                    sites.append(node)
+            if not sites:
+                findings.append(Finding(
+                    TOOL, "stale-registry", rel, fn.lineno,
+                    f"counter pair '{name}': no '{op}' site in {qual} — "
+                    f"stale registry (missing symbol: {op})",
+                    qual=qual,
+                ))
+                continue
+            for node in sites:
+                if id(node) in protected:
+                    continue
+                if astlib.allowed(
+                    info.lines, node.lineno, NS, require_reason=True
+                ):
+                    continue
+                findings.append(Finding(
+                    TOOL, "cancellation-atomicity", rel, node.lineno,
+                    f"'{op}' ({name}) outside a finally: a raise or "
+                    f"cancellation on this path leaks the pair — move "
+                    f"the decrement into the finally or annotate "
+                    f"'# async: ok(<why this path cannot raise>)'",
+                    qual=qual,
+                ))
+    return findings
+
+
+# ---------------------------------------- rule 4: unsupervised-task
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _rule_unsupervised_task(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in modules:
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            call = node.value
+            f = call.func
+            spawns = (
+                isinstance(f, ast.Attribute) and f.attr in _TASK_SPAWNERS
+                or isinstance(f, ast.Name) and f.id in _TASK_SPAWNERS
+            )
+            if not spawns:
+                continue
+            name = f.attr if isinstance(f, ast.Attribute) else f.id
+            status, _r = astlib.opt_out(info.lines, node.lineno, NS)
+            if status == astlib.OPT_OUT_REASON:
+                continue
+            if status == astlib.OPT_OUT_EMPTY:
+                findings.append(Finding(
+                    TOOL, "unsupervised-task", info.rel, node.lineno,
+                    f"opt-out names no supervisor — '# async: ok()' is "
+                    f"not a contract ({name} result dropped)",
+                ))
+                continue
+            findings.append(Finding(
+                TOOL, "unsupervised-task", info.rel, node.lineno,
+                f"asyncio.{name}(...) result dropped — a fire-and-forget "
+                f"task loses its exception and escapes shutdown; store "
+                f"it, await it, or hand it to a supervisor "
+                f"(runtime.lifecycle SupervisedTask pattern)",
+            ))
+    return findings
+
+
+# ------------------------------------ rule 5: cross-thread-mutation
+def _mutations(
+    info: ModuleInfo, qual: str, locks: Sequence[str]
+) -> Dict[str, List[Tuple[int, bool]]]:
+    """attr → [(lineno, locked)] for every ``self.attr`` assignment /
+    aug-assignment in the function's own body. ``locked`` is True when
+    the site sits inside a ``with self.<lock>`` for a registry lock."""
+    fn = info.functions.get(qual)
+    out: Dict[str, List[Tuple[int, bool]]] = {}
+    if fn is None:
+        return out
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        now_locked = locked
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if (
+                    isinstance(ce, ast.Attribute)
+                    and isinstance(ce.value, ast.Name)
+                    and ce.value.id == "self"
+                    and ce.attr in locks
+                ):
+                    now_locked = True
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out.setdefault(t.attr, []).append((node.lineno, now_locked))
+        for child in ast.iter_child_nodes(node):
+            visit(child, now_locked)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+    return out
+
+
+def _rule_cross_thread(
+    modules: Dict[str, ModuleInfo],
+    thread_shared: Dict[str, List[Dict[str, object]]],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, entries in thread_shared.items():
+        info = modules.get(rel)
+        if info is None:
+            findings.append(Finding(
+                TOOL, "stale-registry", rel, 0,
+                "THREAD_SHARED entry matches no module — stale registry",
+            ))
+            continue
+        for entry in entries:
+            locks: Sequence[str] = entry.get("locks", ())  # type: ignore
+            exec_fns: Sequence[str] = entry["executor_fns"]  # type: ignore
+            loop_fns: Sequence[str] = entry["loop_fns"]  # type: ignore
+            missing = [
+                q for q in [*exec_fns, *loop_fns]
+                if q not in info.functions
+            ]
+            for q in missing:
+                findings.append(Finding(
+                    TOOL, "stale-registry", rel, 0,
+                    f"THREAD_SHARED function '{q}' not found — stale "
+                    f"registry (missing symbol: {q})",
+                    qual=q,
+                ))
+            exec_muts: Dict[str, List[Tuple[int, bool]]] = {}
+            for q in exec_fns:
+                for attr, sites in _mutations(info, q, locks).items():
+                    exec_muts.setdefault(attr, []).extend(
+                        (q, ln, lk) for ln, lk in sites  # type: ignore
+                    )
+            for q in loop_fns:
+                for attr, sites in _mutations(info, q, locks).items():
+                    if attr not in exec_muts:
+                        continue
+                    for ln, locked in sites:
+                        if locked:
+                            continue
+                        bad_exec = [
+                            (eq, eln) for eq, eln, elk in exec_muts[attr]
+                            if not elk
+                        ]
+                        if not bad_exec:
+                            continue
+                        if astlib.allowed(
+                            info.lines, ln, NS, require_reason=True
+                        ):
+                            continue
+                        eq, eln = bad_exec[0]
+                        findings.append(Finding(
+                            TOOL, "cross-thread-mutation", rel, ln,
+                            f"'self.{attr}' is mutated here (loop side) "
+                            f"AND in executor-side {eq} (line {eln}) "
+                            f"without a registered lock "
+                            f"({', '.join(locks) or 'none registered'})"
+                            f" — guard both sides or annotate "
+                            f"'# async: ok(<why>)'",
+                            qual=q,
+                        ))
+    return findings
+
+
+# ------------------------------------------------------------- entrypoint
+def lint_async(
+    src_root=None,
+    root_dirs: Optional[Sequence[str]] = None,
+    blocking_leaves: Optional[Dict[str, str]] = None,
+    commit_sections: Optional[Dict[str, List[Dict[str, str]]]] = None,
+    counter_pairs: Optional[Dict[str, List[Dict[str, str]]]] = None,
+    thread_shared: Optional[Dict[str, List[Dict[str, object]]]] = None,
+) -> List[Finding]:
+    """Run all five rules over the package (or a fixture tree); returns
+    findings (empty = clean). Every parameter defaults to the shipped
+    ``tools/registries.py`` entry."""
+    modules = astlib.walk_package(src_root)
+    by_rel = {m.rel: m for m in modules}
+    graph = astlib.get_call_graph(src_root)
+    findings: List[Finding] = []
+    findings += _rule_blocking(
+        graph,
+        root_dirs if root_dirs is not None else registries.ASYNC_ROOT_DIRS,
+        blocking_leaves if blocking_leaves is not None
+        else registries.BLOCKING_LEAVES,
+    )
+    findings += _rule_lock_across_await(modules)
+    findings += _rule_commit_sections(
+        by_rel,
+        commit_sections if commit_sections is not None
+        else registries.COMMIT_SECTIONS,
+    )
+    findings += _rule_counter_pairs(
+        by_rel,
+        counter_pairs if counter_pairs is not None
+        else registries.COUNTER_PAIRS,
+    )
+    findings += _rule_unsupervised_task(modules)
+    findings += _rule_cross_thread(
+        by_rel,
+        thread_shared if thread_shared is not None
+        else registries.THREAD_SHARED,
+    )
+    findings.sort(key=lambda f: (f.rel, f.lineno, f.rule))
+    return findings
+
+
+def main() -> int:
+    findings = lint_async()
+    for f in findings:
+        print(f"check_async: {f}", file=sys.stderr)
+    n_rules = 5
+    print(
+        f"check_async: {n_rules} rules over the package call graph, "
+        f"{len(findings)} finding(s)"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

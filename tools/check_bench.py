@@ -32,7 +32,13 @@ import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+import astlib  # noqa: E402
+
+REPO_ROOT = str(astlib.REPO_ROOT)
 
 THROUGHPUT_TOL = 0.10   # fresh may sit up to 10% below baseline
 P99_TOL = 0.25          # fresh may sit up to 25% above baseline

@@ -50,6 +50,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+import registries  # noqa: E402
 
 MAX_DOTS_PER_SCAN_STEP = 2
 
@@ -59,33 +64,18 @@ MAX_DOTS_PER_SCAN_STEP = 2
 # 1 — measured 1/2 for the LSTM/GRU families at ISSUE 13 time
 MAX_DOTS_PER_TRAIN_SCAN_STEP = 3
 
-# family → config overrides small enough to trace instantly; every entry
-# must exist in MODEL_REGISTRY with a score_stacked contract
-REGISTRY: Dict[str, dict] = {
-    "lstm_ad": {"window": 8, "hidden": 8},
-    "deepar": {"hidden": 8},
-    "transformer": {"context": 8, "dim": 16, "depth": 1, "heads": 2},
-}
-
-# the continual-learning train lane's registry: every entry must also
-# carry a loss_stacked contract — its masked-mean GRADIENT is traced at
-# S=2 and S=4 with the same invariants (bounded scan-body dots, slot-
-# count-invariant total, zero collectives): a refactor that resurrects
-# the per-slot vmap in the backward pass would silently hand the MXU S
-# small matmul chains per train step again.
-TRAIN_REGISTRY: Dict[str, dict] = dict(REGISTRY)
-
-# media decode kernels (ops/dct.py): the compressed-wire ViT leg fuses
-# JPEG reconstruction into the classifier jit. Traced at B=2 and B=4
-# with the same invariants as the scoring kernels — the dot count must
-# be BATCH-invariant (a per-frame Python loop over the batch doubles
-# it) and the whole program must contain zero collective primitives
-# (the PR 5 gotcha: one collective gang-schedules every concurrent
-# classify dispatch). Entries: name → (subsampling, truncation k).
-DCT_REGISTRY: Dict[str, Tuple[int, int]] = {
-    "vit_dct_420": (2, 16),
-    "vit_dct_444": (1, 64),
-}
+# single-sourced in tools/registries.py (imported by every analyzer);
+# re-exported here for the tier-1 suite and backwards compatibility.
+# REGISTRY: family → config overrides small enough to trace instantly;
+# every entry must exist in MODEL_REGISTRY with a score_stacked
+# contract. TRAIN_REGISTRY additionally requires loss_stacked (the
+# masked-mean GRADIENT is traced at S=2/S=4 with the same invariants).
+# DCT_REGISTRY: media decode variants traced at B=2/B=4 — dot count
+# must be BATCH-invariant and the program collective-free (the PR 5
+# gotcha: one collective gang-schedules every concurrent dispatch).
+REGISTRY: Dict[str, dict] = registries.FUSION_REGISTRY
+TRAIN_REGISTRY: Dict[str, dict] = registries.TRAIN_REGISTRY
+DCT_REGISTRY: Dict[str, Tuple[int, int]] = registries.DCT_REGISTRY
 
 _W, _B, _K = 8, 4, 2  # traced window/batch/K-step shape
 

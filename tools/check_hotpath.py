@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Hot-path allocation lint (AST).
+"""Hot-path allocation lint (AST), on the shared ``astlib`` core.
 
 The zero-copy feed contract (docs/PERFORMANCE.md) says the scoring and
 media feed paths move rows as numpy slices into preallocated buffers —
@@ -7,8 +7,8 @@ never as Python lists that are re-converted to arrays per flush. Round 5
 measured why this matters: at 1M+ ev/s every per-flush ``np.asarray``
 over freshly built lists is allocation + a Python-level copy loop on the
 single host core. This lint keeps the invariant structural instead of
-tribal: it parses the hot-path functions named in ``HOT_PATHS`` below
-and flags
+tribal: it parses the hot-path functions named in
+``registries.HOT_PATHS`` and flags
 
 - **list accumulators**: a name bound to a list literal that later takes
   ``.append(...)`` inside a loop (the classic per-row collector);
@@ -29,8 +29,10 @@ and flags
   path").
 
 A line may opt out with a trailing ``# hotpath: ok`` comment (for a
-cold-path branch living inside a hot function). A registry entry whose
-function disappeared is itself a finding — stale registries rot lints.
+cold-path branch living inside a hot function) — the unified grammar
+(``astlib.opt_out``; a reason is welcome but not required here). A
+registry entry whose function disappeared is itself a finding — stale
+registries rot lints (``astlib.stale_registry``).
 
 Used two ways, exactly like ``check_queues.py``: standalone
 (``python tools/check_hotpath.py`` → exit 1 on findings) and imported by
@@ -40,97 +42,25 @@ the tier-1 suite (``lint_hotpaths()``).
 from __future__ import annotations
 
 import ast
+import os
 import sys
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
-REPO_ROOT = Path(__file__).resolve().parent.parent
-SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
 
-# module (relative to sitewhere_tpu/) → hot functions ("name" for
-# module-level, "Class.method" for methods). Point this at the functions
-# that run per flush / per enqueue at full ingest rate — NOT at cold
-# paths (drain, failover, teardown), which may keep convenient idioms.
-HOT_PATHS: Dict[str, List[str]] = {
-    "pipeline/inference.py": [
-        "TpuInferenceService._enqueue_batch",
-        # the slice-routed flush + completion path (multi-chip serving):
-        # every function here runs per flush per SLICE at full rate
-        "TpuInferenceService._flush_slice",
-        "TpuInferenceService._resolve_rows",
-        "TpuInferenceService._reap_loop",
-        "TpuInferenceService._resolve_flush",
-        "TpuInferenceService._canary_compare",
-        "TpuInferenceService._deliver_gauge",
-        # the continual-learning train lane: feed intake + microbatch
-        # packing + the per-pass lane tick all run at full ingest /
-        # loop rate — rows must stay columnar, and the loss device
-        # array must resolve via the reaper, never a blocking asarray
-        "TpuInferenceService._enqueue_train_batch",
-        "TpuInferenceService._pack_train",
-        "TpuInferenceService._train_lane_tick",
-        "TpuInferenceService._dispatch_train",
-        "_LaneRing.push",
-        "_LaneRing.pop_into",
-        "_SliceFence.park",
-    ],
-    # the score-quality feed runs once per resolved flush at full ingest
-    # rate: sketches fold in as vectorized 64-bin adds per touched slot,
-    # never per-row Python (docs/OBSERVABILITY.md "Score health")
-    "runtime/scorehealth.py": [
-        "ScoreHealth.ingest_sketch",
-        "ScoreHealth.note_unscored",
-        "ScoreHealth.canary_note",
-    ],
-    "pipeline/media.py": [
-        "MediaClassificationPipeline.submit_chunk",
-        "MediaClassificationPipeline._classify_and_publish",
-        "MediaClassificationPipeline._classify_compressed",
-        "MediaClassificationPipeline._finish_classify",
-        # the compressed-wire decode stage runs once per classify batch
-        # at camera rate: coefficient packing must stay one vectorized
-        # copy per component, frame fan-out rides preallocated
-        # index/keep arrays (per-FRAME loops are the unit here — the
-        # per-EVENT ban still holds)
-        "MediaClassificationPipeline._decode_batch",
-        "_FrameRing.reserve",
-        "_FrameRing.pop_into",
-        "_ByteRing.append",
-        "_ByteRing.pop_into",
-    ],
-    # the native decode binding runs per frame on the decode pool; its
-    # job is pointer hand-off — any per-coefficient Python here would
-    # multiply by 64 blocks × rate
-    "native/jpegwire.py": [
-        "decode_into",
-    ],
-    # the on-device decode kernels trace under jit (tools/check_fusion.py
-    # asserts batch-invariant lowering); at the Python layer they must
-    # stay free of per-frame/per-block list building
-    "ops/dct.py": [
-        "decode_frames",
-        "idct_plane",
-        "upsample2x",
-        "ycbcr_to_rgb",
-    ],
-    "core/batch.py": [
-        "make_event_ids",
-        "encode_batch_wire",
-    ],
-    # the storage/replay axis runs at feed-path rates (docs/STORAGE.md):
-    # segment scans and replay staging must move rows as vectorized
-    # column picks, never as per-event Python objects
-    "storage/segstore.py": [
-        "SegmentColumns.append_batch",
-        "SegmentColumns.scan",
-        "slice_columns",
-    ],
-    "pipeline/replay.py": [
-        "_slice_to_batch",
-        "ReplayEngine._scan_loop",
-        "ReplayEngine._pump_loop",
-    ],
-}
+import astlib  # noqa: E402
+import registries  # noqa: E402
+
+REPO_ROOT = astlib.REPO_ROOT
+SRC_ROOT = astlib.SRC_ROOT
+NS = "hotpath"
+
+# single-sourced in tools/registries.py (imported by every analyzer);
+# re-exported here for the tier-1 suite and backwards compatibility
+HOT_PATHS: Dict[str, List[str]] = registries.HOT_PATHS
 
 _NP_CONVERTERS = {"asarray", "array", "stack", "concatenate", "fromiter"}
 
@@ -153,12 +83,6 @@ def _is_np_attr(node: ast.AST, attrs: set) -> bool:
     )
 
 
-def _allowed(lines: List[str], lineno: int) -> bool:
-    if 1 <= lineno <= len(lines):
-        return "# hotpath: ok" in lines[lineno - 1]
-    return False
-
-
 class _FnScanner(ast.NodeVisitor):
     """Scan ONE hot function body for the banned patterns."""
 
@@ -175,7 +99,7 @@ class _FnScanner(ast.NodeVisitor):
         return name in self.device_names or name.endswith("_dev")
 
     def _finding(self, node: ast.AST, msg: str) -> None:
-        if not _allowed(self.lines, node.lineno):
+        if not astlib.allowed(self.lines, node.lineno, NS):
             self.findings.append(
                 f"{self.rel}:{node.lineno}: [{self.qual}] {msg}"
             )
@@ -249,18 +173,6 @@ class _FnScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _function_index(tree: ast.Module) -> Dict[str, ast.AST]:
-    out: Dict[str, ast.AST] = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out[node.name] = node
-        elif isinstance(node, ast.ClassDef):
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    out[f"{node.name}.{sub.name}"] = sub
-    return out
-
-
 def lint_hotpaths(
     hot_paths: Optional[Dict[str, List[str]]] = None,
     src_root: Optional[Path] = None,
@@ -273,28 +185,25 @@ def lint_hotpaths(
         if not path.exists():
             findings.append(f"{rel}: registered module does not exist")
             continue
-        text = path.read_text()
-        lines = text.splitlines()
-        tree = ast.parse(text)
-        index = _function_index(tree)
+        info = astlib.get_module(path, rel)
         for qual in quals:
-            fn = index.get(qual)
+            fn = info.functions.get(qual)
             if fn is None:
                 findings.append(
                     f"{rel}: registered hot function '{qual}' not found — "
                     "stale HOT_PATHS registry"
                 )
                 continue
-            scanner = _FnScanner(rel, qual, lines)
+            scanner = _FnScanner(rel, qual, info.lines)
             for stmt in fn.body:
                 scanner.visit(stmt)
             findings.extend(scanner.findings)
         # module-wide: np.char.* is a hidden per-row Python loop
-        for node in ast.walk(tree):
+        for node in ast.walk(info.tree):
             if isinstance(node, ast.Attribute) and _is_np_attr(
                 node.value, {"char"}
             ):
-                if not _allowed(lines, node.lineno):
+                if not astlib.allowed(info.lines, node.lineno, NS):
                     findings.append(
                         f"{rel}:{node.lineno}: np.char.{node.attr} is a "
                         "per-row Python loop in disguise — see "

@@ -28,17 +28,29 @@ stdin) and fails on malformed exposition lines:
   a rolling window, never monotonic): one declared as a counter — or
   wearing the ``_total`` suffix — is a finding.
 
-Used two ways: ``python tools/check_metrics.py`` boots a small instance,
-drives events through the pipeline, and lints the scrape (exit 1 on
-findings); the tier-1 suite imports ``lint_exposition`` and runs it
-against a live instance (tests/test_observability.py).
+Used three ways: ``python tools/check_metrics.py`` boots a small
+instance, drives events through the pipeline, and lints the scrape
+(exit 1 on findings); the tier-1 suite imports ``lint_exposition`` and
+runs it against a live instance (tests/test_observability.py); and
+``tools/lint_all.py`` runs the live-scrape mode as one of the seven
+analyzers (skipped under ``--fast`` — the exposition rules are pure
+string checks, but the scrape needs a booted instance).
 """
 
 from __future__ import annotations
 
+import os
 import re
 import sys
 from typing import Dict, List, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+import astlib  # noqa: E402
+
+REPO_ROOT = str(astlib.REPO_ROOT)
 
 NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -287,7 +299,6 @@ async def _scrape_live() -> str:
 def main(argv=None) -> int:
     import argparse
     import asyncio
-    import os
 
     ap = argparse.ArgumentParser()
     ap.add_argument("path", nargs="?", default="",
@@ -302,9 +313,8 @@ def main(argv=None) -> int:
     else:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         # runnable from anywhere: the repo root is tools/..
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        if repo_root not in sys.path:
-            sys.path.insert(0, repo_root)
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
         text = asyncio.run(_scrape_live())
     errors = lint_exposition(text)
     for e in errors:

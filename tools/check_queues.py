@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Bounded-queue observability lint.
+"""Bounded-queue observability lint, on the shared ``astlib`` core.
 
 Overload control is only trustworthy if every bounded queue in the
 codebase is observable: a queue that can fill must expose a **depth
@@ -10,8 +10,8 @@ cannot be audited (docs/ROBUSTNESS.md "Overload & degradation").
 The lint scans ``sitewhere_tpu/`` for bounded-queue construction sites
 (``asyncio.Queue(maxsize=...)``, ``runtime.overload``'s
 ``PriorityClassQueue``, and the feed path's bounded rings —
-``_LaneRing``/``_FrameRing``) and checks each against the REGISTRY
-below:
+``_LaneRing``/``_FrameRing``) and checks each against
+``registries.QUEUE_REGISTRY``:
 
 - every site must be registered with the metric names of its depth
   gauge and either a shed/expired counter or — for rings that
@@ -34,111 +34,24 @@ the tier-1 suite (``lint_queues()``).
 
 from __future__ import annotations
 
+import os
 import re
 import sys
 from pathlib import Path
 from typing import Dict, List, Tuple
 
-REPO_ROOT = Path(__file__).resolve().parent.parent
-SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
 
-# (relative file, construction regex) → declared observability.
-# depth_gauge / shed_counter are metric family names as passed to
-# MetricsRegistry (labeled families without the exposition suffix).
-REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
-    ("pipeline/sources.py", r"PriorityClassQueue\(maxsize="): {
-        "queue": "receiver ingest queue (priority-classed admission)",
-        "depth_gauge": "receiver_queue_depth",
-        "shed_counter": "receiver_shed_total",
-    },
-    ("pipeline/media.py", r"_FrameRing\("): {
-        "queue": "media frame ring (newest-frame-wins shedding; the "
-                 "legacy/kill-switch decoded-pixel ring)",
-        "depth_gauge": "media_queue_depth",
-        "shed_counter": "media_frames_shed_total",
-    },
-    ("pipeline/media.py", r"_ByteRing\("): {
-        "queue": "compressed media byte ring (variable-length frame "
-                 "spans in one preallocated arena; newest-frame-wins "
-                 "shedding on index OR byte exhaustion)",
-        "depth_gauge": "media_queue_depth",
-        # the byte watermark: arena_bytes bounds RESIDENT bytes, so the
-        # byte gauge — not frame count — is the capacity signal here
-        "bytes_gauge": "media_ring_bytes",
-        "shed_counter": "media_frames_shed_total",
-    },
-    ("pipeline/inference.py", r"ThreadPoolExecutor\("): {
-        "queue": "deliver materialization pool (one job per in-flight "
-                 "flush transfer; occupancy bounded by the per-slice "
-                 "max_inflight semaphores that also bound the reap "
-                 "queues feeding it)",
-        "depth_gauge": "tpu_inference_deliver_inflight",
-        # the pool never sheds: a full in-flight window backpressures
-        # the NEXT flush at the semaphore, same bound as the reap FIFO
-        "backpressure_counter": "tpu_inference.deliver_backpressure",
-    },
-    ("pipeline/media.py", r"ThreadPoolExecutor\("): {
-        "queue": "media native-decode pool (per-WORKER range jobs over "
-                 "a batch's frames; gauge ceiling = max_inflight × "
-                 "decode_workers concurrent jobs)",
-        "depth_gauge": "media_decode_inflight",
-        # the pool never sheds: a saturated pool queues jobs and the
-        # classify semaphore backpressures the batching loop (counted
-        # when a submission lands behind a fully busy pool)
-        "backpressure_counter": "media.decode_backpressure",
-    },
-    ("pipeline/inference.py", r"_LaneRing\("): {
-        "queue": "scoring lane rings (pending rows per (slot, data-shard))",
-        "depth_gauge": "tpu_inference_lane_rows",
-        # lanes never shed: the per-tenant watermark backpressures intake
-        # into the bus (where lag is a gauge and drives overload credit)
-        "backpressure_counter": "tpu_inference.lane_backpressure",
-    },
-    ("pipeline/inference.py", r"_TrainLaneRing\("): {
-        "queue": "continual-learning train lane rings (replay-fed "
-                 "training rows per (slot, data-shard); watermark "
-                 "2 × replay_microbatch)",
-        "depth_gauge": "tpu_inference_train_rows",
-        # the lane never sheds admitted rows: past the watermark the
-        # feed CONSUMER parks (counted) and the backlog stays in the bus
-        # topic, which the replay pump's overload arbitration already
-        # throttles at the producer side
-        "backpressure_counter": "tpu_inference.train_feed_backpressure",
-    },
-    ("pipeline/replay.py", r"_ReplayRing\("): {
-        "queue": "replay intake ring (prepared scan slices between the "
-                 "segment scanner and the publish pump)",
-        "depth_gauge": "replay_ring_depth",
-        # replay never sheds: a throttled pump backpressures the disk
-        # scanner through the ring instead of buffering the store
-        "backpressure_counter": "replay.ring_backpressure",
-    },
-    ("pipeline/inference.py", r"_ReapQueue\("): {
-        "queue": "deliver reap queues (in-flight flush completions per "
-                 "(family, mesh slice); bounded by the max_inflight "
-                 "semaphore)",
-        "depth_gauge": "tpu_inference_deliver_inflight",
-        # per-family labeled variant beside the legacy aggregate: the
-        # queues ARE per-(family, slice), so a wedged family shows here
-        # while the aggregate hides it behind healthy siblings
-        "family_depth_gauge": "tpu_inference_deliver_inflight_family",
-        # ...and the per-DEVICE variant (multi-chip serving): one slow
-        # chip's queue depth must be visible as THAT chip's, not
-        # averaged into the fleet
-        "device_depth_gauge": "tpu_inference_deliver_inflight_device",
-        # completions never shed: a full in-flight window backpressures
-        # the NEXT flush at the semaphore (counted before the acquire)
-        "backpressure_counter": "tpu_inference.deliver_backpressure",
-    },
-    ("pipeline/inference.py", r"\[_StagingSet\("): {
-        "queue": "per-(family, mesh-slice, bucket) rotating flush "
-                 "staging sets (bounded by staging_slots per rotation)",
-        "depth_gauge": "tpu_inference_staging_sets",
-        # staging never sheds: recycling a set whose async h2d copy is
-        # still in flight BLOCKS until the transfer lands (counted)
-        "backpressure_counter": "tpu_inference.stage_reuse_waits",
-    },
-}
+import astlib  # noqa: E402
+import registries  # noqa: E402
+
+REPO_ROOT = astlib.REPO_ROOT
+SRC_ROOT = astlib.SRC_ROOT
+
+# single-sourced in tools/registries.py; re-exported for compatibility
+REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = registries.QUEUE_REGISTRY
 
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
@@ -161,10 +74,15 @@ def lint_queues() -> List[str]:
     """Scan the codebase; returns findings (empty = every bounded queue
     is registered and observable)."""
     findings: List[str] = []
-    texts = {
-        str(p.relative_to(SRC_ROOT)): p.read_text()
-        for p in _source_files()
-    }
+    texts: Dict[str, str] = {}
+    for p in _source_files():
+        if "__pycache__" in p.parts:
+            continue
+        try:
+            rel = str(p.relative_to(SRC_ROOT))
+        except ValueError:
+            rel = p.name
+        texts[rel] = astlib.get_module(p, rel).text
     # 1) every bounded-queue site must be registered — PER LINE, not per
     # file: a new pool/ring construction in a file that already has an
     # unrelated registry entry must still surface (the old per-file
@@ -179,8 +97,9 @@ def lint_queues() -> List[str]:
             ):
                 findings.append(
                     f"{rel}:{lineno}: unregistered bounded queue "
-                    f"({line.strip()[:60]!r}) — add a tools/check_queues.py "
-                    f"REGISTRY entry with its depth gauge + shed counter"
+                    f"({line.strip()[:60]!r}) — add a "
+                    f"tools/registries.py QUEUE_REGISTRY entry with its "
+                    f"depth gauge + shed counter"
                 )
     # 2) registry entries must match a live site and live metrics
     for (rel, pattern), decl in REGISTRY.items():

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Deadline-supervision lint (AST).
+"""Deadline-supervision lint (AST), on the shared ``astlib`` core.
 
 The flush supervisor's contract (docs/ROBUSTNESS.md "Device fault
 domains") is that NO hot-path await on a device future can wedge a
@@ -10,18 +10,20 @@ accrete — a new lane adds one more ``ensure_host_future`` /
 ``run_in_executor`` materialization and nothing guarantees it got a
 deadline. This lint keeps the invariant structural:
 
-- every ``await`` inside a function registered in ``SUPERVISED_PATHS``
-  whose expression touches a watched call — ``ensure_host_future``
-  (the reaper's materialization), ``run_in_executor`` (executor
-  materializations), or ``asyncio.wait`` (the reaper's completion
-  race) — must be DIRECTLY wrapped in ``asyncio.wait_for(...)``, or
+- every ``await`` inside a function registered in
+  ``registries.SUPERVISED_PATHS`` whose expression touches a watched
+  call — ``ensure_host_future`` (the reaper's materialization),
+  ``run_in_executor`` (executor materializations), or ``asyncio.wait``
+  (the reaper's completion race) — must be DIRECTLY wrapped in
+  ``asyncio.wait_for(...)``, or
 - carry a trailing ``# supervised: ok(<owning watchdog>)`` opt-out
   NAMING the mechanism that bounds it (e.g. the flush-deadline timer
   that rides inside the reaper's race). An empty opt-out is a finding
-  — "trust me" is exactly what this lint exists to ban.
+  — "trust me" is exactly what this lint exists to ban. (The unified
+  grammar: ``astlib.opt_out``.)
 
 A registry entry whose function disappeared is itself a finding (stale
-registries rot lints — the check_hotpath rule).
+registries rot lints — the check_hotpath rule, shared via astlib).
 
 Used two ways, exactly like ``check_queues.py``: standalone
 (``python tools/check_supervised.py`` → exit 1 on findings) and
@@ -31,35 +33,26 @@ imported by the tier-1 suite (``lint_supervised()``).
 from __future__ import annotations
 
 import ast
-import re
+import os
 import sys
-from pathlib import Path
 from typing import Dict, List, Optional
 
-REPO_ROOT = Path(__file__).resolve().parent.parent
-SRC_ROOT = REPO_ROOT / "sitewhere_tpu"
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
 
-# module (relative to sitewhere_tpu/) → hot-path functions whose device
-# awaits must be deadline-supervised ("Class.method" or bare name).
-SUPERVISED_PATHS: Dict[str, List[str]] = {
-    "pipeline/inference.py": [
-        # the completion reaper's race over in-flight heads
-        "TpuInferenceService._reap_loop",
-        # per-flush materialization (serve + train lanes)
-        "TpuInferenceService._resolve_flush",
-        # probation probes on quarantined slices
-        "TpuInferenceService._dispatch_probe",
-    ],
-    "pipeline/media.py": [
-        # the classify readback (media lane)
-        "MediaClassificationPipeline._finish_classify",
-    ],
-}
+import astlib  # noqa: E402
+import registries  # noqa: E402
+
+REPO_ROOT = astlib.REPO_ROOT
+SRC_ROOT = astlib.SRC_ROOT
+NS = "supervised"
+
+# single-sourced in tools/registries.py; re-exported for compatibility
+SUPERVISED_PATHS: Dict[str, List[str]] = registries.SUPERVISED_PATHS
 
 # call names whose await is a device-future / reap wait
-WATCHED_NAMES = ("ensure_host_future", "run_in_executor")
-
-OPT_OUT_RE = re.compile(r"#\s*supervised:\s*ok\(([^)]*)\)")
+WATCHED_NAMES = registries.SUPERVISED_WATCHED_NAMES
 
 
 def _is_asyncio_wait(node: ast.AST) -> bool:
@@ -92,31 +85,17 @@ def _is_wait_for(expr: ast.AST) -> bool:
     ) or (isinstance(f, ast.Name) and f.id == "wait_for")
 
 
-def _functions(tree: ast.Module) -> Dict[str, ast.AST]:
-    out: Dict[str, ast.AST] = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out[node.name] = node
-        elif isinstance(node, ast.ClassDef):
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    out[f"{node.name}.{sub.name}"] = sub
-    return out
-
-
 def lint_source(text: str, functions: List[str], rel: str) -> List[str]:
     """Lint one module's source for the registered functions; returns
     findings. Split out so tests can exercise the rule on synthetic
     sources."""
     findings: List[str] = []
     try:
-        tree = ast.parse(text)
+        info = astlib.ModuleInfo.from_source(text, rel)
     except SyntaxError as exc:
         return [f"{rel}: unparseable ({exc})"]
-    lines = text.splitlines()
-    defs = _functions(tree)
     for fname in functions:
-        fn = defs.get(fname)
+        fn = info.functions.get(fname)
         if fn is None:
             findings.append(
                 f"{rel}: registered function '{fname}' not found — stale "
@@ -131,16 +110,15 @@ def lint_source(text: str, functions: List[str], rel: str) -> List[str]:
                 continue
             if _is_wait_for(node.value):
                 continue  # deadline-supervised at the await itself
-            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-            m = OPT_OUT_RE.search(line)
-            if m is None:
+            status, _reason = astlib.opt_out(info.lines, node.lineno, NS)
+            if status == astlib.OPT_OUT_MISSING:
                 findings.append(
                     f"{rel}:{node.lineno}: {fname} awaits {watched} "
                     f"without a deadline — wrap in asyncio.wait_for(...) "
                     f"or name the owning watchdog with "
                     f"'# supervised: ok(<watchdog>)'"
                 )
-            elif not m.group(1).strip():
+            elif status == astlib.OPT_OUT_EMPTY:
                 findings.append(
                     f"{rel}:{node.lineno}: {fname} opt-out names no "
                     f"watchdog — '# supervised: ok()' is not a guarantee"
@@ -157,7 +135,8 @@ def lint_supervised() -> List[str]:
                 f"registry entry for {rel} matches no file — stale registry"
             )
             continue
-        findings.extend(lint_source(path.read_text(), functions, rel))
+        info = astlib.get_module(path, rel)
+        findings.extend(lint_source(info.text, functions, rel))
     return findings
 
 

@@ -18,6 +18,12 @@
 #     force-resolve, probation re-admission, poison-batch ejection)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# preflight: the sub-second pure-AST lint suite (docs/STATIC_ANALYSIS.md)
+# — a chaos run against source the lints reject wastes minutes.
+# SKIP_LINT=1 skips it.
+if [[ "${SKIP_LINT:-}" != "1" ]]; then
+    python tools/lint_all.py --fast
+fi
 if [[ "${OVERLOAD_ONLY:-}" == "1" ]]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_overload_chaos.py \
         -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
