@@ -567,7 +567,14 @@ class SiteWhereInstance(LifecycleComponent):
         await self.inference.add_tenant(cfg)
         return rt
 
-    async def remove_tenant(self, tenant: str) -> None:
+    async def remove_tenant(
+        self, tenant: str, *, drop_topics: bool = True
+    ) -> None:
+        """Stop + dismantle one tenant. ``drop_topics=False`` keeps the
+        tenant's bus topics and group cursors alive — the multi-host
+        drop path (runtime/hostserve.py): when the tenant was ADOPTED by
+        another host, its topics on the shared broker are the adopter's
+        live state, not ours to destroy."""
         rt = self.tenants.pop(tenant, None)
         self._shared_targets = None
         self.tracer.remove_tenant(tenant)
@@ -587,7 +594,8 @@ class SiteWhereInstance(LifecycleComponent):
         # drop the tenant's bus topics: stale group cursors on dead topics
         # would backpressure future publishers (topics recreate lazily if
         # the tenant is ever re-added)
-        self.bus.drop_topics(self.bus.naming.tenant_topic(tenant, ""))
+        if drop_topics:
+            self.bus.drop_topics(self.bus.naming.tenant_topic(tenant, ""))
         # drop the tenant's labeled metric children + inference timer:
         # label cardinality must track LIVE tenants, not historical churn
         self.inference._stage_timers.pop(tenant, None)
@@ -827,12 +835,25 @@ class SiteWhereInstance(LifecycleComponent):
 
         # bus durability belongs to whoever OWNS the log: the in-proc bus
         # is ours to snapshot; an external broker (RemoteEventBus) owns its
-        # own durable state — exactly the reference's posture toward Kafka
-        bus_bytes = (
-            ck.snapshot_bus(self.bus)
-            if isinstance(self.bus, EventBus)
-            else None
-        )
+        # own durable state — exactly the reference's posture toward Kafka.
+        # The consumer-group CURSORS over this instance's tenant topics are
+        # ours though: captured BEFORE the store cut (an older cursor only
+        # redelivers — at-least-once; a newer one would lose rows), so a
+        # hard-killed host restores with cursors rewound to this cut and
+        # nothing consumed-after-checkpoint goes missing
+        bus_bytes = None
+        bus_offsets = None
+        if isinstance(self.bus, EventBus):
+            bus_bytes = ck.snapshot_bus(self.bus)
+        elif hasattr(self.bus, "snapshot_offsets"):
+            snap = await self.bus.snapshot_offsets()
+            prefixes = tuple(
+                self.bus.naming.tenant_topic(t, "") for t in self.tenants
+            )
+            bus_offsets = {
+                topic: groups for topic, groups in snap.items()
+                if prefixes and topic.startswith(prefixes)
+            }
         param_snaps = {
             key: host_copy_params(tree)
             for key, tree in self.inference.snapshot_params().items()
@@ -854,6 +875,8 @@ class SiteWhereInstance(LifecycleComponent):
         def _write() -> None:
             if bus_bytes is not None:
                 ck.write_bus(bus_bytes)
+            if bus_offsets is not None:
+                ck.save_offsets(bus_offsets)
             for (token, family), params in param_snaps.items():
                 ck.save_params(token, family, params)
             for token, snap in tenant_snaps.items():
@@ -875,6 +898,16 @@ class SiteWhereInstance(LifecycleComponent):
             await asyncio.get_running_loop().run_in_executor(
                 None, ck.load_bus, self.bus
             )
+        elif hasattr(self.bus, "restore_offsets"):
+            # remote broker: rewind OUR consumer groups to the checkpoint
+            # cut before any tenant consumer starts — rows the dead
+            # process consumed after its last checkpoint redeliver
+            # (at-least-once), instead of vanishing behind an advanced
+            # cursor. The snapshot was filtered to this instance's
+            # tenant topics, so co-hosted tenants elsewhere are untouched.
+            snap = ck.load_offsets()
+            if snap:
+                await self.bus.restore_offsets(snap)
         manifest = ck.load_manifest() or []
         for entry in manifest:
             if entry["token"] in self.tenants:

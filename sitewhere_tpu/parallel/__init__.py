@@ -8,6 +8,9 @@ story is jax.sharding over a device Mesh:
                     axis conventions (tenant/data/model).
 - ``tenant_router`` tenant → mesh-shard placement (the north star's
                     "tenant-engine router maps tenants onto TPU mesh axes").
+- ``placement``     host-aware placement on top of the router: which serving
+                    process owns which shards, host suspicion/adoption for
+                    the host fault domain (docs/ROBUSTNESS.md).
 - ``sharded``       stacked per-tenant params + shard_map scoring across the
                     tenant axis; dp/tp helpers for the bigger models.
 - ``ring``          ring attention (sequence parallelism) for long-history
@@ -15,11 +18,13 @@ story is jax.sharding over a device Mesh:
 """
 
 from sitewhere_tpu.parallel.mesh import MeshManager, default_mesh
+from sitewhere_tpu.parallel.placement import HostPlacement
 from sitewhere_tpu.parallel.tenant_router import TenantRouter, TenantPlacement
 
 __all__ = [
     "MeshManager",
     "default_mesh",
+    "HostPlacement",
     "TenantRouter",
     "TenantPlacement",
 ]

@@ -1,0 +1,211 @@
+"""Host-aware tenant placement: the coordinator's view of which serving
+process owns which mesh shards (docs/ROBUSTNESS.md "Host fault domains").
+
+:class:`TenantRouter` balances tenants across *shards*; multi-host
+serving adds one more fact — shards live on hosts, and hosts die whole.
+:class:`HostPlacement` layers that fact on without changing any
+single-host behavior: ``register_host`` declares the host → shard
+ownership map, ``mark_suspect`` extends the PR 13 quarantine verdict
+from one (family, shard) to every shard the host owns, ``adopt`` moves
+the host's tenants onto survivors through the same ``failover`` the
+device domain uses (quarantined shards can't receive, so adoptions land
+only on live hosts), and ``readmit_host`` + ``rebalance`` bring tenants
+home after probation.
+
+Cross-host fences mirror ``_SliceFence``: ``adopt`` opens a per-tenant
+fence recording where the tenant came from; the supervisor lifts them
+(``lift_fences``) only after the adopter confirmed it resumed from the
+last committed cursor. FIFO holds across the move because the old
+host's later writes are already epoch-fenced at the broker — the fence
+here guards the *adopter's* side (no serving the tenant until the
+handoff landed), the epoch guards the *zombie's* side.
+
+A deployment that never calls ``register_host`` is a plain
+``TenantRouter`` bit for bit — the suspect-shard union is empty and
+every inherited method runs unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from sitewhere_tpu.parallel.tenant_router import (
+    PlacementError,
+    TenantPlacement,
+    TenantRouter,
+)
+
+logger = logging.getLogger("sitewhere.placement")
+
+
+class HostPlacement(TenantRouter):
+    """A :class:`TenantRouter` that knows which host owns each shard."""
+
+    def __init__(self, n_shards: int, slots_per_shard: int = 8) -> None:
+        super().__init__(n_shards, slots_per_shard)
+        # host → {"shards": set, "state": "live"|"suspect", "reason": str}
+        self._hosts: Dict[str, dict] = {}
+        # tenant → cross-host fence opened by adopt(), lifted by the
+        # supervisor once the adopter confirmed the handoff
+        self._fences: Dict[str, dict] = {}
+
+    # -- host registry ---------------------------------------------------
+    def register_host(self, host: str, shards) -> None:
+        """Declare (or re-declare) the shards a serving process owns.
+        Shard sets must be disjoint across hosts and in range."""
+        shard_set = set(int(s) for s in shards)
+        for s in shard_set:
+            if not (0 <= s < self.n_shards):
+                raise PlacementError(
+                    f"host '{host}': shard {s} out of range 0..{self.n_shards - 1}"
+                )
+            owner = self.host_of(s)
+            if owner is not None and owner != host:
+                raise PlacementError(
+                    f"host '{host}': shard {s} already owned by '{owner}'"
+                )
+        st = self._hosts.setdefault(host, {"state": "live", "reason": ""})
+        st["shards"] = shard_set
+        logger.info("registered host %s → shards %s", host, sorted(shard_set))
+
+    def host_of(self, shard: int) -> Optional[str]:
+        for host, st in self._hosts.items():
+            if shard in st.get("shards", ()):
+                return host
+        return None
+
+    def hosts(self) -> Dict[str, dict]:
+        return {
+            h: {
+                "state": st["state"],
+                "shards": sorted(st.get("shards", ())),
+                "reason": st.get("reason", ""),
+            }
+            for h, st in sorted(self._hosts.items())
+        }
+
+    def host_state(self, host: str) -> str:
+        return self._hosts.get(host, {}).get("state", "unknown")
+
+    def tenants_on_host(self, host: str) -> List[str]:
+        shards = self._hosts.get(host, {}).get("shards", set())
+        return sorted(
+            t for t, p in self._placements.items() if p.shard in shards
+        )
+
+    def _suspect_shards(self) -> Set[int]:
+        out: Set[int] = set()
+        for st in self._hosts.values():
+            if st["state"] == "suspect":
+                out |= st.get("shards", set())
+        return out
+
+    def _avoided(self, family: str) -> Set[int]:
+        # the device-domain quarantine PLUS every shard on a suspect
+        # host — new families placed after the suspicion route around
+        # the dead host without per-family bookkeeping
+        return super()._avoided(family) | self._suspect_shards()
+
+    # -- the SUSPECT verdict ---------------------------------------------
+    def mark_suspect(self, host: str, reason: str = "lease_expired") -> None:
+        """Extend the quarantine verdict to every shard the host owns:
+        no new placements, no failover landings, no rebalance receivers
+        until ``readmit_host``."""
+        st = self._hosts.setdefault(
+            host, {"state": "live", "reason": "", "shards": set()}
+        )
+        st["state"] = "suspect"
+        st["reason"] = reason
+        for fam in list(self._used):
+            for shard in st["shards"]:
+                self.quarantine(fam, shard)
+        logger.warning(
+            "host SUSPECT: %s (%s) — shards %s quarantined",
+            host, reason, sorted(st["shards"]),
+        )
+
+    def adopt(self, host: str) -> List[Tuple[TenantPlacement, TenantPlacement]]:
+        """Move every tenant on the suspect host's shards onto survivors
+        via ``failover`` (suspect shards are in ``_avoided``, so landings
+        are live-host only). Opens a cross-host fence per moved tenant.
+        A tenant with no healthy capacity stays put, degraded — the same
+        "degraded beats unplaceable" stance the device domain takes."""
+        moves: List[Tuple[TenantPlacement, TenantPlacement]] = []
+        for tenant in self.tenants_on_host(host):
+            old = self._placements[tenant]
+            try:
+                new = self.failover(tenant)
+            except PlacementError:
+                logger.warning(
+                    "adoption of tenant %s from host %s: no healthy "
+                    "capacity — left in place (degraded)", tenant, host,
+                )
+                continue
+            self._fences[tenant] = {
+                "from_host": host,
+                "from_shard": old.shard,
+                "to_shard": new.shard,
+                "since": time.monotonic(),
+            }
+            moves.append((old, new))
+        return moves
+
+    # -- cross-host fences -----------------------------------------------
+    def fenced(self, tenant: str) -> bool:
+        return tenant in self._fences
+
+    def fences(self, host: Optional[str] = None) -> Dict[str, dict]:
+        return {
+            t: dict(f) for t, f in self._fences.items()
+            if host is None or f["from_host"] == host
+        }
+
+    def lift_fence(self, tenant: str) -> bool:
+        return self._fences.pop(tenant, None) is not None
+
+    def lift_fences(self, host: Optional[str] = None) -> int:
+        """Release the adoption fences (all, or one host's worth).
+        Returns how many lifted."""
+        doomed = [
+            t for t, f in self._fences.items()
+            if host is None or f["from_host"] == host
+        ]
+        for t in doomed:
+            del self._fences[t]
+        return len(doomed)
+
+    # -- probation passed --------------------------------------------------
+    def readmit_host(self, host: str) -> List[
+        Tuple[TenantPlacement, TenantPlacement]
+    ]:
+        """Probation passed: lift the host's shard quarantine and compute
+        the rebalance-home moves. The CALLER owns executing them through
+        the FIFO-preserving apply path (``apply_rebalance``), exactly as
+        with device readmission."""
+        st = self._hosts.get(host)
+        if st is None:
+            return []
+        st["state"] = "live"
+        st["reason"] = ""
+        for fam in list(self._quarantined):
+            for shard in list(st.get("shards", ())):
+                self.readmit(fam, shard)
+        moves = self.rebalance()
+        logger.info(
+            "host readmitted: %s — %d rebalance-home move(s)",
+            host, len(moves),
+        )
+        return moves
+
+    # -- introspection ---------------------------------------------------
+    def describe(self) -> dict:
+        out = super().describe()
+        out["hosts"] = self.hosts()
+        out["fences"] = {
+            t: {"from_host": f["from_host"], "from_shard": f["from_shard"],
+                "to_shard": f["to_shard"]}
+            for t, f in sorted(self._fences.items())
+        }
+        return out

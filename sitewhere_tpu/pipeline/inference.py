@@ -2536,6 +2536,40 @@ class TpuInferenceService(MultitenantService):
             )
         return n
 
+    async def host_probe(self, n: int = 1) -> int:
+        """HOST-probation probes (docs/ROBUSTNESS.md "Host fault
+        domains"): land ``n`` synthetic zero-row flushes through the
+        real wire and report how many made deadline. A host re-appearing
+        after a lease fence calls this and carries the count in its
+        heartbeat (``probes_ok``); the coordinator's ``HostSupervisor``
+        readmits the host only once the count clears its
+        ``probation_probes`` bar — the process-level mirror of
+        ``_probe_slice``. Each probe rides the first serving slice (the
+        cheapest proof the whole staging→step→gather wire answers); a
+        host with no serving state yet trivially passes — there is
+        nothing to be wedged."""
+        ok = 0
+        for _ in range(max(1, int(n))):
+            landed = not self.scorers
+            for (family, sl), scorer in sorted(self.scorers.items()):
+                try:
+                    landed = await self._dispatch_probe(scorer, family, sl)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 - a probe fault
+                    # IS the verdict, never a crash
+                    self._record_error("host-probe", exc)
+                    landed = False
+                break
+            if landed:
+                ok += 1
+                self.metrics.counter("tpu_inference.host_probes_ok").inc()
+            else:
+                self.metrics.counter(
+                    "tpu_inference.host_probe_failures"
+                ).inc()
+        return ok
+
     def _probe_quarantined(self) -> None:
         """Scoring-loop tick: launch (at most one per slice) probation
         probes for quarantined slices whose probe interval elapsed.

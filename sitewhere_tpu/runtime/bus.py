@@ -102,6 +102,14 @@ class TopicNaming:
         store ∪ DLQ ∪ expired accounting stays exact under load shedding."""
         return self.tenant_topic(tenant, "expired-events")
 
+    def host_fenced(self, host: str) -> str:
+        """DLQ for a zombie host's stale-epoch publishes (host fault
+        domain): a process whose lease was fenced keeps its writes OUT
+        of the live topics but never loses them silently — each rejected
+        publish lands here with the host, epoch, and intended topic so
+        the store ∪ DLQ accounting stays exact across an adoption."""
+        return self.global_topic(f"host-fenced.{host}")
+
 
 class TransientPublishError(RuntimeError):
     """An injected (or backend) publish failure that a well-behaved

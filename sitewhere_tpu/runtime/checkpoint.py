@@ -121,6 +121,25 @@ class CheckpointManager:
         """One-shot snapshot+write (callers already off the event loop)."""
         return self.write_bus(self.snapshot_bus(bus))
 
+    def save_offsets(self, snap: dict) -> Path:
+        """Persist consumer-group cursors captured from an EXTERNAL
+        broker (``snapshot_offsets``). The in-proc bus never needs this —
+        its cursors travel inside ``bus.ckpt``; against a remote broker
+        the log is the broker's, but the CURSORS belong to this
+        instance's consumption and must rewind with its stores
+        (docs/ROBUSTNESS.md "Host fault domains", hard-kill drill)."""
+        path = self.root / "offsets.json"
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(snap))
+        tmp.replace(path)  # atomic
+        return path
+
+    def load_offsets(self) -> Optional[dict]:
+        path = self.root / "offsets.json"
+        if not path.exists():
+            return None
+        return json.loads(path.read_text())
+
     def load_bus(self, bus) -> bool:
         path = self.root / "bus.ckpt"
         if not path.exists():

@@ -216,6 +216,130 @@ def _apply_blocking(fault: DeviceFault, plan: DeviceFaultPlan) -> None:
         time.sleep(fault.delay_s)
 
 
+# ---------------------------------------------------------------------
+# host fault domain (ISSUE 16): the HOST twin of the device plan above.
+# A host fault never raises at a scoring site — it starves the lease
+# control plane (runtime.hostlease) the way a dead/wedged/partitioned
+# process starves a real coordinator:
+#
+# - ``kill9`` / ``sigstop`` — whole-process faults. The in-process plan
+#   cannot deliver these to itself; the multi-process chaos harness
+#   (tests/test_host_chaos.py) sends the actual signals and the plan
+#   records them for selector symmetry only.
+# - ``renew_blackhole``  — the lease-renewal frame is silently dropped
+#   before it reaches the wire (a one-way partition on the control
+#   plane: the host looks alive to itself, dead to the coordinator).
+# - ``partition``        — every lease-plane call raises
+#   ConnectionError (full netbus partition as the client experiences
+#   it; data-plane faults ride the bus FaultPlan, not this one).
+# - ``slow_heartbeat``   — each renewal is delayed ``delay_s`` before
+#   it is sent (a GC-pausing / overcommitted host whose heartbeats
+#   straggle toward the TTL edge).
+#
+# Faults select by host id and op ("acquire" / "renew"), pace by nth /
+# first_n exactly like DeviceFault, and can bound themselves with
+# ``duration_s`` (the fault self-heals — the partition that ends).
+
+HOST_FAULT_KINDS = (
+    "kill9",
+    "sigstop",
+    "renew_blackhole",
+    "partition",
+    "slow_heartbeat",
+)
+
+
+class InjectedHostFault(ConnectionError):
+    """Raised by ``partition`` injections on the lease plane."""
+
+
+@dataclass
+class HostFault:
+    """One injectable host fault + its selectors (empty = match all)."""
+
+    kind: str
+    hosts: Tuple[str, ...] = ()
+    ops: Tuple[str, ...] = ()    # "acquire" / "renew" (empty = all)
+    nth: int = 1                 # fire on every nth MATCHING call
+    first_n: int = 0             # total firing budget (0 = unlimited)
+    delay_s: float = 0.05        # slow_heartbeat stall per renewal
+    duration_s: float = 0.0      # fault lifetime from first firing (0 = forever)
+    # internal: matching/firing tallies (per-plan bookkeeping)
+    seen: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+    started: float = field(default=0.0, compare=False)
+
+    def __post_init__(self) -> None:
+        if self.kind not in HOST_FAULT_KINDS:
+            raise ValueError(
+                f"kind must be one of {HOST_FAULT_KINDS}, got {self.kind!r}"
+            )
+
+    def selects(self, host: str, op: str) -> bool:
+        if self.hosts and host not in self.hosts:
+            return False
+        if self.ops and op not in self.ops:
+            return False
+        return True
+
+    def expired(self, now: float) -> bool:
+        return bool(
+            self.duration_s and self.started
+            and now - self.started >= self.duration_s
+        )
+
+
+class HostFaultPlan:
+    """An ordered set of :class:`HostFault`\\ s consulted by the lease
+    client at each control-plane call. Injectable + clearable exactly
+    like :class:`DeviceFaultPlan`: ``match`` at the call site,
+    ``clear()`` heals everything, ``injected`` counts applications for
+    test assertions."""
+
+    def __init__(self, *faults: HostFault) -> None:
+        self.faults = list(faults)
+        self.cleared = False
+        self.injected = 0
+
+    def add(self, fault: HostFault) -> None:
+        """Inject one more fault into a live plan (the chaos harness
+        drives this over the host-control topic mid-run). Re-arms a
+        previously cleared plan — inject/clear/inject must work."""
+        self.cleared = False
+        self.faults.append(fault)
+
+    def match(self, host: str, op: str) -> Optional[HostFault]:
+        """The fault (if any) this (host, op) control-plane call draws.
+        First matching declaration wins; duration-expired faults are
+        dropped in place (the partition that healed)."""
+        if self.cleared:
+            return None
+        now = time.monotonic()
+        self.faults = [f for f in self.faults if not f.expired(now)]
+        for f in self.faults:
+            if f.kind in ("kill9", "sigstop"):
+                continue  # process-level: the harness delivers signals
+            if not f.selects(host, op):
+                continue
+            if f.first_n and f.fired >= f.first_n:
+                continue
+            f.seen += 1
+            if f.nth > 1 and f.seen % f.nth:
+                continue
+            if not f.started:
+                f.started = now
+            f.fired += 1
+            self.injected += 1
+            return f
+        return None
+
+    def clear(self) -> None:
+        """Drop every fault — the 'partition healed / host recovered'
+        transition (probation heartbeats start landing after this)."""
+        self.cleared = True
+        self.faults = []
+
+
 class FaultyResult:
     """Proxy over a dispatched device array applying one fault at the
     points the result path actually touches: ``is_ready`` (the reaper's

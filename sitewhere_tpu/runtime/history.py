@@ -87,6 +87,13 @@ DEFAULT_ALLOWLIST: Tuple[str, ...] = (
     # / heal" questions read these beside the d2h series
     "tpu_flush_timeout_total",
     "tpu_inference_quarantined_slices",
+    # host fault domain (runtime.hostlease): lease epochs, suspicion
+    # verdicts, and cross-host adoptions — "when did the host die / who
+    # took its tenants" questions read these beside the flush series
+    "host_lease_epoch",
+    "host_lease_lost_total",
+    "host_suspect_total",
+    "host_adoptions_total",
 )
 
 # Families the Watchdog rules read from the history ring. A custom
@@ -105,6 +112,7 @@ WATCHDOG_REQUIRED: Tuple[str, ...] = (
     "score_quality_psi",
     "score_quality_nan_rate",
     "tpu_flush_timeout_total",
+    "host_lease_lost_total",
 )
 
 # PSI verdict boundary the score_drift rule shares with the REST health
@@ -611,6 +619,38 @@ class Watchdog:
             **meta,
         }
 
+    def _rule_host_lease_lost(self):
+        """A host's TTL lease lapsed (or a renewal came back stale)
+        inside the rule window — the host fault domain already fenced
+        its epoch and adopted its tenants; this alert is the
+        operator-facing escalation. Its snapshot names the host, and the
+        60 s cooldown means a flapping host (lease lost, probation,
+        lost again) pages once per minute, not once per heartbeat."""
+        hits = []
+        first: Optional[Dict[str, str]] = None
+        for name in self.history.children("host_lease_lost_total"):
+            d = self.history.delta(name, self.window)
+            if d is None:
+                # born by its first loss: the whole cumulative count
+                # sits inside the window (same young-child stance as
+                # the flush_timeout rule)
+                d = self.history.latest(name)
+            if d is None or d < 1:
+                continue
+            labels = _child_labels(name)
+            hits.append(f"{labels.get('host', name)} (+{int(d)})")
+            if first is None:
+                first = labels
+        if not hits:
+            return None
+        return {
+            "detail": (
+                f"host lease lost in {self.window_s:g}s: "
+                + ", ".join(hits)
+            ),
+            "host": first.get("host") if first else None,
+        }
+
     RULES = (
         ("steady_state_recompile", "_rule_steady_state_recompile"),
         ("h2d_overlap_collapse", "_rule_h2d_overlap_collapse"),
@@ -620,6 +660,7 @@ class Watchdog:
         ("score_drift", "_rule_score_drift"),
         ("nan_rate_spike", "_rule_nan_rate_spike"),
         ("flush_timeout", "_rule_flush_timeout"),
+        ("host_lease_lost", "_rule_host_lease_lost"),
     )
 
     # -- evaluation ------------------------------------------------------

@@ -1,0 +1,710 @@
+"""Host fault domain: TTL leases, epoch fencing, and cross-host
+supervision (docs/ROBUSTNESS.md "Host fault domains").
+
+PR 13 closed the *device* fault domain — flush deadlines, slice
+quarantine, probation probes — inside one process. This module is the
+HOST rung of the same ladder (ROADMAP item 1): the paper's architecture
+spreads tenant engines across microservice instances over a Kafka-style
+bus, so a wedged or killed *process* must be as survivable as a wedged
+chip. The moving parts:
+
+- :class:`LeaseTable` — the broker-side authority: each serving process
+  holds a TTL lease over its slice set at a monotonically increasing
+  **epoch**. Renewals carry a health summary (flush-timeout rate,
+  quarantined slices, overload credit) so the coordinator reads fleet
+  health from the lease plane it already polls.
+- **Epoch fencing** — the zombie problem. A host that misses renewals
+  (SIGSTOP, GC wedge, partition) is not dead; it may wake after its
+  tenants were re-adopted elsewhere and keep publishing. The supervisor
+  FENCES the lease (epoch high-water bumps past the zombie's grant)
+  *before* adopting, and every data-plane publish from a lease-holding
+  host rides ``publish_fenced``: the broker checks (host, epoch) and the
+  append in ONE dispatch. Stale-epoch publishes are rejected, counted
+  (``host_fenced_publishes_total``), and DLQ'd to
+  ``TopicNaming.host_fenced(host)`` — never silently double-served,
+  never silently dropped.
+- :class:`HostLeaseClient` — the per-process side: acquires at
+  ``min_epoch`` = its last epoch (so epochs stay monotonic across broker
+  restarts), renews at TTL/3, and learns it lost the lease from a stale
+  renewal (counted ``host_lease_lost_total``, flight-recorder snapshot,
+  ``on_lease_lost`` callback). Renewals ride RemoteEventBus's jittered
+  reconnect backoff — a broker bounce inside the window is invisible and
+  the epoch survives because it is an argument, not connection state.
+- :class:`FencedBus` — the data-plane wrapper: an EventBus-surface proxy
+  whose publishes carry the client's (host, epoch). Single-host
+  deployments simply never construct it — the lease layer OFF is the
+  bitwise-identical default path.
+- :class:`HostSupervisor` — the coordinator: polls the lease table,
+  marks a host SUSPECT on lease expiry or sustained sick heartbeats,
+  fences FIRST, then re-adopts its tenants onto surviving hosts through
+  :class:`parallel.placement.HostPlacement` (cross-host fences mirroring
+  ``_SliceFence``: per-tenant FIFO holds because the adopter resumes
+  from the last committed cursor while the zombie's later writes are
+  epoch-fenced). Probation mirrors PR 13: a re-appearing host must land
+  N synthetic probe flushes under deadline (reported via its heartbeat)
+  before ``apply_rebalance`` brings tenants home.
+
+Chaos drives the layer through :class:`runtime.faultplan.HostFaultPlan`
+(renew-blackhole, netbus partition, slow heartbeat in-process; kill -9 /
+SIGSTOP delivered by the multi-process harness,
+``tools/run_host_chaos.sh``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sitewhere_tpu.runtime.faultplan import HostFaultPlan, InjectedHostFault
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+logger = logging.getLogger("sitewhere.hostlease")
+
+# lease defaults: a 5 s TTL with renewals every TTL/3 tolerates two
+# consecutive lost renewals before expiry — the same 3x margin the flush
+# deadline uses over p99 (chaos harnesses shrink both)
+DEFAULT_LEASE_TTL_S = 5.0
+RENEW_FRACTION = 3.0
+
+
+class LeaseTable:
+    """Broker-side lease authority (single-threaded on the broker loop).
+
+    Epochs are per-host high-water marks that NEVER reset: a re-acquire,
+    a fence, and a broker restart (clients re-assert their epoch via
+    ``min_epoch`` / renewal re-adoption) all move them forward only —
+    "newer epoch wins" stays decidable for the life of the deployment.
+    """
+
+    def __init__(
+        self,
+        default_ttl_s: float = DEFAULT_LEASE_TTL_S,
+        clock=time.monotonic,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.default_ttl_s = float(default_ttl_s)
+        self._clock = clock
+        self.metrics = metrics or MetricsRegistry()
+        self._leases: Dict[str, dict] = {}
+        self._high: Dict[str, int] = {}   # epoch high-water, survives release
+
+    # -- grants ----------------------------------------------------------
+    def acquire(
+        self,
+        host: str,
+        slices: tuple = (),
+        ttl_s: Optional[float] = None,
+        min_epoch: int = 0,
+    ) -> dict:
+        """Grant (or re-grant) the host's lease at a FRESH epoch past
+        both the table's high-water and the client's ``min_epoch`` — a
+        client re-acquiring after a broker restart keeps monotonicity by
+        asserting the last epoch it held."""
+        ttl = self.default_ttl_s if ttl_s is None else float(ttl_s)
+        epoch = max(self._high.get(host, 0), int(min_epoch)) + 1
+        self._high[host] = epoch
+        now = self._clock()
+        self._leases[host] = {
+            "epoch": epoch,
+            "ttl_s": ttl,
+            "expires_at": now + ttl,
+            "slices": tuple(slices),
+            "health": {},
+            "fenced": False,
+            "renewals": 0,
+            "since": now,
+        }
+        self.metrics.gauge("host_lease_epoch", host=host).set(epoch)
+        logger.info("lease acquired: host=%s epoch=%d ttl=%.2fs",
+                    host, epoch, ttl)
+        return {"epoch": epoch, "ttl_s": ttl}
+
+    def renew(
+        self,
+        host: str,
+        epoch: int,
+        ttl_s: Optional[float] = None,
+        health: Optional[dict] = None,
+    ) -> dict:
+        """Extend the lease iff ``epoch`` is the host's CURRENT unfenced
+        grant. A renewal for an unknown host whose epoch clears the
+        high-water re-adopts it (a fresh broker after restart has no
+        table; the client's epoch is the best information there is — a
+        ZOMBIE cannot ride this path because the fence bumped the
+        high-water past its grant before its tenants moved)."""
+        now = self._clock()
+        st = self._leases.get(host)
+        if st is None:
+            if int(epoch) >= self._high.get(host, 0) and int(epoch) > 0:
+                ttl = self.default_ttl_s if ttl_s is None else float(ttl_s)
+                self._high[host] = int(epoch)
+                self._leases[host] = st = {
+                    "epoch": int(epoch),
+                    "ttl_s": ttl,
+                    "expires_at": now + ttl,
+                    "slices": (),
+                    "health": dict(health or {}),
+                    "fenced": False,
+                    "renewals": 1,
+                    "since": now,
+                }
+                self.metrics.gauge("host_lease_epoch", host=host).set(epoch)
+                return {"ok": True, "epoch": int(epoch)}
+            return {"ok": False, "epoch": self._high.get(host, 0)}
+        if st["fenced"] or int(epoch) != st["epoch"]:
+            # stale: the host was fenced (or out-raced by a re-acquire).
+            # The zombie learns it lost the lease from this reply.
+            return {"ok": False, "epoch": st["epoch"]}
+        if ttl_s is not None:
+            st["ttl_s"] = float(ttl_s)
+        st["expires_at"] = now + st["ttl_s"]
+        st["renewals"] += 1
+        if health is not None:
+            st["health"] = dict(health)
+        return {"ok": True, "epoch": st["epoch"]}
+
+    def release(self, host: str, epoch: int) -> bool:
+        st = self._leases.get(host)
+        if st is None or int(epoch) != st["epoch"]:
+            return False
+        del self._leases[host]
+        return True
+
+    # -- fencing ---------------------------------------------------------
+    def fence(self, host: str) -> int:
+        """The supervisor's commit point: invalidate the host's current
+        grant and bump the high-water past it, so (a) every in-flight or
+        future publish at the old epoch fails ``check``, and (b) any
+        renewal-re-adoption at the old epoch is refused. Returns the new
+        high-water (the floor any legitimate re-acquire will exceed)."""
+        st = self._leases.get(host)
+        high = max(
+            self._high.get(host, 0), st["epoch"] if st else 0
+        ) + 1
+        self._high[host] = high
+        if st is not None:
+            st["fenced"] = True
+        logger.warning("lease fenced: host=%s high-water=%d", host, high)
+        return high
+
+    def check(self, host: str, epoch: int) -> bool:
+        """Is (host, epoch) the current unfenced grant? Called inside the
+        broker's ``publish_fenced`` dispatch — check and append are one
+        atomic step on the broker loop. An EXPIRED-but-unfenced lease
+        still passes: expiry is the supervisor's *signal*; the fence is
+        the commitment, and it always lands before any adoption."""
+        st = self._leases.get(host)
+        return (
+            st is not None
+            and not st["fenced"]
+            and int(epoch) == st["epoch"]
+        )
+
+    # -- coordinator reads -----------------------------------------------
+    def expired(self, now: Optional[float] = None) -> List[str]:
+        now = self._clock() if now is None else now
+        return sorted(
+            h for h, st in self._leases.items()
+            if not st["fenced"] and now >= st["expires_at"]
+        )
+
+    def table(self) -> Dict[str, dict]:
+        """Wire-shaped snapshot. Expiry crosses as RELATIVE seconds
+        (``expires_in_s``): the broker's monotonic clock means nothing in
+        the supervisor's process."""
+        now = self._clock()
+        return {
+            h: {
+                "epoch": st["epoch"],
+                "ttl_s": st["ttl_s"],
+                "expires_in_s": st["expires_at"] - now,
+                "fenced": st["fenced"],
+                "slices": tuple(st["slices"]),
+                "health": dict(st["health"]),
+                "renewals": st["renewals"],
+                "age_s": now - st["since"],
+            }
+            for h, st in self._leases.items()
+        }
+
+
+class LocalLeaseTransport:
+    """The lease-op surface of :class:`netbus.RemoteEventBus` over an
+    in-proc :class:`LeaseTable` — lets the client/supervisor pair run
+    (and be unit-tested) without a socket, and gives an embedded
+    coordinator the same duck type the remote one has."""
+
+    def __init__(self, table: Optional[LeaseTable] = None) -> None:
+        self.table = table if table is not None else LeaseTable()
+
+    async def lease_acquire(
+        self, host_id: str, slices: tuple = (),
+        ttl_s: Optional[float] = None, min_epoch: int = 0,
+    ) -> dict:
+        return self.table.acquire(host_id, slices, ttl_s, min_epoch)
+
+    async def lease_renew(
+        self, host_id: str, epoch: int,
+        ttl_s: Optional[float] = None, health: Optional[dict] = None,
+    ) -> dict:
+        return self.table.renew(host_id, epoch, ttl_s, health)
+
+    async def lease_release(self, host_id: str, epoch: int) -> bool:
+        return self.table.release(host_id, epoch)
+
+    async def lease_fence(self, host_id: str) -> int:
+        return self.table.fence(host_id)
+
+    async def lease_table(self) -> Dict[str, dict]:
+        return self.table.table()
+
+
+class HostLeaseClient(LifecycleComponent):
+    """Per-process lease holder: acquire on start, renew at TTL/3,
+    heartbeat the health summary, learn (and announce) lease loss.
+
+    ``bus`` is anything with the lease-op surface — a
+    ``netbus.RemoteEventBus`` or a :class:`LocalLeaseTransport`.
+    ``health_fn`` returns the heartbeat dict (flush-timeout rate,
+    quarantined slices, overload credit, probation probes);
+    ``faultplan`` is a :class:`HostFaultPlan` consulted per renewal.
+    """
+
+    def __init__(
+        self,
+        bus,
+        host_id: str,
+        *,
+        slices: tuple = (),
+        ttl_s: float = DEFAULT_LEASE_TTL_S,
+        renew_interval_s: Optional[float] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        flightrec=None,
+        health_fn: Optional[Callable[[], dict]] = None,
+        faultplan: Optional[HostFaultPlan] = None,
+        on_lease_lost: Optional[Callable[["HostLeaseClient"], None]] = None,
+    ) -> None:
+        super().__init__(f"host-lease-{host_id}")
+        self.bus = bus
+        self.host_id = str(host_id)
+        self.slices = tuple(slices)
+        self.ttl_s = float(ttl_s)
+        self.renew_interval_s = (
+            float(renew_interval_s) if renew_interval_s is not None
+            else self.ttl_s / RENEW_FRACTION
+        )
+        self.metrics = metrics or MetricsRegistry()
+        self.flightrec = flightrec
+        self.health_fn = health_fn
+        self.faultplan = faultplan
+        self.on_lease_lost = on_lease_lost
+        self.epoch = 0
+        self.held = False
+        self.renewals = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def on_start(self) -> None:
+        await self.acquire()
+        self._task = asyncio.create_task(
+            self._renew_loop(), name=f"lease-renew-{self.host_id}"
+        )
+
+    async def on_stop(self) -> None:
+        await cancel_and_wait(self._task)
+        self._task = None
+        if self.held:
+            try:
+                await self.bus.lease_release(self.host_id, self.epoch)
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # broker gone at shutdown: the TTL reaps the lease
+            self.held = False
+
+    async def acquire(self) -> dict:
+        """(Re-)acquire, asserting ``min_epoch`` = the last epoch held so
+        the grant stays monotonic across broker restarts and our own
+        re-admissions."""
+        fault = (
+            self.faultplan.match(self.host_id, "acquire")
+            if self.faultplan is not None else None
+        )
+        if fault is not None and fault.kind == "partition":
+            raise InjectedHostFault(
+                f"injected netbus partition ({self.host_id}/acquire)"
+            )
+        grant = await self.bus.lease_acquire(
+            self.host_id, self.slices, self.ttl_s, min_epoch=self.epoch
+        )
+        self.epoch = int(grant["epoch"])
+        self.held = True
+        self.metrics.gauge("host_lease_epoch", host=self.host_id).set(
+            self.epoch
+        )
+        return grant
+
+    async def _renew_loop(self) -> None:
+        """The heartbeat: one renewal per interval, forever. Failures
+        never break the loop — a missed renewal is the *signal* the
+        supervisor acts on, not a client crash."""
+        while True:
+            await asyncio.sleep(self.renew_interval_s)
+            try:
+                await self.renew_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - renewals must not
+                # kill the heartbeat; the failure counters carry this
+                self._record_error("lease-renew", exc)
+
+    async def renew_once(self) -> bool:
+        """One renewal + heartbeat. Returns True iff the lease extended.
+        Injected host faults apply here: blackhole drops the frame
+        (counted as a renew failure — the broker never sees it),
+        partition raises the ConnectionError a real netbus split would,
+        slow_heartbeat stalls the frame toward the TTL edge."""
+        fault = (
+            self.faultplan.match(self.host_id, "renew")
+            if self.faultplan is not None else None
+        )
+        if fault is not None and fault.kind == "renew_blackhole":
+            self.metrics.counter(
+                "netbus_lease_renew_failures_total", host=self.host_id
+            ).inc()
+            return False
+        health: dict = {}
+        if self.health_fn is not None:
+            try:
+                health = dict(self.health_fn() or {})
+            except Exception as exc:  # noqa: BLE001 - a broken health
+                # probe must not stop renewals (liveness > telemetry)
+                self._record_error("lease-health", exc)
+        try:
+            if fault is not None and fault.kind == "partition":
+                raise InjectedHostFault(
+                    f"injected netbus partition ({self.host_id}/renew)"
+                )
+            if fault is not None and fault.kind == "slow_heartbeat":
+                await asyncio.sleep(fault.delay_s)
+            resp = await self.bus.lease_renew(
+                self.host_id, self.epoch, self.ttl_s, health
+            )
+        except InjectedHostFault:
+            # never reached the bus, so the netbus-side counter didn't
+            # see it — count here (same family, same meaning)
+            self.metrics.counter(
+                "netbus_lease_renew_failures_total", host=self.host_id
+            ).inc()
+            return False
+        except (ConnectionError, OSError, RuntimeError):
+            # netbus counted netbus_lease_renew_failures_total on its
+            # registry; the epoch is preserved and the next tick retries
+            return False
+        if resp.get("ok"):
+            self.held = True
+            self.renewals += 1
+            return True
+        self._lost(int(resp.get("epoch", self.epoch)))
+        return False
+
+    def _lost(self, current_epoch: int) -> None:
+        """A stale renewal reply: someone fenced us. From here every
+        fenced publish lands in the host-fenced DLQ; the owner decides
+        (via ``on_lease_lost``) whether to quiesce or re-acquire and
+        earn probation."""
+        if not self.held:
+            return
+        self.held = False
+        self.metrics.counter(
+            "host_lease_lost_total", host=self.host_id
+        ).inc()
+        logger.warning(
+            "lease LOST: host=%s epoch=%d (current=%d) — writes are "
+            "fenced from here", self.host_id, self.epoch, current_epoch,
+        )
+        if self.flightrec is not None:
+            self.flightrec.snapshot(
+                f"lease-loss:{self.host_id}",
+                host=self.host_id, epoch=self.epoch,
+                current_epoch=current_epoch,
+            )
+        if self.on_lease_lost is not None:
+            try:
+                self.on_lease_lost(self)
+            except Exception as exc:  # noqa: BLE001 - owner callback
+                self._record_error("lease-lost-callback", exc)
+
+
+class FencedBus:
+    """EventBus-surface proxy that stamps every publish with the lease
+    client's (host, epoch) and routes it through the broker's atomic
+    fence check. Everything else delegates verbatim to the inner
+    ``RemoteEventBus`` — deployments that never construct this wrapper
+    (single-host: the default) run today's publish path bit for bit."""
+
+    def __init__(self, inner, client: HostLeaseClient) -> None:
+        self.inner = inner
+        self.client = client
+        self.fenced = 0   # publishes this process saw rejected (tests)
+
+    @property
+    def metrics(self):
+        return self.inner.metrics
+
+    @metrics.setter
+    def metrics(self, value) -> None:
+        # the instance rebinds bus.metrics to its own registry at build
+        # time — that rebind must land on the REAL bus client, or its
+        # reconnect/renew counters scrape from a registry nobody reads
+        self.inner.metrics = value
+
+    async def publish(self, topic: str, payload: Any, key: Any = None) -> int:
+        resp = await self.inner.publish_fenced(
+            topic, payload, self.client.host_id, self.client.epoch, key
+        )
+        if resp.get("fenced"):
+            self.fenced += 1
+            return int(resp.get("offset", -1))
+        return int(resp["offset"])
+
+    def publish_nowait(self, topic: str, payload: Any, key: Any = None) -> int:
+        self.inner.publish_fenced_nowait(
+            topic, payload, self.client.host_id, self.client.epoch, key
+        )
+        return -1
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)
+
+
+class HostSupervisor(LifecycleComponent):
+    """Coordinator-side watcher: lease table → SUSPECT verdicts → fence →
+    cross-host adoption → probation → rebalance home.
+
+    The state machine per host (docs/ROBUSTNESS.md has the table):
+
+    - LIVE     — lease current, heartbeats healthy.
+    - SUSPECT  — lease expired, or ``sick_heartbeats`` consecutive
+      heartbeats with ``flush_timeout_rate >= sick_flush_timeout_rate``.
+      Entering SUSPECT fences the lease FIRST (zombie writes die at the
+      broker from this instant), then adopts every tenant on the host's
+      shards onto survivors (``HostPlacement.adopt`` — per-tenant
+      cross-host fences mirror ``_SliceFence``).
+    - PROBATION — the host re-acquired past the fence (fresh epoch) and
+      is heartbeating again; it must report ``probes_ok >=
+      probation_probes`` synthetic probe flushes landed under deadline.
+    - back to LIVE — ``readmit_host`` lifts the shard quarantine and the
+      rebalance moves tenants home (``on_rebalance_home`` executes them).
+
+    ``on_adopt(host, moves, reason)`` / ``on_rebalance_home(host,
+    moves)`` are the deployment's actuators (publish host-control
+    commands, hand off checkpoints); both may be coroutines.
+    """
+
+    def __init__(
+        self,
+        bus,
+        placement,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+        flightrec=None,
+        scorehealth=None,
+        tick_s: float = 0.25,
+        sick_flush_timeout_rate: float = 0.5,
+        sick_heartbeats: int = 3,
+        probation_probes: int = 2,
+        on_adopt=None,
+        on_rebalance_home=None,
+    ) -> None:
+        super().__init__("host-supervisor")
+        self.bus = bus
+        self.placement = placement
+        self.metrics = metrics or MetricsRegistry()
+        self.flightrec = flightrec
+        self.scorehealth = scorehealth
+        self.tick_s = float(tick_s)
+        self.sick_flush_timeout_rate = float(sick_flush_timeout_rate)
+        self.sick_heartbeats = int(sick_heartbeats)
+        self.probation_probes = int(probation_probes)
+        self.on_adopt = on_adopt
+        self.on_rebalance_home = on_rebalance_home
+        self._hosts: Dict[str, dict] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_start(self) -> None:
+        self._task = asyncio.create_task(
+            self._watch_loop(), name="host-supervisor"
+        )
+
+    async def on_stop(self) -> None:
+        await cancel_and_wait(self._task)
+        self._task = None
+
+    def host_state(self, host: str) -> str:
+        # "state" itself is the lifecycle attribute (LifecycleComponent)
+        return self._hosts.get(host, {}).get("state", "unknown")
+
+    def describe(self) -> dict:
+        return {
+            h: {k: v for k, v in st.items()}
+            for h, st in sorted(self._hosts.items())
+        }
+
+    # -- the watch loop --------------------------------------------------
+    async def _watch_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_s)
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, OSError, RuntimeError):
+                # broker bounce: the lease table is unreadable this
+                # tick; verdicts wait — a coordinator must never
+                # suspect hosts on ITS OWN partition's evidence
+                continue
+            except Exception as exc:  # noqa: BLE001 - rule bugs must
+                # not kill supervision
+                self._record_error("host-watch", exc)
+
+    async def poll_once(self) -> List[dict]:
+        """One supervision tick. Returns the verdicts applied (tests)."""
+        table = await self.bus.lease_table()
+        verdicts: List[dict] = []
+        for host, row in table.items():
+            st = self._hosts.setdefault(
+                host, {"state": "live", "sick": 0, "epoch": row["epoch"]}
+            )
+            if st["state"] == "live":
+                if row["fenced"] or row["expires_in_s"] <= 0.0:
+                    await self.suspect(host, "lease_expired", row)
+                    verdicts.append({"host": host, "to": "suspect",
+                                     "reason": "lease_expired"})
+                    continue
+                hb = row.get("health") or {}
+                rate = float(hb.get("flush_timeout_rate", 0.0))
+                if rate >= self.sick_flush_timeout_rate:
+                    st["sick"] += 1
+                    if st["sick"] >= self.sick_heartbeats:
+                        await self.suspect(host, "sick_heartbeats", row)
+                        verdicts.append({"host": host, "to": "suspect",
+                                         "reason": "sick_heartbeats"})
+                else:
+                    st["sick"] = 0
+                st["epoch"] = row["epoch"]
+            elif st["state"] == "suspect":
+                # a re-appearing host: fresh grant past the fence, alive
+                if (
+                    not row["fenced"]
+                    and row["epoch"] > st.get("fenced_epoch", 0) - 1
+                    and row["epoch"] > st["epoch"]
+                    and row["expires_in_s"] > 0.0
+                ):
+                    st["state"] = "probation"
+                    st["epoch"] = row["epoch"]
+                    verdicts.append({"host": host, "to": "probation"})
+            elif st["state"] == "probation":
+                if row["fenced"] or row["expires_in_s"] <= 0.0:
+                    # relapsed mid-probation: stay suspect (already
+                    # fenced + adopted; nothing more to move)
+                    st["state"] = "suspect"
+                    verdicts.append({"host": host, "to": "suspect",
+                                     "reason": "probation_relapse"})
+                    continue
+                hb = row.get("health") or {}
+                if int(hb.get("probes_ok", 0)) >= self.probation_probes:
+                    moves = self._commit_readmit(host, int(row["epoch"]))
+                    if self.on_rebalance_home is not None:
+                        r = self.on_rebalance_home(host, moves)
+                        if asyncio.iscoroutine(r):
+                            await r
+                    verdicts.append({"host": host, "to": "live",
+                                     "moves": len(moves)})
+        return verdicts
+
+    # -- SUSPECT: fence → adopt ------------------------------------------
+    async def suspect(self, host: str, reason: str, row: dict) -> List[
+        Tuple[Any, Any]
+    ]:
+        """The adoption sequence, in its load-bearing order: (1) fence
+        the lease at the broker — from this instant the zombie's
+        publishes are DLQ'd; (2) commit the placement move + counters
+        synchronously (no await can split it); (3) snapshot the flight
+        recorder; (4) run the deployment's adoption actuator; (5) lift
+        the cross-host fences once the adopter confirmed."""
+        fence_epoch = await self.bus.lease_fence(host)
+        moves = self._commit_adoption(host, reason, fence_epoch)
+        tenants = [old.tenant for old, _new in moves]
+        if self.flightrec is not None:
+            self.flightrec.snapshot(
+                f"host-adoption:{host}",
+                host=host, cause=reason, fence_epoch=fence_epoch,
+                tenants=tenants, variants=self._variants(moves),
+            )
+        if self.on_adopt is not None:
+            r = self.on_adopt(host, moves, reason)
+            if asyncio.iscoroutine(r):
+                await r
+        self._commit_fence_lift(host)
+        return moves
+
+    def _commit_adoption(
+        self, host: str, reason: str, fence_epoch: int
+    ) -> List[Tuple[Any, Any]]:
+        """Lease-commit → adoption bookkeeping. SYNCHRONOUS on purpose
+        (registered commit section, tools/registries.py): an await
+        between the SUSPECT mark and the adoption counters would let a
+        cancellation strand tenants half-moved."""
+        st = self._hosts.setdefault(host, {"state": "live", "sick": 0,
+                                           "epoch": 0})
+        self.placement.mark_suspect(host, reason)
+        moves = self.placement.adopt(host)
+        st.update(state="suspect", sick=0, fenced_epoch=fence_epoch,
+                  reason=reason)
+        self.metrics.counter(
+            "host_suspect_total", host=host, reason=reason
+        ).inc()
+        self.metrics.counter("host_lease_lost_total", host=host).inc()
+        if moves:
+            self.metrics.counter("host_adoptions_total").inc(len(moves))
+        return moves
+
+    def _commit_fence_lift(self, host: str) -> int:
+        """Epoch-bump → fence-lift (registered commit section): the
+        fences opened by ``adopt`` release together, after the adopter
+        confirmed — FIFO holds because the old host's later writes are
+        already epoch-fenced at the broker."""
+        n = self.placement.lift_fences(host)
+        self.metrics.counter("host_fence_lifts_total", host=host).inc(
+            max(1, n)
+        )
+        return n
+
+    def _commit_readmit(self, host: str, epoch: int) -> List[
+        Tuple[Any, Any]
+    ]:
+        """Probation passed: readmit the host's shards and compute the
+        rebalance-home moves in one synchronous step."""
+        moves = self.placement.readmit_host(host)
+        st = self._hosts[host]
+        st.update(state="live", sick=0, epoch=epoch)
+        self.metrics.counter("host_readmitted_total", host=host).inc()
+        logger.info("host readmitted: %s (%d tenants rebalancing home)",
+                    host, len(moves))
+        return moves
+
+    def _variants(self, moves) -> List[dict]:
+        """The kernel variants serving the adopted tenants — 'which
+        fused/int8 build was live when the host died' reads very
+        differently across a rollout (PR 13 snapshot pattern)."""
+        if self.scorehealth is None:
+            return []
+        out = []
+        for old, _new in moves:
+            try:
+                out.append(self.scorehealth.variant(old.tenant))
+            except Exception:  # noqa: BLE001 - telemetry only
+                out.append({})
+        return out

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from sitewhere_tpu.runtime import safepickle
 from sitewhere_tpu.runtime.bus import EventBus, FaultPlan, TopicNaming
+from sitewhere_tpu.runtime.hostlease import LeaseTable
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, cancel_and_wait
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 
@@ -91,7 +92,7 @@ def _dump(obj: Any, topic: Optional[str] = None) -> Tuple[bytes, bytes]:
 
 def _publish_topic(op: str, args: tuple) -> Optional[str]:
     """The topic a payload-bearing op targets (for write-path errors)."""
-    if op in ("publish", "publish_nowait") and args:
+    if op in ("publish", "publish_nowait", "publish_fenced") and args:
         return str(args[0])
     return None
 
@@ -102,6 +103,24 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     if n > MAX_FRAME:
         raise ValueError(f"frame too large: {n}")
     return safepickle.loads(await reader.readexactly(n))
+
+
+class _ConnCtx:
+    """Per-connection broker state: the reply writer + its lock, the
+    pending consume polls by req_id (cancellable — by the client via
+    ``consume_cancel``, or by a lease fence revoking the host's group
+    membership), and the host ids whose lease ops arrived on this
+    connection (a serving host multiplexes its lease client and its
+    consumers over ONE socket, which is what makes fence-time poll
+    revocation possible)."""
+
+    __slots__ = ("writer", "write_lock", "consumes", "hosts")
+
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self.writer = writer
+        self.write_lock = asyncio.Lock()
+        self.consumes: Dict[Any, asyncio.Task] = {}
+        self.hosts: set = set()
 
 
 class BusBrokerServer(LifecycleComponent):
@@ -121,6 +140,13 @@ class BusBrokerServer(LifecycleComponent):
         # whose logs + cursors survive kill -9 (round-4 verdict item 4)
         self.bus = bus if bus is not None else EventBus(naming, retention)
         self.metrics = metrics or MetricsRegistry()
+        # host fault domain (docs/ROBUSTNESS.md "Host fault domains"):
+        # the broker is the authority on which process holds which
+        # slice-set lease, at which epoch — the single place a zombie
+        # host's stale-epoch writes can be fenced atomically with the
+        # publish they ride on
+        self.leases = LeaseTable(metrics=self.metrics)
+        self._host_conns: Dict[str, set] = {}  # host id → {_ConnCtx}
         self._clamp_logged: set = set()
         self.host = host
         self.port = port
@@ -147,7 +173,7 @@ class BusBrokerServer(LifecycleComponent):
     ) -> None:
         task = asyncio.current_task()
         self._conn_tasks.add(task)
-        write_lock = asyncio.Lock()
+        conn = _ConnCtx(writer)
         pending: set = set()
         try:
             while True:
@@ -161,22 +187,48 @@ class BusBrokerServer(LifecycleComponent):
                     # and every other client stay up
                     self._record_error("frame", exc)
                     return
+                if op == "consume_cancel":
+                    # the client-side consumer task was cancelled (tenant
+                    # teardown, handoff): kill its pending long-poll NOW,
+                    # before a future publish gets delivered into the void
+                    # — the in-proc poll commits the group cursor at
+                    # delivery, so a stale poll that outlives its caller
+                    # silently eats the next item. Cancelling while the
+                    # poll waits is loss-free: nothing is taken until
+                    # delivery.
+                    t = conn.consumes.get(args[0]) if args else None
+                    if t is not None:
+                        t.cancel()
+                    self.metrics.counter("netbus_consume_cancels_total").inc()
+                    continue
                 # each request runs in its own task so a long-poll can't
                 # block other ops multiplexed on this connection
                 t = asyncio.create_task(
-                    self._handle(req_id, op, args, writer, write_lock)
+                    self._handle(req_id, op, args, conn)
                 )
                 pending.add(t)
                 t.add_done_callback(pending.discard)
+                if op == "consume" and req_id is not None:
+                    conn.consumes[req_id] = t
+                    t.add_done_callback(
+                        lambda _t, r=req_id: conn.consumes.pop(r, None)
+                    )
         finally:
             for t in list(pending):
                 await cancel_and_wait(t)
+            for h in conn.hosts:
+                conns = self._host_conns.get(h)
+                if conns is not None:
+                    conns.discard(conn)
+                    if not conns:
+                        self._host_conns.pop(h, None)
             writer.close()
             self._conn_tasks.discard(task)
 
-    async def _handle(self, req_id, op, args, writer, write_lock) -> None:
+    async def _handle(self, req_id, op, args, conn: _ConnCtx) -> None:
+        writer, write_lock = conn.writer, conn.write_lock
         try:
-            value = await self._dispatch(op, args)
+            value = await self._dispatch(op, args, conn)
             ok = True
         except asyncio.CancelledError:
             raise
@@ -193,11 +245,61 @@ class BusBrokerServer(LifecycleComponent):
             # poison the connection either — surface it as a call error
             frame = _dump((req_id, False, f"{type(exc).__name__}: {exc}"))
             self._record_error(op, exc)
-        async with write_lock:
-            writer.writelines(frame)
-            await writer.drain()
+        try:
+            async with write_lock:
+                writer.writelines(frame)
+                await writer.drain()
+        except asyncio.CancelledError:
+            if op == "consume" and ok and isinstance(value, list) and value:
+                # a consume_cancel (or connection teardown) raced an
+                # in-flight delivery: the cursor is already past these
+                # items and the reply will never land — at-most-once
+                # loses them. Count loudly; the wide stale-poll window
+                # is closed by consume_cancel, this is the residual
+                # delivery-already-taken instant.
+                self.metrics.counter(
+                    "netbus_cancelled_delivery_dropped_total"
+                ).inc(len(value))
+                logger.warning(
+                    "consume delivery of %d item(s) dropped by "
+                    "cancellation before the reply was written",
+                    len(value),
+                )
+            raise
 
-    async def _dispatch(self, op: str, args: tuple) -> Any:
+    def _bind_host_conn(self, host_id: str, conn: Optional[_ConnCtx]) -> None:
+        """Remember which connection a host's lease ops ride on — the
+        same multiplexed socket carries its consumers, so a fence can
+        find (and revoke) the host's parked polls."""
+        if conn is None:
+            return
+        conn.hosts.add(host_id)
+        self._host_conns.setdefault(host_id, set()).add(conn)
+
+    def _revoke_host_polls(self, host_id: str) -> None:
+        """Fence-time group-membership revocation: cancel every parked
+        consume poll on the fenced host's connection(s) and reply ``[]``
+        so the client's consumer (if it ever thaws) sees an empty poll,
+        not a hang. Cancelling a parked poll is loss-free — the in-proc
+        poll takes nothing until delivery. The replies skip ``drain()``
+        on purpose: a frozen host isn't reading, and the fence dispatch
+        must not block on its socket buffer."""
+        for conn in self._host_conns.get(host_id, ()):
+            for req_id, t in list(conn.consumes.items()):
+                if t.done():
+                    continue
+                t.cancel()
+                self.metrics.counter(
+                    "netbus_fence_revoked_polls_total", host=host_id
+                ).inc()
+                try:
+                    conn.writer.writelines(_dump((req_id, True, [])))
+                except (ConnectionError, OSError, RuntimeError):
+                    pass  # connection already tearing down
+
+    async def _dispatch(
+        self, op: str, args: tuple, conn: Optional[_ConnCtx] = None
+    ) -> Any:
         bus = self.bus
         if op == "publish":
             return await bus.publish(*args)
@@ -271,6 +373,57 @@ class BusBrokerServer(LifecycleComponent):
             )
         if op == "clear_faults":
             return bus.clear_faults(*args)
+        # -- host lease control plane (runtime.hostlease) ----------------
+        if op == "lease_acquire":
+            host_id, slices, ttl_s, min_epoch = args
+            self._bind_host_conn(str(host_id), conn)
+            return self.leases.acquire(
+                host_id, slices, ttl_s, min_epoch=min_epoch
+            )
+        if op == "lease_renew":
+            host_id, epoch, ttl_s, health = args
+            self._bind_host_conn(str(host_id), conn)
+            return self.leases.renew(host_id, epoch, ttl_s, health)
+        if op == "lease_release":
+            return self.leases.release(*args)
+        if op == "lease_fence":
+            high = self.leases.fence(*args)
+            # the lease is also the consumer-group SESSION: fencing a
+            # host revokes its parked consume polls, Kafka-rebalance
+            # style. Without this a hung-but-connected host (SIGSTOP)
+            # keeps its long-polls parked at the broker, and every
+            # publish after adoption is delivered into its frozen socket
+            # buffer — the cursor advances and the adopter starves.
+            self._revoke_host_polls(str(args[0]) if args else "")
+            return high
+        if op == "lease_table":
+            return self.leases.table()
+        if op == "metrics_snapshot":
+            # chaos harnesses + operators read broker-side counters
+            # (fenced publishes, lease churn) without a scrape endpoint
+            return self.metrics.snapshot()
+        if op == "publish_fenced":
+            # the zombie-fencing commit point: the lease check and the
+            # publish happen in ONE broker-side dispatch, so "lease lost
+            # after the check" cannot interleave with the append. A
+            # stale-epoch publish is rejected, counted, and DLQ'd —
+            # never silently double-served, never silently dropped.
+            topic, payload, key, host_id, epoch = args
+            if self.leases.check(host_id, epoch):
+                return {
+                    "fenced": False,
+                    "offset": await bus.publish(topic, payload, key),
+                }
+            self.metrics.counter(
+                "host_fenced_publishes_total", host=str(host_id)
+            ).inc()
+            naming = getattr(bus, "naming", None) or TopicNaming()
+            off = bus.publish_nowait(
+                naming.host_fenced(str(host_id)),
+                {"topic": topic, "host": host_id, "epoch": epoch,
+                 "payload": payload},
+            )
+            return {"fenced": True, "offset": off}
         raise ValueError(f"unknown op '{op}'")
 
 
@@ -423,6 +576,15 @@ class RemoteEventBus:
                     fut.set_result(value)
                 else:
                     fut.set_exception(RuntimeError(value))
+            elif ok and isinstance(value, list) and value:
+                # a delivery beat our consume_cancel to the wire: the
+                # broker committed the cursor, but no caller is awaiting.
+                # Loud, not silent — this is the residual at-most-once
+                # window the cancel op shrinks from seconds to an RTT.
+                logger.warning(
+                    "discarding %d item(s) delivered to a cancelled "
+                    "consume (req_id=%s)", len(value), req_id,
+                )
 
     async def _call(self, op: str, *args) -> Any:
         loop = asyncio.get_running_loop()
@@ -442,6 +604,20 @@ class RemoteEventBus:
                 self._writer.writelines(frame)
                 await self._writer.drain()
                 return await fut
+            except asyncio.CancelledError:
+                # our caller's task was cancelled (component terminate,
+                # tenant handoff) while this call was in flight. For a
+                # consume that leaves a live long-poll on the broker:
+                # the next publish would be delivered against THIS dead
+                # future and discarded — a silent row loss. Tell the
+                # broker to cancel the poll (loss-free while it waits).
+                self._futures.pop(req_id, None)
+                if op == "consume" and self._writer is not None:
+                    try:
+                        self._send_nowait("consume_cancel", req_id)
+                    except Exception:  # noqa: BLE001 - teardown path
+                        pass
+                raise
             except ConnectionError:
                 # broker died mid-call. Retrying may re-apply a mutation
                 # whose first attempt landed before the crash (at-least-
@@ -543,6 +719,75 @@ class RemoteEventBus:
 
     def clear_faults(self, topic: str) -> None:
         self._send_nowait("clear_faults", topic)
+
+    # -- host lease control plane ----------------------------------------
+    # Lease ops ride ``_call``, i.e. the SAME jittered-backoff reconnect
+    # path every awaited op gets: a renewal issued mid-reconnect retries
+    # against the window and lands carrying its original epoch — the
+    # epoch is an argument, not connection state, so a broker bounce
+    # never resets it (tests/test_netbus.py reconnect-during-renewal).
+    async def lease_acquire(
+        self,
+        host_id: str,
+        slices: tuple = (),
+        ttl_s: Optional[float] = None,
+        min_epoch: int = 0,
+    ) -> dict:
+        return await self._call(
+            "lease_acquire", host_id, tuple(slices), ttl_s, int(min_epoch)
+        )
+
+    async def lease_renew(
+        self,
+        host_id: str,
+        epoch: int,
+        ttl_s: Optional[float] = None,
+        health: Optional[dict] = None,
+    ) -> dict:
+        try:
+            return await self._call(
+                "lease_renew", host_id, int(epoch), ttl_s,
+                dict(health or {}),
+            )
+        except (ConnectionError, RuntimeError):
+            # the broker stayed unreachable past the reconnect window
+            # (or rejected the frame): the caller keeps its epoch and
+            # retries next tick — counted, never silent, because a host
+            # quietly failing renewals is exactly how a lease expires
+            # out from under live traffic
+            self.metrics.counter(
+                "netbus_lease_renew_failures_total", host=str(host_id)
+            ).inc()
+            raise
+
+    async def lease_release(self, host_id: str, epoch: int) -> bool:
+        return await self._call("lease_release", host_id, int(epoch))
+
+    async def lease_fence(self, host_id: str) -> int:
+        return await self._call("lease_fence", host_id)
+
+    async def lease_table(self) -> dict:
+        return await self._call("lease_table")
+
+    async def metrics_snapshot(self) -> dict:
+        return await self._call("metrics_snapshot")
+
+    async def publish_fenced(
+        self, topic: str, payload: Any, host_id: str, epoch: int,
+        key: Any = None,
+    ) -> dict:
+        return await self._call(
+            "publish_fenced", topic, payload, key, host_id, int(epoch)
+        )
+
+    def publish_fenced_nowait(
+        self, topic: str, payload: Any, host_id: str, epoch: int,
+        key: Any = None,
+    ) -> int:
+        self._send_nowait(
+            "publish_fenced", topic, payload, key, host_id, int(epoch)
+        )
+        return -1  # offset unknowable without a round trip
 
     # checkpoint seam — async here (network), awaited by CheckpointManager
     # callers that support remote buses

@@ -1,0 +1,273 @@
+"""Shared helpers for the multi-process host fault-domain suites
+(tests/test_instance_kill.py, tests/test_host_chaos.py): spawn a real
+netbus broker + ``hostserve`` serving processes as OS subprocesses,
+drive them over a test-side ``RemoteEventBus`` with hostctl ops, and
+decode the accounting reports.
+
+Kept import-light at module level (no jax) so collecting the chaos
+suite on a skipping rig stays cheap.
+"""
+
+import asyncio
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+ROWS = 8
+DEVICE_TOKENS = tuple(f"dev-{i}" for i in range(4))
+READY_TIMEOUT_S = 120.0  # cold jax import in the child dominates
+
+
+def _child_env(cache_dir: Path = None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    if cache_dir is not None:
+        # shared persistent compile cache: a RESPAWNED host must not
+        # stall its event loop (and miss lease renewals) on a cold
+        # jit compile the first incarnation already paid for
+        env["JAX_COMPILATION_CACHE_DIR"] = str(cache_dir)
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    return env
+
+
+class Proc:
+    """One spawned child (broker or host): stdout drained on a thread
+    into a line queue (READY parsing without pipe-deadlock risk),
+    stderr appended to a log file for post-mortem."""
+
+    def __init__(self, argv, log_path: Path, cache_dir: Path = None):
+        self.log_path = log_path
+        self._log = open(log_path, "ab")
+        self.p = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=self._log,
+            env=_child_env(cache_dir),
+            cwd=str(Path(__file__).resolve().parents[1]),
+        )
+        self._lines: "queue.Queue[bytes]" = queue.Queue()
+        self._reader = threading.Thread(target=self._drain, daemon=True)
+        self._reader.start()
+
+    def _drain(self):
+        for line in self.p.stdout:
+            self._lines.put(line)
+
+    @property
+    def pid(self) -> int:
+        return self.p.pid
+
+    def ready(self, timeout_s: float = READY_TIMEOUT_S) -> dict:
+        """Block until the child prints its READY json line."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"no READY within {timeout_s}s; see {self.log_path}"
+                )
+            if self.p.poll() is not None:
+                tail = self.log_path.read_bytes()[-2000:].decode(errors="replace")
+                raise RuntimeError(
+                    f"child exited rc={self.p.returncode} before READY:\n{tail}"
+                )
+            try:
+                line = self._lines.get(timeout=min(left, 0.5))
+            except queue.Empty:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and obj.get("ready"):
+                return obj
+
+    def kill9(self):
+        try:
+            os.kill(self.p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.p.wait(timeout=30)
+
+    def sigstop(self):
+        os.kill(self.p.pid, signal.SIGSTOP)
+
+    def sigcont(self):
+        os.kill(self.p.pid, signal.SIGCONT)
+
+    def stop(self):
+        """Best-effort teardown at test end."""
+        if self.p.poll() is None:
+            try:
+                os.kill(self.p.pid, signal.SIGCONT)  # in case STOPped
+            except ProcessLookupError:
+                pass
+            self.p.terminate()
+            try:
+                self.p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.p.kill()
+                self.p.wait(timeout=10)
+        try:
+            self._log.close()
+        except OSError:
+            pass
+
+
+def spawn_broker(tmp: Path, instance_id: str, *, durable: bool = False) -> "tuple[Proc, int]":
+    argv = [
+        sys.executable, "-m", "sitewhere_tpu.runtime.netbus",
+        "--port", "0", "--instance-id", instance_id,
+    ]
+    if durable:
+        argv += ["--data-dir", str(tmp / "broker")]
+    proc = Proc(argv, tmp / "broker.log")
+    ready = proc.ready()
+    return proc, int(ready["port"])
+
+
+def spawn_host(
+    tmp: Path,
+    port: int,
+    host_id: str,
+    instance_id: str,
+    *,
+    lease_ttl: float = 0.0,
+    renew_interval: float = None,
+    probation_probes: int = 2,
+    restore: bool = False,
+    recover_unscored: bool = False,
+) -> Proc:
+    data_dir = tmp / f"data-{host_id}"
+    argv = [
+        sys.executable, "-m", "sitewhere_tpu.runtime.hostserve",
+        "--broker-port", str(port),
+        "--host-id", host_id,
+        "--instance-id", instance_id,
+        "--data-dir", str(data_dir),
+        "--mesh", "1,1,8",
+        "--lease-ttl", str(lease_ttl),
+        "--probation-probes", str(probation_probes),
+    ]
+    if renew_interval is not None:
+        argv += ["--renew-interval", str(renew_interval)]
+    if restore:
+        argv += ["--restore"]
+    if recover_unscored:
+        argv += ["--recover-unscored"]
+    # log file per incarnation so a respawn doesn't clobber the victim's
+    suffix = 0
+    while (tmp / f"host-{host_id}.{suffix}.log").exists():
+        suffix += 1
+    return Proc(argv, tmp / f"host-{host_id}.{suffix}.log",
+                cache_dir=tmp / "jaxcache")
+
+
+def tenant_cfg_dict(tenant: str) -> dict:
+    """A small fast-flush tenant config as the hostctl ``adopt`` op's
+    wire dict (built test-side, decoded by the serving process)."""
+    from sitewhere_tpu.runtime.config import (
+        FaultTolerancePolicy,
+        MicroBatchConfig,
+        TenantEngineConfig,
+        tenant_config_to_dict,
+    )
+
+    return tenant_config_to_dict(TenantEngineConfig(
+        tenant=tenant,
+        model_config={"hidden": 8},
+        microbatch=MicroBatchConfig(
+            max_batch=64, deadline_ms=1.0, buckets=(32, 64), window=8
+        ),
+        fault_tolerance=FaultTolerancePolicy(
+            flush_deadline_ms=800.0, flush_deadline_x=8.0,
+            probation_probes=2, probe_interval_s=0.1,
+            backoff_base_s=0.002, backoff_max_s=0.02,
+        ),
+        max_streams=64,
+    ))
+
+
+def round_batch(tenant: str, r: int):
+    """value = 100*round + i: the per-round fingerprint both suites
+    decode back out of the store via the report op's ``round_rows``."""
+    from sitewhere_tpu.core.batch import MeasurementBatch
+
+    return MeasurementBatch.from_columns(
+        tenant,
+        [DEVICE_TOKENS[i % len(DEVICE_TOKENS)] for i in range(ROWS)],
+        ["temperature"] * ROWS,
+        [100.0 * r + float(i) for i in range(ROWS)],
+        [0.0] * ROWS,
+    )
+
+
+async def publish_round(bus, tenant: str, r: int):
+    await bus.publish(bus.naming.inbound_events(tenant), round_batch(tenant, r))
+
+
+async def ctl(bus, host_id: str, op: dict):
+    """Send one hostctl op to a serving process (FIFO per host: the
+    server's single ctl loop executes ops in publish order)."""
+    await bus.publish(
+        bus.naming.global_topic(f"hostctl.{host_id}"), dict(op)
+    )
+
+
+class Reporter:
+    """Request/await accounting reports from serving processes over a
+    private reply topic (one consumer group per Reporter)."""
+
+    def __init__(self, bus, name: str = "reports"):
+        self.bus = bus
+        self.topic = bus.naming.global_topic(f"test-reply.{name}")
+        self.group = f"reporter[{name}]"
+        bus.subscribe(self.topic, self.group)
+
+    async def report(self, host_id: str, timeout_s: float = 60.0) -> dict:
+        await ctl(self.bus, host_id, {"op": "report", "reply_to": self.topic})
+        deadline = time.monotonic() + timeout_s
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(f"no report from {host_id} in {timeout_s}s")
+            got = await self.bus.consume(
+                self.topic, self.group, 8, timeout_s=min(left, 1.0)
+            )
+            for rep in got:
+                if isinstance(rep, dict) and rep.get("host") == host_id:
+                    return rep
+
+    async def wait_rounds(
+        self,
+        host_id: str,
+        tenant: str,
+        want_rounds,
+        *,
+        rows: int = ROWS,
+        timeout_s: float = 90.0,
+    ) -> dict:
+        """Poll reports until ``tenant``'s store holds every round in
+        ``want_rounds`` with the full distinct-row count; returns the
+        satisfying report."""
+        want = {int(r) for r in want_rounds}
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            last = await self.report(host_id, timeout_s=timeout_s)
+            rr = last.get("round_rows", {}).get(tenant, {})
+            if all(rr.get(r, 0) >= rows for r in want):
+                return last
+            await asyncio.sleep(0.2)
+        raise AssertionError(
+            f"{host_id}/{tenant}: rounds {sorted(want)} x{rows} not reached; "
+            f"last round_rows={last.get('round_rows') if last else None}"
+        )

@@ -1,0 +1,416 @@
+"""Multi-process host fault-domain chaos suite (ISSUE 16 acceptance):
+a real netbus broker + two real ``hostserve`` OS processes on a shared
+instance id, supervised by an in-test :class:`HostSupervisor` +
+:class:`HostPlacement` coordinator whose actuators publish hostctl ops
+— then the harness delivers the faults the in-process plan cannot:
+
+- ``kill -9`` one host mid-traffic: lease expiry → fence → tenants
+  adopted cross-host (params handed off as already-encoded checkpoint
+  bytes), rounds published while the host is dead land FULLY on the
+  adopter (consumer-group cursor continuity — zero loss), FIFO holds,
+  and a respawned host earns probation probes and gets a tenant
+  rebalanced home.
+- ``SIGSTOP`` (hung host, not dead): same adoption path while frozen;
+  on SIGCONT the zombie's first renewal is stale → it quiesces, re-
+  acquires past the fence, lands its probation probes by itself
+  (rebirth path), and the supervisor brings a tenant home.
+- netbus ``partition`` (injected at the lease plane) with NO spare
+  capacity: the tenant degrades in place, the partitioned host keeps
+  serving as a zombie — its data-plane publishes are epoch-fenced at
+  the broker (counted + DLQ'd, never silently double-served); healing
+  the partition walks it through lease-loss rebirth back to LIVE, and
+  the operator requeues the DLQ'd batches to close accounting to zero
+  loss.
+
+Run standalone via ``tools/run_host_chaos.sh`` (chaos+slow marked —
+excluded from tier-1; tests/test_instance_kill.py is the tier-1 floor).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tests._hostproc import (
+    ROWS,
+    Reporter,
+    ctl,
+    publish_round,
+    spawn_broker,
+    spawn_host,
+    tenant_cfg_dict,
+)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+LEASE_TTL = 4.0
+RENEW_S = 0.5
+
+
+def _fam_sum(snapshot, family):
+    return sum(
+        float(v) for k, v in snapshot.items()
+        if (k == family or k.startswith(family + "{"))
+        and isinstance(v, (int, float))
+    )
+
+
+async def _wait_for(cond, timeout_s=60.0, interval=0.1):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if cond():
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        await asyncio.sleep(interval)
+
+
+async def _wait_for_tenant(cl, host, tenant, timeout_s=30.0):
+    """Poll reports until ``tenant`` shows up in ``host``'s serving set
+    (the adopt-op completion barrier)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rep = await cl.rep.report(host)
+        if tenant in rep["tenants"]:
+            return True
+        await asyncio.sleep(0.5)
+    return False
+
+
+class Cluster:
+    """Broker + hosts h0/h1 (shards 0/1) as subprocesses, plus the
+    in-test coordinator: placement, supervisor, and the actuators that
+    publish hostctl ops (the deployment side of ``on_adopt`` /
+    ``on_rebalance_home``)."""
+
+    def __init__(self, tmp, *, slots_per_shard=8):
+        self.tmp = tmp
+        self.slots_per_shard = slots_per_shard
+        self.procs = {}
+        self.extra_procs = []
+        self.bus = None
+        self.sup = None
+        self.placement = None
+        self.rep = None
+        self.port = None
+        self.adoptions = []
+        self.homecomings = []
+
+    # -- coordinator actuators -------------------------------------------
+    def data_dir(self, host):
+        return str(self.tmp / f"data-{host}")
+
+    async def _on_adopt(self, host, moves, reason):
+        for old, new in moves:
+            target = self.placement.host_of(new.shard)
+            await ctl(self.bus, target, {
+                "op": "adopt",
+                "config": tenant_cfg_dict(old.tenant),
+                "params_from": self.data_dir(host),
+            })
+            self.adoptions.append(
+                {"tenant": old.tenant, "from": host, "to": target,
+                 "reason": reason}
+            )
+
+    async def _on_home(self, host, moves):
+        for old, new in moves:
+            src = self.placement.host_of(old.shard) or host
+            dst = self.placement.host_of(new.shard)
+            # the donor must QUIESCE the tenant before the adopter
+            # subscribes: checkpoint (fresh params for the handoff),
+            # drop, then a report as the FIFO barrier — otherwise both
+            # hosts briefly share the consumer group and a row consumed
+            # by the donor after its checkpoint dies with the drop
+            await ctl(self.bus, src, {"op": "checkpoint"})
+            await ctl(self.bus, src, {"op": "drop", "tenant": old.tenant})
+            await self.ctl_rep.report(src)
+            await ctl(self.bus, dst, {
+                "op": "adopt",
+                "config": tenant_cfg_dict(old.tenant),
+                "params_from": self.data_dir(src),
+            })
+            self.homecomings.append(
+                {"tenant": old.tenant, "from": src, "to": dst}
+            )
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self, layout):
+        """``layout`` maps host → tenants, e.g. {"h0": ["t-a"], ...}.
+        Subprocesses come up, tenants are adopted onto their homes, and
+        one round of traffic lands per tenant BEFORE the supervisor
+        starts (first-flush jax compile must not read as a hung host)."""
+        from sitewhere_tpu.parallel.placement import HostPlacement
+        from sitewhere_tpu.runtime.bus import TopicNaming
+        from sitewhere_tpu.runtime.hostlease import HostSupervisor
+        from sitewhere_tpu.runtime.netbus import RemoteEventBus
+
+        broker, self.port = spawn_broker(self.tmp, "hc")
+        self.extra_procs.append(broker)
+        for host in layout:
+            self.procs[host] = spawn_host(
+                self.tmp, self.port, host, "hc",
+                lease_ttl=LEASE_TTL, renew_interval=RENEW_S,
+            )
+        for host, proc in self.procs.items():
+            ready = proc.ready()
+            assert ready["epoch"] >= 1, f"{host} came up without a lease"
+
+        self.bus = RemoteEventBus(
+            "127.0.0.1", self.port, naming=TopicNaming("hc")
+        )
+        await self.bus.connect()
+        self.rep = Reporter(self.bus, "chaos")
+        # separate reply topic/group for actuator barriers: the test
+        # task and the supervisor task must not split one group's stream
+        self.ctl_rep = Reporter(self.bus, "actuator")
+
+        self.placement = HostPlacement(
+            len(layout), slots_per_shard=self.slots_per_shard
+        )
+        shard_of = {h: i for i, h in enumerate(layout)}
+        for host, shard in shard_of.items():
+            self.placement.register_host(host, [shard])
+        for host, tenants in layout.items():
+            for t in tenants:
+                self.placement.place(t, prefer_shard=shard_of[host])
+                await ctl(self.bus, host, {
+                    "op": "adopt", "config": tenant_cfg_dict(t),
+                })
+        for host, tenants in layout.items():
+            first = await self.rep.report(host)
+            assert set(tenants) <= set(first["tenants"])
+            for t in tenants:
+                await publish_round(self.bus, t, 0)
+            await self.rep.wait_rounds(host, tenants[0], {0})
+
+        self.sup = HostSupervisor(
+            self.bus, self.placement,
+            tick_s=0.2, probation_probes=2,
+            on_adopt=self._on_adopt, on_rebalance_home=self._on_home,
+        )
+        await self.sup.start()
+        return self
+
+    async def close(self):
+        if self.sup is not None:
+            await self.sup.terminate()
+        if self.bus is not None:
+            await self.bus.close()
+        for p in list(self.procs.values()) + self.extra_procs:
+            p.stop()
+
+    async def wait_state(self, host, state, timeout_s=30.0):
+        ok = await _wait_for(
+            lambda: self.sup.host_state(host) == state, timeout_s
+        )
+        assert ok, (
+            f"{host} never reached {state!r}; supervisor sees "
+            f"{self.sup.describe()}"
+        )
+
+
+LAYOUT = {"h0": ["t-a", "t-b"], "h1": ["t-c"]}
+
+
+async def test_kill9_adoption_zero_loss_and_rebalance_home(tmp_path):
+    cl = Cluster(tmp_path)
+    try:
+        await cl.start(LAYOUT)
+
+        # steady-state traffic, then checkpoint the victim (its periodic
+        # checkpoint in miniature) so rounds 1-2 are accounted on disk
+        for r in (1, 2):
+            for t in ("t-a", "t-b", "t-c"):
+                await publish_round(cl.bus, t, r)
+        await cl.rep.wait_rounds("h0", "t-a", {0, 1, 2})
+        await cl.rep.wait_rounds("h0", "t-b", {0, 1, 2})
+        await ctl(cl.bus, "h0", {"op": "checkpoint"})
+        pre = await cl.rep.report("h0")  # FIFO barrier: checkpoint done
+        assert pre["held"] is True and pre["epoch"] >= 1
+
+        cl.procs["h0"].kill9()
+        # rounds published while NOBODY serves t-a/t-b: they must sit in
+        # the broker and land on the adopter via cursor continuity
+        for r in (3, 4):
+            for t in ("t-a", "t-b", "t-c"):
+                await publish_round(cl.bus, t, r)
+
+        await cl.wait_state("h0", "suspect")
+        # the state flips at the adoption verdict; the on_adopt actuator
+        # (and the fence lift behind it) finish moments later
+        assert await _wait_for(
+            lambda: {a["tenant"] for a in cl.adoptions} == {"t-a", "t-b"}
+            and cl.placement.fences("h0") == {}, 30.0
+        ), (cl.adoptions, cl.placement.describe())
+        assert all(a["to"] == "h1" for a in cl.adoptions)
+        assert cl.placement.host_state("h0") == "suspect"
+
+        # ZERO LOSS: every dead-window round lands fully on the adopter;
+        # the healthy host's own tenant never hiccuped
+        fin_a = await cl.rep.wait_rounds("h1", "t-a", {3, 4})
+        fin_b = await cl.rep.wait_rounds("h1", "t-b", {3, 4})
+        await cl.rep.wait_rounds("h1", "t-c", {0, 1, 2, 3, 4})
+        assert set(fin_a["tenants"]) == {"t-a", "t-b", "t-c"}
+        # FIFO on the adopter: round first-appearance order is sorted
+        for fin, t in ((fin_a, "t-a"), (fin_b, "t-b")):
+            order = fin["round_order"][t]
+            assert order == sorted(order), (t, order)
+
+        # respawn: fresh process, fresh epoch past the fence; probes are
+        # the probation currency (the coordinator requests them)
+        cl.procs["h0"] = spawn_host(
+            tmp_path, cl.port, "h0", "hc",
+            lease_ttl=LEASE_TTL, renew_interval=RENEW_S,
+        )
+        ready = cl.procs["h0"].ready()
+        assert ready["epoch"] > pre["epoch"]  # monotonic past the fence
+        await cl.wait_state("h0", "probation")
+        await ctl(cl.bus, "h0", {"op": "probe", "n": 2})
+        await cl.wait_state("h0", "live")
+        assert cl.placement.host_state("h0") == "live"
+
+        # rebalance home: 3 tenants / 2 shards → exactly one comes home
+        # (the actuator finishes its quiesce barrier after the verdict)
+        assert await _wait_for(lambda: len(cl.homecomings) >= 1, 30.0)
+        assert len(cl.homecomings) == 1
+        home = cl.homecomings[0]
+        assert home["to"] == "h0" and home["from"] == "h1"
+        t_home = home["tenant"]
+        assert await _wait_for_tenant(cl, "h0", t_home), (
+            f"{t_home} never arrived home on h0"
+        )
+        await publish_round(cl.bus, t_home, 5)
+        rep0 = await cl.rep.wait_rounds("h0", t_home, {5})
+        assert rep0["held"] is True
+    finally:
+        await cl.close()
+
+
+async def test_sigstop_hung_host_adoption_and_self_rebirth(tmp_path):
+    cl = Cluster(tmp_path)
+    try:
+        await cl.start(LAYOUT)
+        pre = await cl.rep.report("h0")
+
+        cl.procs["h0"].sigstop()
+        await cl.wait_state("h0", "suspect")
+        assert await _wait_for(
+            lambda: {a["tenant"] for a in cl.adoptions} == {"t-a", "t-b"},
+            30.0,
+        ), cl.adoptions
+
+        # rounds published while h0 is FROZEN and fenced: a hung host's
+        # TCP connection stays open, so its long-polls would stay parked
+        # at the broker and eat these publishes into its frozen socket
+        # buffer — the fence revoked them (lease = group membership),
+        # and frozen means it cannot re-poll. Full landing on the
+        # adopter is deterministic.
+        for r in (1, 2):
+            for t in ("t-a", "t-b", "t-c"):
+                await publish_round(cl.bus, t, r)
+        fin_a = await cl.rep.wait_rounds("h1", "t-a", {1, 2})
+        await cl.rep.wait_rounds("h1", "t-b", {1, 2})
+        await cl.rep.wait_rounds("h1", "t-c", {0, 1, 2})
+        order = fin_a["round_order"]["t-a"]
+        assert order == sorted(order), order
+
+        # wake the zombie: its first renewal comes back stale → rebirth
+        # (quiesce tenants, re-acquire past the fence, self-probe) — the
+        # supervisor walks it probation → live with NO operator help
+        cl.procs["h0"].sigcont()
+        await cl.wait_state("h0", "probation", timeout_s=60.0)
+        await cl.wait_state("h0", "live", timeout_s=60.0)
+
+        rep0 = await cl.rep.report("h0")
+        assert rep0["held"] is True
+        assert rep0["epoch"] > pre["epoch"]
+        # lease loss was counted + snapshotted process-side; the rebirth
+        # dropped the adopted-away tenants before re-serving anything
+        assert await _wait_for(lambda: len(cl.homecomings) >= 1, 30.0)
+        assert len(cl.homecomings) == 1
+        t_home = cl.homecomings[0]["tenant"]
+        assert await _wait_for_tenant(cl, "h0", t_home), (
+            f"{t_home} never arrived home on h0"
+        )
+        await publish_round(cl.bus, t_home, 3)
+        await cl.rep.wait_rounds("h0", t_home, {3})
+    finally:
+        await cl.close()
+
+
+async def test_partition_zombie_publishes_fenced_then_heals(tmp_path):
+    # slots_per_shard=1: NO spare capacity — t-a degrades in place, so
+    # the partitioned host keeps serving it as a zombie and EVERY one of
+    # its data-plane publishes after the fence is deterministic DLQ bait
+    cl = Cluster(tmp_path, slots_per_shard=1)
+    try:
+        await cl.start({"h0": ["t-a"], "h1": ["t-c"]})
+        pre = await cl.rep.report("h0")
+        assert pre["fenced_publishes"] == 0
+
+        dlq = cl.bus.naming.host_fenced("h0")
+        await ctl(cl.bus, "h0", {
+            "op": "inject_fault",
+            "fault": {"kind": "partition", "ops": ["renew"]},
+        })
+        await cl.wait_state("h0", "suspect")
+        # no healthy capacity: the tenant stayed put, degraded in place
+        assert cl.adoptions == []
+        assert cl.placement.placement("t-a").shard == 0
+
+        # the zombie serves on (it re-polls right after the fence-time
+        # revocation, and nobody else holds the group): it consumes and
+        # scores round 1, but every data-plane claim it publishes dies
+        # at the broker — counted, DLQ'd, and NOT double-served. Its own
+        # store stays at round 0: persistence feeds off the scored topic
+        # the fence just closed to it.
+        await publish_round(cl.bus, "t-a", 1)
+        zomb = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            zomb = await cl.rep.report("h0")
+            if zomb["fenced_publishes"] >= 1:
+                break
+            await asyncio.sleep(0.2)
+        assert zomb["fenced_publishes"] >= 1, zomb
+        assert zomb["faults_injected"] >= 1
+        assert zomb["round_rows"]["t-a"] == {0: ROWS}, zomb["round_rows"]
+
+        snap = await cl.bus.metrics_snapshot()
+        assert _fam_sum(snap, "host_fenced_publishes_total") >= 1
+        entries = (await cl.bus.peek(dlq, 1000))["entries"]
+        assert entries, "fenced publishes were not DLQ'd"
+        recs = [e for _, e in entries]
+        assert all(r["host"] == "h0" for r in recs)
+        scored_topic = cl.bus.naming.scored_events("t-a")
+        dlq_scored = [r for r in recs if r["topic"] == scored_topic]
+        assert dlq_scored, sorted({r["topic"] for r in recs})
+
+        # heal the partition: the next renewal reaches the broker, comes
+        # back stale → rebirth → probation → live, no operator help
+        await ctl(cl.bus, "h0", {"op": "clear_faults"})
+        await cl.wait_state("h0", "probation", timeout_s=60.0)
+        await cl.wait_state("h0", "live", timeout_s=60.0)
+        assert cl.homecomings == []  # t-a never left shard 0
+
+        # operator escape hatch: re-adopt the quiesced tenant in place...
+        await ctl(cl.bus, "h0", {
+            "op": "adopt", "config": tenant_cfg_dict("t-a"),
+            "params_from": cl.data_dir("h0"),
+        })
+        adopted = await _wait_for_tenant(cl, "h0", "t-a")
+        assert adopted, "t-a never re-adopted on h0"
+        # ...then drain the fence DLQ: requeue the zombie's scored
+        # batches onto their original topic, where the re-adopted
+        # persistence consumer (cursor intact — the rebirth kept topics)
+        # picks them up. "Never silently dropped" closes to zero loss.
+        for r in dlq_scored:
+            await cl.bus.publish(r["topic"], r["payload"])
+        await publish_round(cl.bus, "t-a", 2)
+        fin = await cl.rep.wait_rounds("h0", "t-a", {1, 2})
+        assert fin["held"] is True and fin["epoch"] > pre["epoch"]
+        order = fin["round_order"]["t-a"]
+        assert order == sorted(order), order
+    finally:
+        await cl.close()

@@ -1,0 +1,609 @@
+"""Host fault domain, unit tier (docs/ROBUSTNESS.md "Host fault
+domains"): the lease table's epoch/fence algebra, the client's
+renew-loss path under injected host faults, host-aware placement, the
+supervisor's LIVE → SUSPECT → PROBATION → LIVE machine, the fenced
+publish path over a real socket, and the ``host_lease_lost`` watchdog
+rule. The multi-process kill/partition scenarios live in
+tests/test_host_chaos.py (chaos tier)."""
+
+import asyncio
+from contextlib import asynccontextmanager
+
+import pytest
+
+from sitewhere_tpu.parallel.placement import HostPlacement
+from sitewhere_tpu.parallel.tenant_router import PlacementError, TenantRouter
+from sitewhere_tpu.runtime.bus import TopicNaming
+from sitewhere_tpu.runtime.faultplan import (
+    HostFault,
+    HostFaultPlan,
+    InjectedHostFault,
+)
+from sitewhere_tpu.runtime.flightrec import FlightRecorder
+from sitewhere_tpu.runtime.history import MetricsHistory, Watchdog
+from sitewhere_tpu.runtime.hostlease import (
+    FencedBus,
+    HostLeaseClient,
+    HostSupervisor,
+    LeaseTable,
+    LocalLeaseTransport,
+)
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.netbus import BusBrokerServer, RemoteEventBus
+
+
+class _Clock:
+    """Injectable monotonic clock — lease expiry without real sleeps."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _fam_sum(snapshot, family):
+    return sum(
+        float(v) for k, v in snapshot.items()
+        if (k == family or k.startswith(family + "{"))
+        and isinstance(v, (int, float))
+    )
+
+
+# ---------------------------------------------------------- lease table
+def test_lease_epochs_monotonic_across_reacquire_release_and_min_epoch():
+    clk = _Clock()
+    t = LeaseTable(default_ttl_s=5.0, clock=clk)
+    g1 = t.acquire("h0", slices=(0, 1))
+    assert g1["epoch"] == 1 and g1["ttl_s"] == 5.0
+    # re-acquire (same host): fresh epoch past the old one
+    assert t.acquire("h0")["epoch"] == 2
+    # release does NOT reset the high-water
+    assert t.release("h0", 2)
+    assert t.acquire("h0")["epoch"] == 3
+    # a client re-asserting a higher epoch (broker restarted under it)
+    fresh = LeaseTable(default_ttl_s=5.0, clock=clk)
+    assert fresh.acquire("h0", min_epoch=7)["epoch"] == 8
+    # release with a stale epoch is a no-op
+    assert not t.release("h0", 2)
+
+
+def test_lease_renew_extends_and_stale_epoch_is_refused():
+    clk = _Clock()
+    t = LeaseTable(default_ttl_s=5.0, clock=clk)
+    epoch = t.acquire("h0")["epoch"]
+    clk.t = 4.0
+    r = t.renew("h0", epoch, health={"flush_timeout_rate": 0.1})
+    assert r == {"ok": True, "epoch": epoch}
+    row = t.table()["h0"]
+    assert row["expires_in_s"] == pytest.approx(5.0)
+    assert row["health"]["flush_timeout_rate"] == 0.1
+    # an out-raced epoch (someone re-acquired) is told the current one
+    t.acquire("h0")
+    assert t.renew("h0", epoch) == {"ok": False, "epoch": epoch + 1}
+
+
+def test_fence_bumps_high_water_and_blocks_zombie_paths():
+    clk = _Clock()
+    t = LeaseTable(default_ttl_s=5.0, clock=clk)
+    epoch = t.acquire("h0")["epoch"]
+    assert t.check("h0", epoch)
+    high = t.fence("h0")
+    assert high == epoch + 1
+    # every zombie surface is dead: check, renew, even the
+    # broker-restart re-adoption path (high-water outruns the grant)
+    assert not t.check("h0", epoch)
+    assert t.renew("h0", epoch)["ok"] is False
+    fresh = LeaseTable(default_ttl_s=5.0, clock=clk)
+    fresh._high["h0"] = high
+    assert fresh.renew("h0", epoch)["ok"] is False
+    # ...but a legitimate re-acquire clears the fence at a fresh epoch
+    e2 = t.acquire("h0")["epoch"]
+    assert e2 == high + 1
+    assert t.check("h0", e2)
+
+
+def test_broker_restart_renewal_readoption_and_epoch_zero_guard():
+    clk = _Clock()
+    fresh = LeaseTable(default_ttl_s=5.0, clock=clk)
+    # a fresh broker has no table; a renewing client's epoch is the
+    # best information there is — re-adopt at the claimed epoch
+    r = fresh.renew("hA", 3, health={"probes_ok": 1})
+    assert r == {"ok": True, "epoch": 3}
+    assert fresh.table()["hA"]["health"] == {"probes_ok": 1}
+    # a client that never held a lease (epoch 0) cannot self-adopt
+    assert fresh.renew("hB", 0)["ok"] is False
+
+
+def test_expiry_is_a_signal_not_a_fence():
+    clk = _Clock()
+    t = LeaseTable(default_ttl_s=5.0, clock=clk)
+    epoch = t.acquire("h0")["epoch"]
+    clk.t = 6.0
+    assert t.expired() == ["h0"]
+    assert t.table()["h0"]["expires_in_s"] < 0.0
+    # EXPIRED-but-unfenced still passes check(): expiry is the
+    # supervisor's signal; the fence is the commitment
+    assert t.check("h0", epoch)
+    t.fence("h0")
+    assert not t.check("h0", epoch)
+    assert t.expired() == []  # fenced hosts leave the expiry list
+
+
+# ------------------------------------------------------- host faultplan
+def test_host_fault_kind_validation_and_pacing():
+    with pytest.raises(ValueError):
+        HostFault("meteor_strike")
+    plan = HostFaultPlan(
+        HostFault("renew_blackhole", hosts=("h0",), ops=("renew",),
+                  first_n=2)
+    )
+    # wrong host / wrong op: no draw
+    assert plan.match("h1", "renew") is None
+    assert plan.match("h0", "acquire") is None
+    assert plan.match("h0", "renew") is not None
+    assert plan.match("h0", "renew") is not None
+    assert plan.match("h0", "renew") is None  # first_n budget spent
+    assert plan.injected == 2
+    # kill9/sigstop are process-level: the harness delivers signals,
+    # match() never fires them in-process
+    sig = HostFaultPlan(HostFault("kill9"), HostFault("sigstop"))
+    assert sig.match("h0", "renew") is None
+    # clear() heals everything
+    plan2 = HostFaultPlan(HostFault("partition"))
+    plan2.clear()
+    assert plan2.match("h0", "renew") is None
+
+
+# --------------------------------------------------------- lease client
+async def test_client_acquire_renew_heartbeat_and_release():
+    table = LeaseTable(default_ttl_s=5.0)
+    reg = MetricsRegistry()
+    client = HostLeaseClient(
+        LocalLeaseTransport(table), "h0", slices=(0, 1), ttl_s=0.5,
+        renew_interval_s=0.01, metrics=reg,
+        health_fn=lambda: {"flush_timeout_rate": 0.0, "probes_ok": 0},
+    )
+    await client.start()
+    try:
+        assert client.held and client.epoch == 1
+        assert reg.gauge("host_lease_epoch", host="h0").value == 1
+        await asyncio.sleep(0.05)
+        row = table.table()["h0"]
+        assert row["renewals"] >= 1 and client.renewals >= 1
+        assert row["health"]["flush_timeout_rate"] == 0.0
+        assert row["slices"] == (0, 1)
+    finally:
+        await client.terminate()
+    # stop released the lease; the high-water survives for re-acquire
+    assert "h0" not in table.table()
+    assert table.acquire("h0")["epoch"] == 2
+
+
+async def test_client_injected_faults_blackhole_partition_slow():
+    table = LeaseTable(default_ttl_s=5.0)
+    reg = MetricsRegistry()
+    plan = HostFaultPlan()
+    client = HostLeaseClient(
+        LocalLeaseTransport(table), "h0", ttl_s=5.0,
+        renew_interval_s=9.0, metrics=reg, faultplan=plan,
+    )
+    await client.acquire()
+    before = table.table()["h0"]["renewals"]
+    # blackhole: the frame is dropped client-side — counted, broker
+    # never sees it, epoch preserved
+    plan.add(HostFault("renew_blackhole", first_n=1))
+    assert await client.renew_once() is False
+    assert table.table()["h0"]["renewals"] == before
+    assert reg.counter(
+        "netbus_lease_renew_failures_total", host="h0"
+    ).value == 1
+    # partition: raises the ConnectionError a real split would; still
+    # counted client-side (it never reached the bus)
+    plan.add(HostFault("partition", ops=("renew",), first_n=1))
+    assert await client.renew_once() is False
+    assert reg.counter(
+        "netbus_lease_renew_failures_total", host="h0"
+    ).value == 2
+    assert client.held and client.epoch == 1  # epoch survives faults
+    # slow heartbeat: delayed but delivered
+    plan.add(HostFault("slow_heartbeat", delay_s=0.01, first_n=1))
+    assert await client.renew_once() is True
+    # partition can also hit acquire
+    plan.add(HostFault("partition", ops=("acquire",), first_n=1))
+    with pytest.raises(InjectedHostFault):
+        await client.acquire()
+
+
+async def test_client_lease_loss_announces_and_reacquires_past_fence():
+    table = LeaseTable(default_ttl_s=5.0)
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    lost = []
+    client = HostLeaseClient(
+        LocalLeaseTransport(table), "h0", ttl_s=5.0,
+        renew_interval_s=9.0, metrics=reg, flightrec=fr,
+        on_lease_lost=lambda c: lost.append(c.epoch),
+    )
+    await client.acquire()
+    high = table.fence("h0")
+    assert await client.renew_once() is False
+    assert not client.held
+    assert lost == [1]
+    assert reg.counter("host_lease_lost_total", host="h0").value == 1
+    assert any(
+        s["reason"] == "lease-loss:h0" and s["meta"]["epoch"] == 1
+        for s in fr.snapshot_summaries()
+    )
+    # loss is announced once, not per stale renewal
+    assert await client.renew_once() is False
+    assert reg.counter("host_lease_lost_total", host="h0").value == 1
+    # rebirth: re-acquire lands past the fence
+    grant = await client.acquire()
+    assert grant["epoch"] > high and client.held
+
+
+# ------------------------------------------------------- host placement
+def _placed(n=4, slots=4):
+    p = HostPlacement(n, slots)
+    p.register_host("h0", [0, 1])
+    p.register_host("h1", [2, 3])
+    return p
+
+
+def test_host_registry_validates_range_and_disjoint_ownership():
+    p = HostPlacement(4, 4)
+    p.register_host("h0", [0, 1])
+    with pytest.raises(PlacementError):
+        p.register_host("h1", [4])       # out of range
+    with pytest.raises(PlacementError):
+        p.register_host("h1", [1, 2])    # shard 1 owned by h0
+    p.register_host("h1", [2, 3])
+    assert p.host_of(2) == "h1" and p.host_of(0) == "h0"
+    assert p.hosts()["h0"]["shards"] == [0, 1]
+
+
+def test_adopt_moves_tenants_to_survivors_and_opens_fences():
+    p = _placed()
+    a = p.place("t-a", "lstm_ad", prefer_shard=0)
+    b = p.place("t-b", "lstm_ad", prefer_shard=1)
+    c = p.place("t-c", "lstm_ad", prefer_shard=2)
+    assert p.tenants_on_host("h0") == ["t-a", "t-b"]
+    p.mark_suspect("h0", "lease_expired")
+    assert p.host_state("h0") == "suspect"
+    moves = p.adopt("h0")
+    assert sorted(old.tenant for old, _ in moves) == ["t-a", "t-b"]
+    for old, new in moves:
+        assert old.shard in (a.shard, b.shard)
+        assert new.shard in (2, 3)       # survivors only
+        assert p.fenced(old.tenant)
+    assert not p.fenced("t-c") and p.placement("t-c").shard == c.shard
+    fences = p.fences("h0")
+    assert fences["t-a"]["from_host"] == "h0"
+    assert fences["t-a"]["to_shard"] in (2, 3)
+    # suspect shards are avoided for NEW placements too
+    d = p.place("t-d", "lstm_ad")
+    assert d.shard in (2, 3)
+    assert p.lift_fences("h0") == 2
+    assert p.fences() == {}
+
+
+def test_readmit_host_rebalances_tenants_home():
+    p = _placed(4, 2)  # tight slots so rebalance has pressure to move
+    for i in range(4):
+        p.place(f"t{i}", "lstm_ad", prefer_shard=i % 4)
+    p.mark_suspect("h0")
+    p.adopt("h0")
+    assert all(
+        pl["shard"] in (2, 3)
+        for pl in p.describe()["placements"].values()
+    )
+    moves = p.readmit_host("h0")
+    assert p.host_state("h0") == "live"
+    assert moves, "rebalance must move tenants back onto h0's shards"
+    assert any(new.shard in (0, 1) for _old, new in moves)
+
+
+def test_unregistered_host_placement_is_plain_tenant_router():
+    # single-host deployments never call register_host: behavior must
+    # degenerate to TenantRouter bit for bit
+    hp, tr = HostPlacement(4, 4), TenantRouter(4, 4)
+    for i in range(6):
+        a = hp.place(f"t{i}", "lstm_ad")
+        b = tr.place(f"t{i}", "lstm_ad")
+        assert (a.shard, a.slot) == (b.shard, b.slot)
+    assert hp.describe()["placements"] == tr.describe()["placements"]
+    assert hp.describe()["hosts"] == {} and hp.describe()["fences"] == {}
+
+
+def test_adopt_with_no_healthy_capacity_leaves_tenant_degraded():
+    p = HostPlacement(2, 1)
+    p.register_host("h0", [0])
+    p.register_host("h1", [1])
+    p.place("t-a", "lstm_ad", prefer_shard=0)
+    p.place("t-b", "lstm_ad", prefer_shard=1)  # survivor is full
+    p.mark_suspect("h0")
+    assert p.adopt("h0") == []
+    assert p.placement("t-a").shard == 0       # degraded in place
+    assert not p.fenced("t-a")
+
+
+# ------------------------------------------------------ host supervisor
+class _VariantStub:
+    def variant(self, tenant):
+        return {"param_dtype": "int8", "tenant": tenant}
+
+
+def _supervised(clk, **kw):
+    table = LeaseTable(default_ttl_s=5.0, clock=clk)
+    placement = _placed()
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    sup = HostSupervisor(
+        LocalLeaseTransport(table), placement, metrics=reg,
+        flightrec=fr, scorehealth=_VariantStub(),
+        sick_heartbeats=3, probation_probes=2, **kw,
+    )
+    return table, placement, reg, fr, sup
+
+
+async def test_supervisor_expiry_fences_then_adopts():
+    clk = _Clock()
+    table, placement, reg, fr, sup = _supervised(clk)
+    adopted = []
+    sup.on_adopt = lambda host, moves, reason: adopted.append(
+        (host, [o.tenant for o, _ in moves], reason)
+    )
+    placement.place("t-a", "lstm_ad", prefer_shard=0)
+    placement.place("t-c", "lstm_ad", prefer_shard=2)
+    e0 = table.acquire("h0")["epoch"]
+    table.acquire("h1")
+    assert await sup.poll_once() == []          # both live
+    clk.t = 6.0
+    table.renew("h1", table.table()["h1"]["epoch"])  # h1 stays fresh
+    verdicts = await sup.poll_once()
+    assert verdicts == [
+        {"host": "h0", "to": "suspect", "reason": "lease_expired"}
+    ]
+    # fence landed BEFORE adoption: the zombie's epoch is already dead
+    assert not table.check("h0", e0)
+    assert sup.host_state("h0") == "suspect" and sup.host_state("h1") == "live"
+    assert placement.host_state("h0") == "suspect"
+    assert placement.placement("t-a").shard in (2, 3)
+    assert adopted == [("h0", ["t-a"], "lease_expired")]
+    # fences lifted after the adoption actuator confirmed
+    assert placement.fences() == {}
+    assert reg.counter(
+        "host_suspect_total", host="h0", reason="lease_expired"
+    ).value == 1
+    assert reg.counter("host_lease_lost_total", host="h0").value == 1
+    assert reg.counter("host_adoptions_total").value == 1
+    snap = [
+        s for s in fr.snapshot_summaries()
+        if s["reason"] == "host-adoption:h0"
+    ]
+    assert len(snap) == 1
+    assert snap[0]["meta"]["tenants"] == ["t-a"]
+    assert snap[0]["meta"]["variants"][0]["param_dtype"] == "int8"
+    # one verdict per incident, not per poll
+    assert await sup.poll_once() == []
+
+
+async def test_supervisor_sick_heartbeats_need_consecutive_evidence():
+    clk = _Clock()
+    table, placement, reg, _fr, sup = _supervised(clk)
+    placement.place("t-a", "lstm_ad", prefer_shard=0)
+    epoch = table.acquire("h0")["epoch"]
+    table.renew("h0", epoch, health={"flush_timeout_rate": 1.0})
+    await sup.poll_once()
+    await sup.poll_once()
+    # a healthy heartbeat resets the streak
+    table.renew("h0", epoch, health={"flush_timeout_rate": 0.0})
+    await sup.poll_once()
+    table.renew("h0", epoch, health={"flush_timeout_rate": 0.9})
+    assert await sup.poll_once() == []
+    assert await sup.poll_once() == []
+    verdicts = await sup.poll_once()
+    assert verdicts == [
+        {"host": "h0", "to": "suspect", "reason": "sick_heartbeats"}
+    ]
+    assert reg.counter(
+        "host_suspect_total", host="h0", reason="sick_heartbeats"
+    ).value == 1
+
+
+async def test_supervisor_probation_then_rebalance_home():
+    clk = _Clock()
+    table, placement, reg, _fr, sup = _supervised(clk)
+    home = []
+    sup.on_rebalance_home = lambda host, moves: home.append(
+        (host, len(moves))
+    )
+    placement.place("t-a", "lstm_ad", prefer_shard=0)
+    placement.place("t-b", "lstm_ad", prefer_shard=0)
+    placement.place("t-c", "lstm_ad", prefer_shard=1)
+    table.acquire("h0")
+    clk.t = 6.0
+    await sup.poll_once()                        # suspect + adopt
+    assert sup.host_state("h0") == "suspect"
+    # the host re-appears: fresh grant past the fence...
+    e2 = table.acquire("h0")["epoch"]
+    verdicts = await sup.poll_once()
+    assert verdicts == [{"host": "h0", "to": "probation"}]
+    # ...but probes not yet landed: nothing moves
+    table.renew("h0", e2, health={"probes_ok": 1})
+    assert await sup.poll_once() == []
+    # probation passed: readmit + rebalance home
+    table.renew("h0", e2, health={"probes_ok": 2})
+    verdicts = await sup.poll_once()
+    assert len(verdicts) == 1 and verdicts[0]["to"] == "live"
+    assert verdicts[0]["moves"] >= 1
+    assert sup.host_state("h0") == "live"
+    assert placement.host_state("h0") == "live"
+    assert home == [("h0", verdicts[0]["moves"])]
+    assert reg.counter("host_readmitted_total", host="h0").value == 1
+    assert any(
+        pl["shard"] in (0, 1)
+        for pl in placement.describe()["placements"].values()
+    ), "rebalance must bring tenants home"
+
+
+async def test_supervisor_probation_relapse_falls_back_to_suspect():
+    clk = _Clock()
+    table, placement, _reg, _fr, sup = _supervised(clk)
+    placement.place("t-a", "lstm_ad", prefer_shard=0)
+    table.acquire("h0")
+    clk.t = 6.0
+    await sup.poll_once()
+    table.acquire("h0")
+    await sup.poll_once()
+    assert sup.host_state("h0") == "probation"
+    clk.t = 20.0                                 # fresh grant lapses too
+    verdicts = await sup.poll_once()
+    assert verdicts == [
+        {"host": "h0", "to": "suspect", "reason": "probation_relapse"}
+    ]
+    assert sup.host_state("h0") == "suspect"
+
+
+async def test_supervisor_watch_loop_survives_broker_bounce():
+    class _FlakyBus:
+        def __init__(self):
+            self.calls = 0
+
+        async def lease_table(self):
+            self.calls += 1
+            raise ConnectionError("broker bounce")
+
+    bus = _FlakyBus()
+    sup = HostSupervisor(bus, _placed(), tick_s=0.01)
+    await sup.start()
+    try:
+        await asyncio.sleep(0.05)
+        assert bus.calls >= 2, "loop must retry through broker bounces"
+        assert sup.errors == []
+    finally:
+        await sup.terminate()
+
+
+# ------------------------------------- fenced publishes over the socket
+@asynccontextmanager
+async def remote_bus(instance_id="hl", retention=64):
+    broker = BusBrokerServer(TopicNaming(instance_id), retention=retention)
+    await broker.initialize()
+    await broker.start()
+    bus = RemoteEventBus(
+        "127.0.0.1", broker.bound_port,
+        naming=TopicNaming(instance_id), retention=retention,
+    )
+    await bus.connect()
+    try:
+        yield bus, broker
+    finally:
+        await bus.close()
+        await broker.terminate()
+
+
+async def test_lease_ops_and_fenced_publish_over_socket():
+    async with remote_bus() as (bus, broker):
+        grant = await bus.lease_acquire("hA", (0,), 5.0)
+        epoch = grant["epoch"]
+        assert (await bus.lease_renew("hA", epoch, 5.0, {"x": 1}))["ok"]
+        row = (await bus.lease_table())["hA"]
+        assert row["epoch"] == epoch and row["health"] == {"x": 1}
+        # live epoch: the publish appends
+        topic = bus.naming.global_topic("t.fenced")
+        dlq = bus.naming.host_fenced("hA")
+        bus.subscribe(topic, "g")
+        bus.subscribe(dlq, "dlq")
+        r = await bus.publish_fenced(topic, {"i": 0}, "hA", epoch)
+        assert r["fenced"] is False and r["offset"] == 0
+        # fence, then publish at the stale epoch: rejected + DLQ'd +
+        # counted — in ONE broker dispatch with the lease check
+        await bus.lease_fence("hA")
+        r = await bus.publish_fenced(topic, {"i": 1}, "hA", epoch)
+        assert r["fenced"] is True
+        assert await bus.consume(topic, "g", 10, timeout_s=1) == [{"i": 0}]
+        dead = await bus.consume(dlq, "dlq", 10, timeout_s=1)
+        assert len(dead) == 1
+        assert dead[0]["topic"] == topic and dead[0]["epoch"] == epoch
+        assert dead[0]["payload"] == {"i": 1}
+        snap = await bus.metrics_snapshot()
+        assert _fam_sum(snap, "host_fenced_publishes_total") == 1
+        await bus.lease_release("hA", epoch)
+
+
+async def test_fenced_bus_stamps_epoch_and_delegates():
+    async with remote_bus() as (bus, _broker):
+        client = HostLeaseClient(bus, "hB", ttl_s=5.0, renew_interval_s=9.0)
+        await client.acquire()
+        fb = FencedBus(bus, client)
+        topic = bus.naming.global_topic("t.fb")
+        fb.subscribe(topic, "g")               # __getattr__ delegation
+        assert await fb.publish(topic, {"i": 0}) == 0
+        fb.publish_nowait(topic, {"i": 1})
+        assert await fb.consume(topic, "g", 10, timeout_s=1) == [
+            {"i": 0}, {"i": 1}
+        ]
+        # the instance rebinds bus.metrics at build time — the rebind
+        # must land on the REAL bus client through the proxy
+        reg = MetricsRegistry()
+        fb.metrics = reg
+        assert bus.metrics is reg and fb.metrics is reg
+        # lease lost: publishes keep flowing into the DLQ, visibly
+        await bus.lease_fence("hB")
+        await fb.publish(topic, {"i": 2})
+        assert fb.fenced == 1
+        assert await fb.consume(topic, "g", 10, timeout_s=0.2) == []
+        await client.terminate()
+
+
+# ------------------------------------------------------- watchdog rule
+def _hist_with(reg, n, setter):
+    hist = MetricsHistory(reg, resolution_s=1.0, capacity=64)
+    for i in range(n):
+        setter(i)
+        hist.sample(now=float(i))
+    return hist
+
+
+def test_watchdog_host_lease_lost_rule_meta_and_cooldown():
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    c = reg.counter("host_lease_lost_total", host="h7")
+    calm = reg.counter("host_lease_lost_total", host="calm")
+    assert calm.value == 0
+
+    def setter(i):
+        if i == 6:
+            c.inc()
+
+    hist = _hist_with(reg, 12, setter)
+    wd = Watchdog(reg, hist, flightrec=fr, cooldown_s=60.0)
+    fired = wd.evaluate(now=100.0)
+    hits = [a for a in fired if a["rule"] == "host_lease_lost"]
+    assert len(hits) == 1
+    assert hits[0]["host"] == "h7"
+    assert "h7" in hits[0]["detail"] and "calm" not in hits[0]["detail"]
+    assert reg.counter(
+        "watchdog_alerts_total", rule="host_lease_lost"
+    ).value == 1
+    assert any(
+        s["reason"] == "watchdog:host_lease_lost"
+        and s["meta"].get("host") == "h7"
+        for s in fr.snapshot_summaries()
+    )
+    # 60 s cooldown: a flapping host pages once a minute, not per tick
+    assert not [
+        a for a in wd.evaluate(now=110.0) if a["rule"] == "host_lease_lost"
+    ]
+
+
+def test_watchdog_quiet_without_lease_losses():
+    reg = MetricsRegistry()
+    reg.counter("host_lease_lost_total", host="h7")  # exists, never inc'd
+    hist = _hist_with(reg, 12, lambda i: None)
+    assert not [
+        a for a in Watchdog(reg, hist).evaluate(now=50.0)
+        if a["rule"] == "host_lease_lost"
+    ]

@@ -1,0 +1,106 @@
+"""Tier-1 whole-process SIGKILL drill (host fault domain, single-host
+degenerate path): a real ``hostserve`` process over a real netbus broker
+is ``kill -9``'d mid-traffic — after a checkpoint but with two more
+rounds published and persisted only in its dying memory+cursors — and a
+respawn with ``--restore --recover-unscored`` must account for EVERY
+round exactly:
+
+- rounds published before the checkpoint restore from the store cut;
+- rounds consumed AFTER the checkpoint redeliver from the broker,
+  because ``checkpoint()`` snapshots this instance's consumer-group
+  cursors (``offsets.json``) BEFORE the store cut and ``restore()``
+  rewinds them — an advanced broker cursor can no longer swallow the
+  dead process's post-checkpoint window;
+- per-tenant FIFO holds across the rebirth (round first-appearance
+  order in the append-ordered store is sorted);
+- with ``--lease-ttl 0`` the lease layer is never constructed: the
+  report shows epoch 0 / lease not held (bitwise single-host posture).
+
+Multi-host kill/partition scenarios live in the chaos-marked
+tests/test_host_chaos.py; this drill is the tier-1 floor under them.
+"""
+
+import asyncio
+
+import pytest
+
+from tests._hostproc import (
+    ROWS,
+    Reporter,
+    ctl,
+    publish_round,
+    spawn_broker,
+    spawn_host,
+    tenant_cfg_dict,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+async def test_sigkill_mid_traffic_restores_every_round(tmp_path):
+    from sitewhere_tpu.runtime.bus import TopicNaming
+    from sitewhere_tpu.runtime.netbus import RemoteEventBus
+
+    procs = []
+    bus = None
+    try:
+        broker, port = spawn_broker(tmp_path, "ik")
+        procs.append(broker)
+        host = spawn_host(
+            tmp_path, port, "h0", "ik", recover_unscored=True
+        )
+        procs.append(host)
+        ready = host.ready()
+        assert ready["host"] == "h0" and ready["epoch"] == 0
+
+        bus = RemoteEventBus("127.0.0.1", port, naming=TopicNaming("ik"))
+        await bus.connect()
+        rep = Reporter(bus, "kill")
+
+        # adopt tenant c0; the ctl loop is FIFO per host, so the first
+        # report doubles as the adopt barrier
+        await ctl(bus, "h0", {"op": "adopt", "config": tenant_cfg_dict("c0")})
+        first = await rep.report("h0")
+        assert first["tenants"] == ["c0"]
+        assert first["held"] is False  # lease layer OFF at ttl 0
+
+        for r in range(4):
+            await publish_round(bus, "c0", r)
+        await rep.wait_rounds("h0", "c0", range(4))
+
+        # checkpoint, then a report as the completion barrier (FIFO)
+        await ctl(bus, "h0", {"op": "checkpoint"})
+        await rep.report("h0")
+
+        # the post-checkpoint window: persisted + cursors committed on
+        # the broker, but absent from the store cut on disk
+        for r in (4, 5):
+            await publish_round(bus, "c0", r)
+        await rep.wait_rounds("h0", "c0", range(6))
+
+        host.kill9()
+
+        host2 = spawn_host(
+            tmp_path, port, "h0", "ik",
+            restore=True, recover_unscored=True,
+        )
+        procs.append(host2)
+        ready2 = host2.ready()
+        assert ready2["pid"] != ready["pid"]
+
+        final = await rep.wait_rounds("h0", "c0", range(6))
+        rr = final["round_rows"]["c0"]
+        # exact accounting: every round fully present, none invented
+        assert sorted(rr) == list(range(6))
+        assert all(rr[r] == ROWS for r in range(6)), rr
+        assert final["tenants"] == ["c0"]  # manifest restored the tenant
+        # FIFO across the rebirth: first-appearance order is in order
+        order = final["round_order"]["c0"]
+        assert order == sorted(order), order
+        # single-host degenerate posture survives the respawn too
+        assert final["epoch"] == 0 and final["held"] is False
+    finally:
+        if bus is not None:
+            await bus.close()
+        for p in procs:
+            p.stop()

@@ -67,6 +67,33 @@ async def test_blocking_poll_wakes_on_publish():
         await task
 
 
+async def test_cancelled_consumer_does_not_swallow_next_publish():
+    """Regression (host fault domain handoffs): cancelling a consumer
+    TASK mid long-poll must not leave a live poll on the broker. Before
+    ``consume_cancel``, the orphaned broker-side poll ate the next
+    published item — cursor committed at delivery, reply discarded
+    against the cancelled caller's dead future — so the row vanished
+    from every replacement consumer. Exactly the tenant-handoff shape:
+    remove_tenant cancels persistence consumers, a re-adopted tenant
+    re-subscribes the same group."""
+    async with remote_bus() as (bus, broker):
+        bus.subscribe("t.cc", "g")
+        poll = asyncio.create_task(bus.consume("t.cc", "g", 10, timeout_s=30))
+        await asyncio.sleep(0.2)  # long-poll parked broker-side
+        poll.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await poll
+        # let the fire-and-forget consume_cancel frame land
+        for _ in range(50):
+            if broker.metrics.counter("netbus_consume_cancels_total").value:
+                break
+            await asyncio.sleep(0.02)
+        assert broker.metrics.counter("netbus_consume_cancels_total").value >= 1
+        await bus.publish("t.cc", "survivor")
+        # the replacement consumer on the SAME group sees the row
+        assert await bus.consume("t.cc", "g", 10, timeout_s=2) == ["survivor"]
+
+
 async def test_backpressure_respected_over_socket():
     async with remote_bus(retention=4) as (bus, _):
         bus.subscribe("t.bp", "g")
@@ -216,3 +243,78 @@ async def test_full_pipeline_e2e_on_tcp_backend():
         await inst.terminate()
         await bus.close()
         await broker.terminate()
+
+# ------------------------------------------- host-lease plane hardening
+@pytest.mark.chaos
+async def test_lease_renewal_rides_reconnect_without_dropping_epoch():
+    """Satellite regression (host fault domain): a lease-renewal frame
+    issued while the broker is bouncing rides the client's jittered
+    reconnect backoff and lands WITHOUT dropping the epoch. The epoch is
+    a call argument, not connection state — and the fresh broker's empty
+    lease table re-adopts the renewing host at its claimed epoch (the
+    high-water guard keeps zombies off this path)."""
+    from sitewhere_tpu.runtime.hostlease import HostLeaseClient
+
+    naming = TopicNaming("lr")
+    broker = BusBrokerServer(naming)
+    await broker.initialize()
+    await broker.start()
+    port = broker.bound_port
+    bus = RemoteEventBus("127.0.0.1", port, naming=naming,
+                         reconnect_window_s=10.0)
+    await bus.connect()
+    client = HostLeaseClient(bus, "hR", ttl_s=5.0, renew_interval_s=9.0)
+    try:
+        await client.acquire()
+        assert client.epoch == 1
+        # hard broker bounce on the same port, mid-renewal-cycle
+        await broker.terminate()
+        broker = BusBrokerServer(naming, host="127.0.0.1", port=port)
+        await broker.initialize()
+        await broker.start()
+        assert await client.renew_once() is True
+        assert client.epoch == 1 and client.held
+        row = (await bus.lease_table())["hR"]
+        assert row["epoch"] == 1 and not row["fenced"]
+        # the zombie variant cannot ride the same path: a fence recorded
+        # on the NEW broker outruns any stale-epoch renewal
+        await bus.lease_fence("hR")
+        assert await client.renew_once() is False
+        assert not client.held
+    finally:
+        await bus.close()
+        await broker.terminate()
+
+
+@pytest.mark.chaos
+async def test_lease_renew_failures_counted_when_window_exhausted():
+    """A renewal that exhausts the reconnect window surfaces as
+    ``netbus_lease_renew_failures_total{host}`` on the bus's registry —
+    the supervisor-facing evidence that the HOST (not the lease logic)
+    lost its control plane."""
+    from sitewhere_tpu.runtime.hostlease import HostLeaseClient
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+    naming = TopicNaming("lf")
+    broker = BusBrokerServer(naming)
+    await broker.initialize()
+    await broker.start()
+    bus = RemoteEventBus("127.0.0.1", broker.bound_port, naming=naming,
+                         reconnect_window_s=0.2)
+    await bus.connect()
+    reg = MetricsRegistry()
+    bus.metrics = reg
+    client = HostLeaseClient(bus, "hF", ttl_s=5.0, renew_interval_s=9.0)
+    try:
+        await client.acquire()
+        await broker.terminate()  # broker gone for good, window too short
+        assert await client.renew_once() is False
+        # counted by the NETBUS layer (the client does not double-count
+        # transport failures it didn't inject)
+        assert reg.counter(
+            "netbus_lease_renew_failures_total", host="hF"
+        ).value >= 1
+        # epoch preserved for the eventual re-acquire
+        assert client.epoch == 1
+    finally:
+        await bus.close()

@@ -238,10 +238,23 @@ SUPERVISED_PATHS: Dict[str, List[str]] = {
         "TpuInferenceService._resolve_flush",
         # probation probes on quarantined slices
         "TpuInferenceService._dispatch_probe",
+        # host-probation probes (host fault domain): same wire, same
+        # deadline contract, driven by a re-appearing host's heartbeat
+        "TpuInferenceService.host_probe",
     ],
     "pipeline/media.py": [
         # the classify readback (media lane)
         "MediaClassificationPipeline._finish_classify",
+    ],
+    # the host fault domain's control-plane loops: the lease heartbeat
+    # and the coordinator's lease-table watch. Neither may grow an
+    # unsupervised device/executor await — a wedged probe inside the
+    # heartbeat would silently stop renewals and fence a healthy host.
+    "runtime/hostlease.py": [
+        "HostLeaseClient._renew_loop",
+        "HostLeaseClient.renew_once",
+        "HostSupervisor._watch_loop",
+        "HostSupervisor.poll_once",
     ],
 }
 
@@ -349,6 +362,27 @@ COMMIT_SECTIONS: Dict[str, List[Dict[str, str]]] = {
             "name": "manifest commit → doomed-file delete",
             "begin": "_commit_manifest",
             "end": "unlink",
+        },
+    ],
+    "runtime/hostlease.py": [
+        {
+            # lease-commit → adoption: the SUSPECT mark, the placement
+            # moves, and the adoption counters must land as one step —
+            # an await between them lets a cancellation strand tenants
+            # half-moved (fenced at the broker but never adopted)
+            "function": "HostSupervisor._commit_adoption",
+            "name": "host suspect mark → tenant adoption bookkeeping",
+            "begin": "mark_suspect",
+            "end": "inc",
+        },
+        {
+            # epoch-bump → fence-lift: the cross-host fences release
+            # together with their counter, only after the adopter
+            # confirmed (the epoch bump already happened at the broker)
+            "function": "HostSupervisor._commit_fence_lift",
+            "name": "cross-host fence lift → accounting",
+            "begin": "lift_fences",
+            "end": "inc",
         },
     ],
 }

@@ -16,6 +16,11 @@
 #     slice of a 4x2 mesh with live traffic — exact store∪DLQ∪expired∪
 #     unscored accounting, healthy-slice p99 bound, flush-deadline
 #     force-resolve, probation re-admission, poison-batch ejection)
+#   HOST_ONLY=1 tools/run_chaos.sh       # just the HOST-fault suite
+#     (tests/test_host_chaos.py: multi-process kill -9 / SIGSTOP-zombie /
+#     netbus-partition runs over a shared durable broker — zero event
+#     loss, per-tenant FIFO across adoption, zombie-epoch writes fenced,
+#     tenants rebalanced home after probation)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # preflight: the sub-second pure-AST lint suite (docs/STATIC_ANALYSIS.md)
@@ -30,6 +35,10 @@ if [[ "${OVERLOAD_ONLY:-}" == "1" ]]; then
 fi
 if [[ "${MESH_ONLY:-}" == "1" ]]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_device_chaos.py \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+fi
+if [[ "${HOST_ONLY:-}" == "1" ]]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_host_chaos.py \
         -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \

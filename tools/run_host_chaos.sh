@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Run the multi-process HOST-fault chaos suite (tests/test_host_chaos.py):
+# a durable netbus broker + two serving-host subprocesses
+# (runtime/hostserve.py) with live traffic, then one host at a time takes
+# kill -9, a SIGSTOP wedge (resumed into a zombie), and a netbus
+# partition. The coordinator (HostSupervisor in the test process) must
+# fence the dead host's lease epoch, adopt its tenants cross-host, and —
+# after the host re-appears and lands its probation probes — rebalance
+# tenants home. Asserted per scenario:
+#
+#   - zero event loss: exact store ∪ DLQ ∪ expired ∪ unscored accounting
+#     across both hosts (the host-fenced DLQ included — a zombie's
+#     stale-epoch publishes are rejected + DLQ'd, never silently dropped
+#     or double-served),
+#   - per-tenant FIFO across adoption (scored-round order modulo the
+#     at-least-once redeliveries the cursor contract allows),
+#   - zombie-epoch writes provably fenced (host_fenced_publishes_total),
+#   - tenants rebalanced home after probation.
+#
+# Preflight: lint_all --fast (SKIP_LINT=1 skips). The suite is
+# chaos+slow marked — tier-1 never runs it.
+#
+# Usage: tools/run_host_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [[ "${SKIP_LINT:-}" != "1" ]]; then
+    python tools/lint_all.py --fast
+fi
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_host_chaos.py \
+    -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
