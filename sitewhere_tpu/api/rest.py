@@ -205,6 +205,8 @@ class RestApi:
         r.add_get("/api/flightrec", self.flightrec)
         r.add_get("/api/flightrec/snapshots", self.flightrec_snapshots)
         r.add_get("/api/metrics/history", self.metrics_history)
+        r.add_get("/api/latency", self.latency_fleet)
+        r.add_get("/api/tenants/{token}/latency", self.tenant_latency)
 
         r.add_get("/api/schedules", self.list_schedules)
         r.add_post("/api/schedules", self.create_schedule)
@@ -476,6 +478,43 @@ class RestApi:
         if token not in self.instance.tenants:
             return web.json_response({"error": "unknown tenant"}, status=404)
         return web.json_response(self.instance.tenant_slo_report(token))
+
+    async def latency_fleet(self, request) -> web.Response:
+        """The fleet latency waterfall (runtime.latency): one merged
+        additive p99 decomposition over every ledger window, per-(tenant,
+        priority) cohort summaries sorted hottest-first, per-tenant SLO
+        burn rates, and the attribution engine's own measured overhead.
+        ``?flush=1`` forces pending tail decisions first so a freshly
+        driven instance reports current traffic, not the previous
+        window's."""
+        if request.query.get("flush", "") in ("1", "true"):
+            self.instance.tracer.gc(force=True)
+        else:
+            self.instance.tracer.gc()
+        return web.json_response(self.instance.latency.fleet_report())
+
+    async def tenant_latency(self, request) -> web.Response:
+        """One tenant's latency decomposition per priority class, its
+        5 min / 1 h SLO burn rates, and the worst-N SLO-breach traces
+        grouped by dominant stage (each row links its Chrome export).
+        ``?worst=N`` sizes the breach list; ``?flush=1`` forces pending
+        tail decisions first."""
+        token = request.match_info["token"]
+        if token not in self.instance.tenants:
+            return web.json_response({"error": "unknown tenant"}, status=404)
+        if request.query.get("flush", "") in ("1", "true"):
+            self.instance.tracer.gc(force=True)
+        else:
+            self.instance.tracer.gc()
+        try:
+            worst_n = min(int(request.query.get("worst", 5)), 50)
+        except ValueError:
+            return web.json_response(
+                {"error": "bad worst= value"}, status=400
+            )
+        return web.json_response(
+            self.instance.latency.tenant_report(token, worst_n=worst_n)
+        )
 
     async def tenant_overload(self, request) -> web.Response:
         """Per-tenant overload-control state: credit, degradation ladder

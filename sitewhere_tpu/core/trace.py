@@ -43,6 +43,9 @@ class TraceContext:
     tenant: str = ""
     device: str = ""
     source_topic: str = ""
+    # admission priority class name (runtime.overload.PRIORITY_NAMES) —
+    # the latency ledger cohorts per-(tenant, priority) attribution on it
+    priority: str = "measurement"
 
     def child(self) -> "TraceContext":
         """A derived context (rule-derived events, command invocations):
@@ -53,6 +56,7 @@ class TraceContext:
             tenant=self.tenant,
             device=self.device,
             source_topic=self.source_topic,
+            priority=self.priority,
         )
 
     # -- header round trip (gRPC metadata / external wire formats) -------
@@ -63,6 +67,7 @@ class TraceContext:
             "x-sw-tenant": self.tenant,
             "x-sw-device": self.device,
             "x-sw-source": self.source_topic,
+            "x-sw-priority": self.priority,
         }
 
     @staticmethod
@@ -76,6 +81,7 @@ class TraceContext:
             tenant=h.get("x-sw-tenant", ""),
             device=h.get("x-sw-device", ""),
             source_topic=h.get("x-sw-source", ""),
+            priority=h.get("x-sw-priority", "measurement") or "measurement",
         )
 
 

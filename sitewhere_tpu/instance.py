@@ -181,6 +181,19 @@ class SiteWhereInstance(LifecycleComponent):
 
         self.flightrec = FlightRecorder()
         self.tracer.flightrec = self.flightrec  # SLO-breach snapshots
+        # latency attribution (runtime.latency): the engine every tail
+        # decision feeds — per-(tenant, priority) stage ledgers, p99
+        # decomposition, SLO burn rates. Shared by the tracer (feed),
+        # the watchdog (slo_burn rule), REST (/api/latency), and the
+        # flight recorder (snapshot context)
+        from sitewhere_tpu.runtime.latency import LatencyEngine
+
+        self.latency = LatencyEngine(self.metrics)
+        self.latency.tracer = self.tracer
+        self.tracer.latency = self.latency
+        self.flightrec.add_context(
+            "latency", self.latency.snapshot_context
+        )
         allowlist = (
             tuple(cfg.metrics_history_allowlist)
             if cfg.metrics_history_allowlist
@@ -210,6 +223,7 @@ class SiteWhereInstance(LifecycleComponent):
                 self.metrics, self.history,
                 flightrec=self.flightrec, tracer=self.tracer,
                 scorehealth=self.scorehealth,
+                latency=self.latency,
             )
             if cfg.watchdog_enabled
             else None
@@ -236,6 +250,7 @@ class SiteWhereInstance(LifecycleComponent):
             self.bus, self.metrics,
             overload=self.overload,
             flightrec=self.flightrec,
+            tracer=self.tracer,
             state_dir=(
                 _Path(cfg.data_dir) / "replay" if cfg.checkpointing else None
             ),
@@ -579,6 +594,7 @@ class SiteWhereInstance(LifecycleComponent):
         self._shared_targets = None
         self.tracer.remove_tenant(tenant)
         self.overload.remove_tenant(tenant)
+        self.latency.remove_tenant(tenant)
         if rt is None:
             return
         # stop broker ingress FIRST: the closure would otherwise keep
@@ -723,6 +739,10 @@ class SiteWhereInstance(LifecycleComponent):
                 # decay idle families' MFU gauges BEFORE sampling so the
                 # ring never records a stale "last busy" value forever
                 self._refresh_mfu()
+                # publish the latency ledgers' rolling p99s / burn rates
+                # as gauges BEFORE sampling so the ring sees this tick's
+                # attribution state, not last tick's
+                self.latency.refresh_gauges()
                 self.history.sample()
                 if self.watchdog is not None:
                     self.watchdog.evaluate()

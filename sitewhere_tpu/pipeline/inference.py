@@ -1006,6 +1006,12 @@ class TpuInferenceService(MultitenantService):
             "(family, slice) scorers currently quarantined (SUSPECT) "
             "and under probation probing",
         )
+        self.metrics.describe(
+            "tpu_flush_latency_p99_ms",
+            "rolling dispatch→transfer-landed p99 per (family, mesh "
+            "slice) — the flush supervisor's deadline source, surfaced "
+            "live for the latency waterfall",
+        )
 
     @property
     def group(self) -> str:
@@ -1046,6 +1052,15 @@ class TpuInferenceService(MultitenantService):
         if rq is None:
             rq = self._flush_p99[key] = RollingQuantile()
         rq.add(device_s)
+        p99 = rq.quantile()
+        if p99 is not None:
+            # the deadline source, surfaced live: per-(family, slice)
+            # dispatch→landed p99 used to feed ONLY deadline sizing —
+            # the latency waterfall and history sampler read this gauge
+            self.metrics.gauge(
+                "tpu_flush_latency_p99_ms",
+                family=key[0], slice=str(key[1]),
+            ).set(round(p99 * 1000.0, 3))
 
     def _make_engine(self, cfg: TenantEngineConfig) -> TpuInferenceEngine:
         return TpuInferenceEngine(cfg, self)
@@ -1806,6 +1821,13 @@ class TpuInferenceService(MultitenantService):
                 "dispatch_s": round(dispatch_s, 6),
                 "compiled": compiling,
                 "bucket": b_lane,
+                # latency-attribution profile: runtime.latency splits the
+                # inference span into its flush sub-stages on these keys
+                # (device/d2h/resolve halves land when the reaper
+                # resolves — see _resolve_flush)
+                "flush_assembly_s": round(assembly_s, 6),
+                "flush_h2d_s": round(h2d_stage_s, 6),
+                "flush_dispatch_s": round(dispatch_s, 6),
             }
             if self.mm.n_devices > 1:
                 # per-device throughput attribution: which chip scored
@@ -3756,6 +3778,15 @@ class TpuInferenceService(MultitenantService):
             self.metrics.counter(
                 "tpu_inference_d2h_bytes_total", **d2h_labels
             ).inc(pf.nbytes)
+            # complete the family's latency-attribution profile: the
+            # inference span annotates with the LAST RESOLVED flush's
+            # full sub-stage split (a per-batch approximation; the
+            # ledger scales it so it never exceeds the span)
+            prof = self._last_flush.get(pf.family)
+            if prof is not None:
+                prof["flush_device_s"] = round(device_s, 6)
+                prof["flush_d2h_wait_s"] = round(waited_s, 6)
+                prof["flush_resolve_s"] = round(resolve_s, 6)
             if pf.rec is not None:
                 # complete the blackbox record in place (see flightrec)
                 pf.rec["d2h_wait_s"] = round(waited_s, 6)

@@ -231,6 +231,7 @@ class ReplayEngine:
         metrics: Optional[MetricsRegistry] = None,
         overload=None,
         flightrec=None,
+        tracer=None,
         state_dir: Optional[str | Path] = None,
         batch_rows: int = 8192,
         ring_capacity: int = 4,
@@ -241,6 +242,11 @@ class ReplayEngine:
         self.metrics = metrics or MetricsRegistry()
         self.overload = overload
         self.flightrec = flightrec
+        # tracing hook (runtime.tracing.Tracer | None): replayed batches
+        # re-enter the live feed path, so they mint their own contexts —
+        # without one, every downstream span is silently skipped and the
+        # latency ledgers lose the whole replay cohort
+        self.tracer = tracer
         self.state_dir = Path(state_dir) if state_dir is not None else None
         if self.state_dir is not None:
             self.state_dir.mkdir(parents=True, exist_ok=True)
@@ -594,6 +600,21 @@ class ReplayEngine:
                     t0 = time.perf_counter()
                     cols = slice_columns(sl)
                     batch = _slice_to_batch(job.tenant, cols, job.target)
+                    if self.tracer is not None:
+                        # replay is an ingest edge like any transport:
+                        # mint per published batch so stage spans (and
+                        # the latency ledger's replay cohort) exist —
+                        # the "replay" trace mark keeps the batch out of
+                        # the live SLO series regardless
+                        # priority "replay" keys a SEPARATE ledger
+                        # cohort: backfill timings must not blur the
+                        # live traffic's attribution or burn its SLO
+                        # budget
+                        batch.trace_ctx = self.tracer.mint(
+                            job.tenant,
+                            source_topic=f"replay:{job.target}",
+                            priority="replay",
+                        )
                     nbytes = (
                         cols["values"].nbytes + cols["scores"].nbytes
                         + cols["event_ts"].nbytes

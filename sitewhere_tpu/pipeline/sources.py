@@ -487,7 +487,12 @@ class EventSource(LifecycleComponent):
                         else ""
                     )
                     mb.trace_ctx = self.tracer.mint(
-                        self.tenant, device=dev, source_topic=src_topic
+                        self.tenant, device=dev, source_topic=src_topic,
+                        # the admission class rides the context so the
+                        # latency ledger cohorts by (tenant, priority)
+                        priority=PRIORITY_NAMES[
+                            classify_priority(first_context)
+                        ],
                     )
                 # span recorded BEFORE the publish so the downstream
                 # stage's span parents under this one deterministically
@@ -521,10 +526,14 @@ class EventSource(LifecycleComponent):
                         # skips them) but carry the stamp for observability
                         req["_deadline"] = float(now) + budget
                 if "_trace" not in req and self.tracer is not None:
+                    ev_type = str(req.get("type", ""))
                     ctx = self.tracer.mint(
                         self.tenant,
                         device=str(req.get("device_token", "")),
                         source_topic=self.source_id,
+                        priority=(
+                            "alert" if "alert" in ev_type else "command"
+                        ),
                     )
                     if ctx is not None:  # None = tracing disabled: no key
                         req["_trace"] = ctx

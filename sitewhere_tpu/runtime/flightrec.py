@@ -109,6 +109,17 @@ class FlightRecorder:
         self._next_snapshot_id = 1
         self.snapshots_taken = 0
         self.snapshots_suppressed = 0
+        # snapshot context providers: name → zero-arg callable returning
+        # a small dict embedded into every snapshot's ``context`` block
+        # (the instance wires the latency engine's hottest-cohort view
+        # here, so an incident snapshot carries the waterfall that was
+        # live AT the incident, not a later reconstruction)
+        self._context_providers: Dict[str, Any] = {}
+
+    def add_context(self, name: str, provider) -> None:
+        """Register a snapshot context provider. Providers must be cheap
+        and bounded — they run inline on every snapshot."""
+        self._context_providers[str(name)] = provider
 
     # -- recording -------------------------------------------------------
     def _ring(self, kind: str, key: str) -> _Ring:
@@ -182,11 +193,19 @@ class FlightRecorder:
             recs = [dict(r) for r in ring.records()]
             rings.setdefault(kind, {})[key] = recs
             n += len(recs)
+        context: Dict[str, Any] = {}
+        for name, provider in self._context_providers.items():
+            try:
+                context[name] = provider()
+            except Exception as exc:  # noqa: BLE001 - a provider bug must
+                # not lose the snapshot; record the failure as evidence
+                context[name] = {"error": f"{type(exc).__name__}: {exc}"}
         snap = {
             "id": self._next_snapshot_id,
             "reason": reason,
             "ts_ms": _now_wall_ms(),
             "meta": dict(meta),
+            "context": context,
             "n_records": n,
             "rings": rings,
         }

@@ -94,6 +94,14 @@ DEFAULT_ALLOWLIST: Tuple[str, ...] = (
     "host_lease_lost_total",
     "host_suspect_total",
     "host_adoptions_total",
+    # latency attribution (runtime.latency): per-(tenant, priority) e2e
+    # and per-stage p99 ledger gauges, SLO burn rates, and the flush
+    # supervisor's per-(family, slice) dispatch→landed p99 — "when did
+    # the p99 move / which stage moved it" questions read these
+    "latency_e2e_p99_ms",
+    "latency_stage_p99_ms",
+    "latency_slo_burn",
+    "tpu_flush_latency_p99_ms",
 )
 
 # Families the Watchdog rules read from the history ring. A custom
@@ -113,6 +121,10 @@ WATCHDOG_REQUIRED: Tuple[str, ...] = (
     "score_quality_nan_rate",
     "tpu_flush_timeout_total",
     "host_lease_lost_total",
+    # slo_burn reads the LatencyEngine directly (its ledgers, not the
+    # ring), but its alert evidence window lives in these series
+    "latency_e2e_p99_ms",
+    "latency_slo_burn",
 )
 
 # PSI verdict boundary the score_drift rule shares with the REST health
@@ -318,6 +330,7 @@ class Watchdog:
         flightrec=None,
         tracer=None,
         scorehealth=None,
+        latency=None,
         *,
         window: float = 60.0,          # rule lookback, seconds
         warmup: float = 120.0,         # recompile-rule grace, seconds
@@ -333,6 +346,8 @@ class Watchdog:
         psi_threshold: float = SCORE_PSI_THRESHOLD,
         nan_rate_threshold: float = 0.10,
         flush_timeout_min: int = 3,    # timeouts per window to alert
+        slo_burn_fast: float = 14.4,   # 5 min burn multiple to page
+        slo_burn_slow: float = 1.0,    # 1 h burn multiple to confirm
         force_retain_s: float = 60.0,
         clock=time.monotonic,
     ) -> None:
@@ -345,6 +360,10 @@ class Watchdog:
         # the incident snapshot meta — "lstm_ad int8/k=2 drifted" is
         # actionable where "lstm_ad drifted" is not
         self.scorehealth = scorehealth
+        # latency attribution (runtime.latency.LatencyEngine): the
+        # slo_burn rule reads its ledgers directly — burn rates live in
+        # the engine's bucket rings, not the history ring
+        self.latency = latency
         # windows are GIVEN in seconds but the history is indexed in
         # samples — convert through the ring's actual resolution (the
         # instance's history_resolution_s is configurable; rules sized
@@ -373,6 +392,8 @@ class Watchdog:
         self.psi_threshold = float(psi_threshold)
         self.nan_rate_threshold = float(nan_rate_threshold)
         self.flush_timeout_min = int(flush_timeout_min)
+        self.slo_burn_fast = float(slo_burn_fast)
+        self.slo_burn_slow = float(slo_burn_slow)
         self.cooldown_s = cooldown_s
         self.min_flushes = min_flushes
         self.overlap_healthy = overlap_healthy
@@ -651,6 +672,43 @@ class Watchdog:
             "host": first.get("host") if first else None,
         }
 
+    def _rule_slo_burn(self):
+        """A tenant is burning its latency error budget on BOTH windows:
+        the 5 min burn proves it is happening now, the 1 h burn proves
+        it is not a blip (the classic multi-window page guard — a
+        14.4× fast burn spends ~2% of a 30-day budget in an hour). The
+        alert names the tenant, the p99-dominant stage from the latency
+        ledger, and the active kernel variant — the on-call's first
+        three questions, answered in the page itself."""
+        lat = self.latency
+        if lat is None:
+            return None
+        worst = lat.worst_burn()
+        if worst is None:
+            return None
+        b5, b1h = worst["burn_5m"], worst["burn_1h"]
+        if b5 is None or b5 < self.slo_burn_fast:
+            return None
+        if b1h is not None and b1h < self.slo_burn_slow:
+            return None
+        meta: Dict[str, object] = {
+            "tenant": worst["tenant"],
+            "stage": worst["stage"] or None,
+            "burn_5m": b5,
+            "burn_1h": b1h,
+        }
+        if self.scorehealth is not None:
+            meta["variant"] = self.scorehealth.variant(worst["tenant"])
+        return {
+            "detail": (
+                f"tenant {worst['tenant']} burning "
+                f"{b5:g}x its {worst['slo_ms']:g}ms-SLO error budget "
+                f"(5m; 1h={b1h if b1h is not None else 'n/a'}), "
+                f"dominant stage: {worst['stage'] or 'unattributed'}"
+            ),
+            **meta,
+        }
+
     RULES = (
         ("steady_state_recompile", "_rule_steady_state_recompile"),
         ("h2d_overlap_collapse", "_rule_h2d_overlap_collapse"),
@@ -661,6 +719,7 @@ class Watchdog:
         ("nan_rate_spike", "_rule_nan_rate_spike"),
         ("flush_timeout", "_rule_flush_timeout"),
         ("host_lease_lost", "_rule_host_lease_lost"),
+        ("slo_burn", "_rule_slo_burn"),
     )
 
     # -- evaluation ------------------------------------------------------

@@ -103,6 +103,14 @@ class RollingQuantile:
         """The cached window quantile, or None under MIN_SAMPLES."""
         return self._cached
 
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def values(self) -> tuple:
+        """Window snapshot (oldest → newest) — offline analysis only;
+        the hot path reads ``quantile()``."""
+        return tuple(self._buf)
+
 
 def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))

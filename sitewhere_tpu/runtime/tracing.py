@@ -88,8 +88,9 @@ class TraceRecord:
     """One trace's spans + retention bookkeeping."""
 
     __slots__ = (
-        "trace_id", "tenant", "device", "source_topic", "spans",
-        "forced", "created_ms", "last_ms", "seal_at_ms", "decision",
+        "trace_id", "tenant", "device", "source_topic", "priority",
+        "spans", "forced", "created_ms", "last_ms", "seal_at_ms",
+        "decision",
     )
 
     MAX_SPANS = 128  # derived-event fan-out bound
@@ -99,6 +100,7 @@ class TraceRecord:
         self.tenant = ctx.tenant
         self.device = ctx.device
         self.source_topic = ctx.source_topic
+        self.priority = getattr(ctx, "priority", "") or "measurement"
         self.spans: List[Span] = []
         self.forced: List[str] = []   # retention reasons (dlq/retry/…)
         self.created_ms = now
@@ -259,6 +261,11 @@ class Tracer:
         # instance): SLO-breach tail decisions snapshot the blackbox, and
         # StageTimers feed it strided per-stage records
         self.flightrec = None
+        # latency-attribution bridge (runtime.latency.LatencyEngine,
+        # wired by the instance): EVERY tail decision — kept or
+        # dropped — feeds the stage ledgers before sampling applies,
+        # so attribution never suffers sampling bias
+        self.latency = None
         # watchdog-forced retention: until this wall-ms, EVERY tail
         # decision keeps its trace (reason "watchdog") — the traffic
         # around an alert is exactly what sampling would discard
@@ -287,7 +294,8 @@ class Tracer:
 
     # -- minting (ingest edges) -------------------------------------------
     def mint(
-        self, tenant: str, device: str = "", source_topic: str = ""
+        self, tenant: str, device: str = "", source_topic: str = "",
+        priority: str = "measurement",
     ) -> Optional[TraceContext]:
         """A fresh context, or None when tracing is off for the tenant —
         the None IS the hot-path guard: no context on the payload means
@@ -295,7 +303,8 @@ class Tracer:
         if not self.enabled_for(tenant):
             return None
         return TraceContext(
-            tenant=tenant, device=device, source_topic=source_topic
+            tenant=tenant, device=device, source_topic=source_topic,
+            priority=priority,
         )
 
     # -- span recording ----------------------------------------------------
@@ -366,9 +375,28 @@ class Tracer:
         if until > self._force_until_ms:
             self._force_until_ms = until
 
+    # -- span-time retention probe (forced flightrec stage records) -------
+    def trace_is_hot(self, ctx: Optional[TraceContext]) -> bool:
+        """True when the payload's trace is already bound for retention
+        (forced by retry/DLQ/error, or past the tenant's SLO budget) —
+        the stage-record stride must not skip these: the incident
+        snapshot needs the SLOW event's own timings, not a neighbor's."""
+        if ctx is None:
+            return False
+        tr = self.store.peek(ctx.trace_id)
+        if tr is None:
+            return False
+        if tr.forced:
+            return True
+        return tr.duration_ms >= self.policy_for(tr.tenant).slo_ms
+
     # -- tail decision ----------------------------------------------------
     def _decide(self, tr: TraceRecord) -> None:
         pol = self.policy_for(tr.tenant)
+        if self.latency is not None:
+            # attribution reads every decision, BEFORE sampling drops
+            # the clean majority (ingest_trace never raises)
+            self.latency.ingest_trace(tr, pol.slo_ms)
         if tr.forced:
             reason = tr.forced[0]
         elif tr.duration_ms >= pol.slo_ms:
@@ -485,8 +513,9 @@ class StageTimer:
         self.wait_h.record(max(0.0, queue_wait_ms) / 1000.0)
         self.events_c.inc(n_events)
         if self.tracer is not None:
+            ctx = trace_ctx_of(item)
             self.tracer.record_span(
-                trace_ctx_of(item), self.stage, start_ms, end_ms,
+                ctx, self.stage, start_ms, end_ms,
                 queue_wait_ms=queue_wait_ms, n_events=n_events, error=error,
                 advance=self.stage not in FORK_STAGES,
                 **annotations,
@@ -494,8 +523,16 @@ class StageTimer:
             fr = self.tracer.flightrec
             if fr is not None:
                 self._fr_tick += 1
-                if error or self._fr_tick >= self.FLIGHTREC_STRIDE:
-                    self._fr_tick = 0
+                # tail-blindness guard: the stride may skip the exact
+                # batch that breached/retried — any span whose trace the
+                # tail sampler will retain records unconditionally, so
+                # the incident snapshot holds the slow event's own
+                # timings (forced records do not reset the stride; the
+                # steady cadence stays intact around an incident)
+                hot = bool(error) or self.tracer.trace_is_hot(ctx)
+                if hot or self._fr_tick >= self.FLIGHTREC_STRIDE:
+                    if self._fr_tick >= self.FLIGHTREC_STRIDE:
+                        self._fr_tick = 0
                     rec = fr.record(
                         "stage", f"{self.tenant}/{self.stage}",
                         service_ms=round(max(0.0, end_ms - start_ms), 3),
@@ -504,6 +541,8 @@ class StageTimer:
                     )
                     if error:
                         rec["error"] = error
+                    if hot and not error:
+                        rec["forced"] = "tail"
 
 
 def queue_wait_from(item: Any, start_ms: float) -> float:

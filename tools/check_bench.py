@@ -86,12 +86,21 @@ _INFO_EXACT = {"vit_wire_mbps"}
 _P99_EXACT = {"serve_p99_train_delta"}
 
 
+def _is_latency_key(key: str) -> bool:
+    """The paced-bench latency column family (ISSUE 17): ``p99_e2e_ms``
+    and the per-stage ``p99_<stage>_ms`` columns. Prefix style (p99_
+    first) so the family reads as one block in the headline; the legacy
+    ``*_p99_ms`` suffix rule can't cover it. Lower is better, gated at
+    the p99 tolerance; new keys report n/a against pre-paced baselines."""
+    return key.startswith("p99_") and key.endswith("_ms")
+
+
 def classify(key: str) -> str:
     """'throughput' (higher is better, gated), 'p99' (lower is better,
     gated), or 'info' (reported, never gates)."""
     if key in _INFO_EXACT:
         return "info"
-    if key.endswith("_p99_ms") or key in _P99_EXACT:
+    if key.endswith("_p99_ms") or key in _P99_EXACT or _is_latency_key(key):
         return "p99"
     if (
         key == "value"

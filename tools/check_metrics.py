@@ -26,7 +26,13 @@ stdin) and fails on malformed exposition lines:
   axis, the per-bin analog of unbounded label cardinality);
 - ``score_quality_*`` families are GAUGES by contract (current state of
   a rolling window, never monotonic): one declared as a counter — or
-  wearing the ``_total`` suffix — is a finding.
+  wearing the ``_total`` suffix — is a finding;
+- every ``pipeline_stage_seconds`` child must have a
+  ``pipeline_stage_queue_wait_seconds`` twin with the same label set: the
+  latency-attribution ledger (runtime/latency.py) decomposes each stage
+  into queue-wait + service, so a stage that times its handler but never
+  reports its queue wait silently under-attributes tail latency — the
+  exact blindness the decomposition exists to remove.
 
 Used three ways: ``python tools/check_metrics.py`` boots a small
 instance, drives events through the pipeline, and lints the scrape
@@ -81,6 +87,14 @@ SKETCH_MAX_BINS = 64
 
 # label names that enumerate histogram bins (per-bin cardinality rule)
 BIN_LABEL_NAMES = ("bin", "le")
+
+# (service-time family, queue-wait twin) pairs: every child of the first
+# must have a same-labels child under the second — a stage that measures
+# handler time but not queue wait under-attributes tail latency in the
+# per-stage p99 decomposition (runtime/latency.py)
+QUEUE_WAIT_TWINS = (
+    ("pipeline_stage_seconds", "pipeline_stage_queue_wait_seconds"),
+)
 
 
 def _parse_labels(block: str) -> Tuple[Dict[str, str], str]:
@@ -143,6 +157,11 @@ def lint_exposition(
     helps: set = set()
     children: Dict[str, set] = {}  # family → distinct label tuples
     bins: Dict[str, set] = {}      # family → distinct bin/le values
+    # family → label tuples stripped of bin/le/quantile, for the
+    # queue-wait-twin rule (histogram children of both families must
+    # align on the REAL label axis, not the bucket axis)
+    twin_fams = {f for pair in QUEUE_WAIT_TWINS for f in pair}
+    twin_children: Dict[str, set] = {}
     lines = text.splitlines()
     if require_eof:
         tail = next((l for l in reversed(lines) if l.strip()), "")
@@ -236,6 +255,11 @@ def lint_exposition(
             children.setdefault(fam, set()).add(
                 tuple(sorted(real_labels.items()))
             )
+        if fam in twin_fams:
+            twin_children.setdefault(fam, set()).add(tuple(sorted(
+                (k, v) for k, v in real_labels.items()
+                if k not in BIN_LABEL_NAMES
+            )))
     for fam, tuples in sorted(children.items()):
         if len(tuples) > max_children:
             errors.append(
@@ -248,6 +272,16 @@ def lint_exposition(
                 f"family {fam} exposes {len(vals)} distinct bins "
                 f"(> {max_bins}) — per-bin exposition must stay "
                 f"sketch-sized (SKETCH_MAX_BINS)"
+            )
+    for svc_fam, wait_fam in QUEUE_WAIT_TWINS:
+        missing = twin_children.get(svc_fam, set()) \
+            - twin_children.get(wait_fam, set())
+        for tup in sorted(missing):
+            label_str = ",".join(f'{k}="{v}"' for k, v in tup)
+            errors.append(
+                f"{svc_fam}{{{label_str}}} has no {wait_fam} twin — "
+                f"every timed stage must also report queue wait (the "
+                f"per-stage latency decomposition needs both halves)"
             )
     return errors
 

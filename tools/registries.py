@@ -122,6 +122,17 @@ HOT_PATHS: Dict[str, List[str]] = {
         "ReplayEngine._scan_loop",
         "ReplayEngine._pump_loop",
     ],
+    # the latency-attribution feed runs once per TRACE at tail-decide
+    # time (per batch, not per event): the stage-vector flatten, ledger
+    # window push, and burn-bucket update must stay O(spans)/O(1) with
+    # no per-row collections — decompose()/reports are read-path and
+    # may sort freely
+    "runtime/latency.py": [
+        "stage_vector",
+        "LatencyEngine.ingest_trace",
+        "StageLedger.add",
+        "_BurnAccount.note",
+    ],
 }
 
 # =====================================================================
