@@ -38,7 +38,6 @@ from sitewhere_tpu.core.model import (
     Zone,
 )
 from sitewhere_tpu.instance import SiteWhereInstance, TenantRuntime
-from sitewhere_tpu.runtime.bus import publish_at_least_once
 from sitewhere_tpu.services.batch_operations import BatchOpStatus
 from sitewhere_tpu.services.event_store import EventQuery
 from sitewhere_tpu.services.schedule_management import Schedule
@@ -1177,13 +1176,21 @@ class RestApi:
         topic = entry.get("source_topic", "")
         if not topic:
             return 0
-        # the redelivery publish itself must be at-least-once: the DLQ
-        # cursor has already advanced past this entry
-        await publish_at_least_once(
-            self.instance.bus, topic, payload,
-            metrics=self.instance.metrics,
-        )
+        self._commit_requeue(topic, payload)
         return 1
+
+    def _commit_requeue(self, topic: str, payload) -> None:
+        """Cancellation-atomic DLQ → source-topic move (registered
+        commit section, tools/registries.py): the republish and its
+        counter land with NO await between them, so a client disconnect
+        cancelling the requeue request — or a broker restart racing it —
+        cannot strand an entry between "taken from the DLQ poll" and
+        "counted as requeued". ``publish_nowait`` is sync on both bus
+        flavors; on a remote bus mid-outage the frame rides the bounded
+        reconnect buffer (flushed on reconnect/failover, overflow
+        counted ``netbus_frames_lost_total`` — never silent)."""
+        self.instance.bus.publish_nowait(topic, payload)
+        self.instance.metrics.counter("dlq.requeued_entries").inc()
 
     # -- schedules / batch ----------------------------------------------
     async def list_schedules(self, request) -> web.Response:

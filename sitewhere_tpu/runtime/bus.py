@@ -236,6 +236,21 @@ class Topic:
             self.aux_event.set()
         return off
 
+    def replica_append(self, offset: int, payload: Any) -> bool:
+        """Apply one append replicated from a PRIMARY broker at the
+        primary's offset assignment (netbus warm standby). Idempotent:
+        offsets this replica already holds are dropped (poll overlap
+        after a resync), and the primary's numbering wins outright —
+        after promotion the standby must serve the primary's offsets,
+        never a private renumbering of them."""
+        if self.dropped or offset < self._next_offset:
+            return False
+        self._next_offset = offset
+        if self._live_len() >= self.retention:
+            self._evict_oldest()
+        self._append(payload)
+        return True
+
     # -- consumer side ---------------------------------------------------
     @property
     def latest_offset(self) -> int:
@@ -692,6 +707,20 @@ class EventBus:
             t = self.topic(name)
             for g, off in groups.items():
                 t.seek(g, off)
+
+    def apply_replica_append(
+        self, topic: str, part: int, offset: int, payload: Any
+    ) -> bool:
+        """Replication apply point (netbus warm standby): land one
+        replicated WAL entry in partition ``part`` of ``topic`` at the
+        primary's offset. A partition-count mismatch (reconfigured
+        standby) is not applyable record-by-record — the caller falls
+        back to a full snapshot resync."""
+        t = self.topic(topic)
+        parts = t.parts if isinstance(t, PartitionedTopic) else [t]
+        if part >= len(parts):
+            return False
+        return parts[part].replica_append(offset, payload)
 
     # -- durable state (the checkpoint seam) ------------------------------
     def snapshot_state(self) -> Dict[str, dict]:

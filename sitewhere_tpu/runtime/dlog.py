@@ -71,6 +71,10 @@ class SegmentWriter:
         self._fh: Optional[io.BufferedWriter] = None
         self._written = 0
         self._last_offset = -1
+        # replication hook (netbus warm standby): fires synchronously
+        # inside append, AFTER the frame is flushed — so the replication
+        # stream per partition is exactly offset order, already durable
+        self.listener = None
 
     def _open_segment(self, first_offset: int) -> None:
         self.close()
@@ -88,6 +92,8 @@ class SegmentWriter:
             os.fsync(self._fh.fileno())
         self._written += _LEN.size + len(data)
         self._last_offset = offset
+        if self.listener is not None:
+            self.listener(offset, payload)
 
     def _rotate(self, next_offset: int) -> None:
         self._open_segment(next_offset)
@@ -126,26 +132,59 @@ def read_segments(root: Path) -> List[Tuple[int, Any]]:
     return out
 
 
-class OffsetsJournal:
-    """Append-only consumer-cursor journal with snapshot compaction."""
+def iter_frames(path: Path):
+    """Intact length-prefixed frames of one journal file, in order; the
+    first torn or corrupt frame (mid-write kill) ends the iteration —
+    everything before it is trustworthy, everything after is not."""
+    try:
+        data = path.read_bytes()
+    except OSError:
+        return
+    pos = 0
+    while pos + _LEN.size <= len(data):
+        (n,) = _LEN.unpack(data[pos:pos + _LEN.size])
+        if pos + _LEN.size + n > len(data):
+            return  # torn tail
+        try:
+            yield safepickle.loads(data[pos + _LEN.size:pos + _LEN.size + n])
+        except Exception:  # noqa: BLE001 - corrupt frame ends the journal
+            return
+        pos += _LEN.size + n
+
+
+class FrameJournal:
+    """Append-only delta journal with snapshot compaction — the shared
+    mechanics under the cursor journal and the lease journal.
+
+    Compaction triggers three ways: every ``COMPACT_EVERY`` delta
+    appends, past ``COMPACT_BYTES`` on disk, and unconditionally at open
+    (a broker restart collapses the whole history to one snapshot frame
+    — the journal never grows across incarnations). The compact itself
+    is the segstore commit-point pattern: write ``<name>.tmp``, fsync,
+    atomic ``replace``. A kill at ANY instant leaves either the old
+    journal or the new snapshot on disk; a stranded ``.tmp`` (killed
+    between the write and the replace) is discarded at the next open.
+
+    Subclasses define the record vocabulary by implementing
+    ``_apply(state, rec)``; snapshot frames are ``("s", state)``.
+    """
 
     COMPACT_EVERY = 20_000
+    COMPACT_BYTES = 4 << 20
 
     def __init__(self, path: Path, fsync: bool = False) -> None:
         self.path = path
         self.fsync = fsync
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        # torn-compaction leftover: the journal itself is intact (replace
+        # never ran), so the .tmp is dead weight — drop it
+        self.path.with_suffix(".tmp").unlink(missing_ok=True)
         self._fh = open(self.path, "ab")
         self._appends = 0
-
-    def record(self, topic: str, group: str, cursor: Any) -> None:
-        self._write(("o", topic, group, cursor))
-
-    def tombstone(self, topic: str) -> None:
-        """Forget every cursor of a dropped topic — without this, a
-        re-added topic would resurrect with a stale cursor ahead of its
-        empty log and silently hide its first events."""
-        self._write(("d", topic))
+        self._bytes = self.path.stat().st_size
+        self.compactions = 0
+        if self._bytes:
+            self.compact(self.replay())  # restart compaction
 
     def _write(self, rec: tuple) -> None:
         data = pickle.dumps(rec, pickle.HIGHEST_PROTOCOL)
@@ -154,10 +193,11 @@ class OffsetsJournal:
         if self.fsync:
             os.fsync(self._fh.fileno())
         self._appends += 1
-        if self._appends >= self.COMPACT_EVERY:
+        self._bytes += _LEN.size + len(data)
+        if self._appends >= self.COMPACT_EVERY or self._bytes >= self.COMPACT_BYTES:
             self.compact(self.replay())
 
-    def compact(self, state: Dict[str, Dict[str, Any]]) -> None:
+    def compact(self, state) -> None:
         tmp = self.path.with_suffix(".tmp")
         data = pickle.dumps(("s", state), pickle.HIGHEST_PROTOCOL)
         with open(tmp, "wb") as f:
@@ -168,36 +208,98 @@ class OffsetsJournal:
         tmp.replace(self.path)
         self._fh = open(self.path, "ab")
         self._appends = 0
+        self._bytes = self.path.stat().st_size
+        self.compactions += 1
 
-    def replay(self) -> Dict[str, Dict[str, Any]]:
-        """{topic: {group: cursor}} from snapshot + deltas."""
-        state: Dict[str, Dict[str, Any]] = {}
-        try:
-            data = self.path.read_bytes()
-        except OSError:
-            return state
-        pos = 0
-        while pos + _LEN.size <= len(data):
-            (n,) = _LEN.unpack(data[pos:pos + _LEN.size])
-            if pos + _LEN.size + n > len(data):
-                break
-            try:
-                rec = safepickle.loads(data[pos + _LEN.size:pos + _LEN.size + n])
-            except Exception:  # noqa: BLE001
-                break
+    def _copy_snapshot(self, snap):
+        """Deep-enough copy of a snapshot frame's state."""
+        return snap
+
+    def _apply(self, state, rec) -> None:
+        raise NotImplementedError
+
+    def replay(self):
+        state: Dict[str, Any] = {}
+        for rec in iter_frames(self.path):
             if rec[0] == "s":
-                state = {t: dict(g) for t, g in rec[1].items()}
-            elif rec[0] == "d":
-                state.pop(rec[1], None)
+                state = self._copy_snapshot(rec[1])
             else:
-                _, topic, group, cursor = rec
-                state.setdefault(topic, {})[group] = cursor
-            pos += _LEN.size + n
+                self._apply(state, rec)
         return state
 
     def close(self) -> None:
         self._fh.flush()
         self._fh.close()
+
+
+class OffsetsJournal(FrameJournal):
+    """Append-only consumer-cursor journal with snapshot compaction."""
+
+    def __init__(self, path: Path, fsync: bool = False) -> None:
+        # replication hook (netbus warm standby): called per cursor
+        # record AFTER it is journaled locally
+        self.listener = None
+        super().__init__(path, fsync)
+
+    def record(self, topic: str, group: str, cursor: Any) -> None:
+        self._write(("o", topic, group, cursor))
+        if self.listener is not None:
+            self.listener(topic, group, cursor)
+
+    def tombstone(self, topic: str) -> None:
+        """Forget every cursor of a dropped topic — without this, a
+        re-added topic would resurrect with a stale cursor ahead of its
+        empty log and silently hide its first events."""
+        self._write(("d", topic))
+
+    def _copy_snapshot(self, snap):
+        return {t: dict(g) for t, g in snap.items()}
+
+    def _apply(self, state, rec) -> None:
+        if rec[0] == "d":
+            state.pop(rec[1], None)
+        else:
+            _, topic, group, cursor = rec
+            state.setdefault(topic, {})[group] = cursor
+
+    def replay(self) -> Dict[str, Dict[str, Any]]:
+        """{topic: {group: cursor}} from snapshot + deltas."""
+        return super().replay()
+
+
+class LeaseJournal(FrameJournal):
+    """Durable lease-fencing state for the broker (netbus): per-host
+    epoch high-waters and fence records, appended as the ``LeaseTable``
+    mutates and replayed at broker start. Without it a broker restart
+    silently resets epochs: a previously-FENCED zombie re-adopts at its
+    old epoch through the renewal path and un-fences itself — exactly
+    the double-serve the fence existed to prevent. Records are tiny
+    (``("h", host, high)`` / ``("f", host, high)``) and lease churn is
+    low, so the thresholds sit well under the cursor journal's."""
+
+    COMPACT_EVERY = 4096
+    COMPACT_BYTES = 1 << 20
+
+    def note_high(self, host: str, high: int) -> None:
+        self._write(("h", str(host), int(high)))
+
+    def note_fence(self, host: str, high: int) -> None:
+        self._write(("f", str(host), int(high)))
+
+    def _copy_snapshot(self, snap):
+        return {h: dict(st) for h, st in snap.items()}
+
+    def _apply(self, state, rec) -> None:
+        kind, host, high = rec
+        st = state.setdefault(host, {"high": 0, "fenced": False})
+        st["high"] = max(int(st["high"]), int(high))
+        # "fenced" = the LAST high-water move was a fence; a later
+        # legitimate re-acquire (a fresh grant past the fence) clears it
+        st["fenced"] = kind == "f"
+
+    def replay(self) -> Dict[str, Dict[str, Any]]:
+        """{host: {"high": int, "fenced": bool}}."""
+        return super().replay()
 
 
 class DurableEventBus(EventBus):
@@ -230,6 +332,7 @@ class DurableEventBus(EventBus):
         # before the reply lands re-delivers that batch on restart
         # (at-least-once) instead of silently skipping it (at-most-once).
         self._pending: Dict[Tuple[str, str], Any] = {}
+        self._repl_append_cb = None
         self._recover()
 
     # -- wiring ----------------------------------------------------------
@@ -243,6 +346,34 @@ class DurableEventBus(EventBus):
                 self._part_dir(name, i), self.segment_bytes,
                 self.fsync, self.retention,
             )
+            if self._repl_append_cb is not None:
+                p.wal.listener = self._wal_listener(name, i)
+
+    # -- replication hooks (netbus warm standby) --------------------------
+    def _wal_listener(self, name: str, part: int):
+        cb = self._repl_append_cb
+        return lambda off, payload: cb(name, part, off, payload)
+
+    def set_repl_listener(self, on_append) -> None:
+        """Arm dlog tailing: ``on_append(topic, part, offset, payload)``
+        fires synchronously inside every WAL append, so the replication
+        stream is exactly offset order per partition — the property the
+        standby's ``replica_append`` relies on. Covers EVERY append path
+        (publish, publish_nowait, fenced-publish diversions, DLQ moves)
+        because they all funnel through the WAL."""
+        self._repl_append_cb = on_append
+        for name, t in self._topics.items():
+            parts = t.parts if isinstance(t, PartitionedTopic) else [t]
+            for i, p in enumerate(parts):
+                if p.wal is not None:
+                    p.wal.listener = self._wal_listener(name, i)
+
+    def set_cursor_listener(self, on_record) -> None:
+        """``on_record(topic, group, cursor)`` fires per journaled cursor
+        commit — NOT per in-memory cursor move: replicating the journal
+        (commit-on-next-poll) keeps the standby's cursors at-least-once,
+        never ahead of a batch the consumer might not have processed."""
+        self._journal.listener = on_record
 
     def _make_topic(self, name: str):
         t = super()._make_topic(name)

@@ -246,6 +246,11 @@ HOST_FAULT_KINDS = (
     "renew_blackhole",
     "partition",
     "slow_heartbeat",
+    # broker fault domain: stall the warm standby's replication tail by
+    # delay_s per poll (consulted by netbus.StandbyReplicator with
+    # host="standby", op="repl") — the replication-lag gauge must grow
+    # visibly instead of the standby silently serving stale state
+    "repl_stall",
 )
 
 

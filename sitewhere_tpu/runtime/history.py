@@ -102,6 +102,16 @@ DEFAULT_ALLOWLIST: Tuple[str, ...] = (
     "latency_stage_p99_ms",
     "latency_slo_burn",
     "tpu_flush_latency_p99_ms",
+    # broker fault domain (runtime.netbus): standby replication lag,
+    # promotions, generation fences, and client reconnect outcomes —
+    # "when did the broker fail over / was the standby caught up"
+    # questions read these beside the host-lease series
+    "netbus_replication_lag",
+    "netbus_reconnects_total",
+    "netbus_fenced_appends_total",
+    "netbus_frames_lost_total",
+    "broker_promotions_total",
+    "broker_generation_fenced_total",
 )
 
 # Families the Watchdog rules read from the history ring. A custom
@@ -125,6 +135,10 @@ WATCHDOG_REQUIRED: Tuple[str, ...] = (
     # ring), but its alert evidence window lives in these series
     "latency_e2e_p99_ms",
     "latency_slo_burn",
+    # broker_failover reads the reconnect-exhausted outcome and the
+    # fenced-append counter (runtime.netbus broker fault domain)
+    "netbus_reconnects_total",
+    "netbus_fenced_appends_total",
 )
 
 # PSI verdict boundary the score_drift rule shares with the REST health
@@ -672,6 +686,51 @@ class Watchdog:
             "host": first.get("host") if first else None,
         }
 
+    def _rule_broker_failover(self):
+        """The bus-client side of the broker fault domain went
+        unhealthy inside the rule window: a client exhausted its whole
+        reconnect window without reaching ANY configured endpoint
+        (outcome="exhausted" — the pipeline saw real ConnectionErrors),
+        or appends landed on a FENCED broker (a zombie primary is still
+        taking traffic from some pinned producer). Either way the
+        detail says which, so the on-call knows whether to chase the
+        endpoint list or the zombie."""
+        exhausted = 0.0
+        for name in self.history.children("netbus_reconnects_total"):
+            if _child_labels(name).get("outcome") != "exhausted":
+                continue
+            d = self.history.delta(name, self.window)
+            if d is None:
+                d = self.history.latest(name)  # born inside the window
+            exhausted += d or 0.0
+        fenced = 0.0
+        for name in self.history.children("netbus_fenced_appends_total"):
+            d = self.history.delta(name, self.window)
+            if d is None:
+                d = self.history.latest(name)
+            fenced += d or 0.0
+        if exhausted < 1 and fenced < 1:
+            return None
+        parts = []
+        if exhausted >= 1:
+            parts.append(
+                f"{int(exhausted)} reconnect window(s) exhausted "
+                f"(no broker endpoint reachable)"
+            )
+        if fenced >= 1:
+            parts.append(
+                f"{int(fenced)} append(s) hit a fenced broker "
+                f"(zombie primary still receiving traffic)"
+            )
+        return {
+            "detail": (
+                f"broker fault domain unhealthy in {self.window_s:g}s: "
+                + "; ".join(parts)
+            ),
+            "reconnects_exhausted": int(exhausted),
+            "fenced_appends": int(fenced),
+        }
+
     def _rule_slo_burn(self):
         """A tenant is burning its latency error budget on BOTH windows:
         the 5 min burn proves it is happening now, the 1 h burn proves
@@ -719,6 +778,7 @@ class Watchdog:
         ("nan_rate_spike", "_rule_nan_rate_spike"),
         ("flush_timeout", "_rule_flush_timeout"),
         ("host_lease_lost", "_rule_host_lease_lost"),
+        ("broker_failover", "_rule_broker_failover"),
         ("slo_burn", "_rule_slo_burn"),
     )
 

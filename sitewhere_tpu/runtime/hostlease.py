@@ -84,12 +84,22 @@ class LeaseTable:
         default_ttl_s: float = DEFAULT_LEASE_TTL_S,
         clock=time.monotonic,
         metrics: Optional[MetricsRegistry] = None,
+        journal=None,
     ) -> None:
         self.default_ttl_s = float(default_ttl_s)
         self._clock = clock
         self.metrics = metrics or MetricsRegistry()
         self._leases: Dict[str, dict] = {}
         self._high: Dict[str, int] = {}   # epoch high-water, survives release
+        # durable fencing state (dlog.LeaseJournal): the high-waters and
+        # fence records ride the broker's data dir, so a broker RESTART
+        # can no longer silently reset epochs — a pre-restart fence still
+        # refuses the zombie's old-epoch renewal re-adoption on the fresh
+        # table. None = process-local (in-proc tests, memory brokers).
+        self.journal = journal
+        if journal is not None:
+            for host, st in journal.replay().items():
+                self._high[host] = int(st.get("high", 0))
 
     # -- grants ----------------------------------------------------------
     def acquire(
@@ -106,6 +116,8 @@ class LeaseTable:
         ttl = self.default_ttl_s if ttl_s is None else float(ttl_s)
         epoch = max(self._high.get(host, 0), int(min_epoch)) + 1
         self._high[host] = epoch
+        if self.journal is not None:
+            self.journal.note_high(host, epoch)
         now = self._clock()
         self._leases[host] = {
             "epoch": epoch,
@@ -141,6 +153,8 @@ class LeaseTable:
             if int(epoch) >= self._high.get(host, 0) and int(epoch) > 0:
                 ttl = self.default_ttl_s if ttl_s is None else float(ttl_s)
                 self._high[host] = int(epoch)
+                if self.journal is not None:
+                    self.journal.note_high(host, int(epoch))
                 self._leases[host] = st = {
                     "epoch": int(epoch),
                     "ttl_s": ttl,
@@ -185,6 +199,8 @@ class LeaseTable:
             self._high.get(host, 0), st["epoch"] if st else 0
         ) + 1
         self._high[host] = high
+        if self.journal is not None:
+            self.journal.note_fence(host, high)
         if st is not None:
             st["fenced"] = True
         logger.warning("lease fenced: host=%s high-water=%d", host, high)
@@ -202,6 +218,69 @@ class LeaseTable:
             and not st["fenced"]
             and int(epoch) == st["epoch"]
         )
+
+    # -- broker failover (netbus warm standby) ---------------------------
+    def extend_all(self, grace_s: float) -> int:
+        """Post-promotion lease grace: push every UNFENCED lease's expiry
+        out to at least ``now + grace_s``, so the failover window itself
+        (replication lag + promotion + client reconnects) never reads as
+        mass expiry to the supervisor. Fenced leases stay fenced — the
+        fence is a verdict, not expiry evidence. Returns the number of
+        leases extended."""
+        now = self._clock()
+        floor = now + float(grace_s)
+        n = 0
+        for st in self._leases.values():
+            if st["fenced"] or st["expires_at"] >= floor:
+                continue
+            st["expires_at"] = floor
+            n += 1
+        return n
+
+    def export(self) -> dict:
+        """Replication snapshot: high-waters + live leases with RELATIVE
+        expiries (monotonic clocks mean nothing across processes)."""
+        now = self._clock()
+        return {
+            "high": dict(self._high),
+            "leases": {
+                h: {
+                    "epoch": st["epoch"],
+                    "ttl_s": st["ttl_s"],
+                    "expires_in_s": st["expires_at"] - now,
+                    "slices": tuple(st["slices"]),
+                    "health": dict(st["health"]),
+                    "fenced": st["fenced"],
+                    "renewals": st["renewals"],
+                    "age_s": now - st["since"],
+                }
+                for h, st in self._leases.items()
+            },
+        }
+
+    def load(self, snap: dict) -> None:
+        """Apply a replication snapshot (standby resync): replaces the
+        table wholesale, journaling the imported fencing state so it is
+        durable on THIS broker too."""
+        now = self._clock()
+        self._high = {h: int(v) for h, v in snap.get("high", {}).items()}
+        if self.journal is not None:
+            for h, v in self._high.items():
+                self.journal.note_high(h, v)
+        self._leases = {}
+        for h, row in snap.get("leases", {}).items():
+            self._leases[h] = {
+                "epoch": int(row["epoch"]),
+                "ttl_s": float(row["ttl_s"]),
+                "expires_at": now + float(row["expires_in_s"]),
+                "slices": tuple(row.get("slices", ())),
+                "health": dict(row.get("health", {})),
+                "fenced": bool(row["fenced"]),
+                "renewals": int(row.get("renewals", 0)),
+                "since": now - float(row.get("age_s", 0.0)),
+            }
+            if row["fenced"] and self.journal is not None:
+                self.journal.note_fence(h, self._high.get(h, int(row["epoch"])))
 
     # -- coordinator reads -----------------------------------------------
     def expired(self, now: Optional[float] = None) -> List[str]:
@@ -514,6 +593,7 @@ class HostSupervisor(LifecycleComponent):
         sick_flush_timeout_rate: float = 0.5,
         sick_heartbeats: int = 3,
         probation_probes: int = 2,
+        broker_grace_s: float = 5.0,
         on_adopt=None,
         on_rebalance_home=None,
     ) -> None:
@@ -527,10 +607,19 @@ class HostSupervisor(LifecycleComponent):
         self.sick_flush_timeout_rate = float(sick_flush_timeout_rate)
         self.sick_heartbeats = int(sick_heartbeats)
         self.probation_probes = int(probation_probes)
+        # "broker unreachable" is NOT "host dead": after a broker bounce
+        # or failover the lease table was just rehydrated (disk replay or
+        # replication) and its expiries may read stale for a beat while
+        # every host's renewals are still reconnecting. Expiry verdicts
+        # are suppressed for this window after contact resumes, so a
+        # sub-window failover never triggers fleet-wide tenant adoption.
+        self.broker_grace_s = float(broker_grace_s)
         self.on_adopt = on_adopt
         self.on_rebalance_home = on_rebalance_home
         self._hosts: Dict[str, dict] = {}
         self._task: Optional[asyncio.Task] = None
+        self._broker_down = False
+        self._grace_until = 0.0
 
     # -- lifecycle -------------------------------------------------------
     async def on_start(self) -> None:
@@ -564,21 +653,49 @@ class HostSupervisor(LifecycleComponent):
                 # broker bounce: the lease table is unreadable this
                 # tick; verdicts wait — a coordinator must never
                 # suspect hosts on ITS OWN partition's evidence
+                self.note_broker_unreachable()
                 continue
             except Exception as exc:  # noqa: BLE001 - rule bugs must
                 # not kill supervision
                 self._record_error("host-watch", exc)
 
+    def note_broker_unreachable(self) -> None:
+        """Record a failed lease-table read (called by the watch loop,
+        and callable by an embedding coordinator with its own loop): the
+        NEXT successful poll opens the post-rehydration grace window."""
+        self._broker_down = True
+        self.metrics.counter(
+            "host_supervisor_broker_unreachable_total"
+        ).inc()
+
     async def poll_once(self) -> List[dict]:
         """One supervision tick. Returns the verdicts applied (tests)."""
         table = await self.bus.lease_table()
+        now = time.monotonic()
+        if self._broker_down:
+            # contact resumed after ≥1 failed tick: broker bounce or
+            # failover. Suppress expiry verdicts for the grace window —
+            # fences are still honored (durable verdicts, not evidence).
+            self._broker_down = False
+            if self.broker_grace_s > 0.0:
+                self._grace_until = now + self.broker_grace_s
+                self.metrics.counter(
+                    "host_supervisor_grace_windows_total"
+                ).inc()
+                logger.info(
+                    "broker contact resumed: suppressing lease-expiry "
+                    "verdicts for %.1fs", self.broker_grace_s,
+                )
+        in_grace = now < self._grace_until
         verdicts: List[dict] = []
         for host, row in table.items():
             st = self._hosts.setdefault(
                 host, {"state": "live", "sick": 0, "epoch": row["epoch"]}
             )
             if st["state"] == "live":
-                if row["fenced"] or row["expires_in_s"] <= 0.0:
+                if row["fenced"] or (
+                    row["expires_in_s"] <= 0.0 and not in_grace
+                ):
                     await self.suspect(host, "lease_expired", row)
                     verdicts.append({"host": host, "to": "suspect",
                                      "reason": "lease_expired"})
@@ -606,7 +723,9 @@ class HostSupervisor(LifecycleComponent):
                     st["epoch"] = row["epoch"]
                     verdicts.append({"host": host, "to": "probation"})
             elif st["state"] == "probation":
-                if row["fenced"] or row["expires_in_s"] <= 0.0:
+                if row["fenced"] or (
+                    row["expires_in_s"] <= 0.0 and not in_grace
+                ):
                     # relapsed mid-probation: stay suspect (already
                     # fenced + adopted; nothing more to move)
                     st["state"] = "suspect"

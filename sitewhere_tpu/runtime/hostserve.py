@@ -293,7 +293,11 @@ def main(argv=None) -> None:
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--broker-host", default="127.0.0.1")
-    ap.add_argument("--broker-port", type=int, required=True)
+    ap.add_argument("--broker-port", type=int, default=0)
+    ap.add_argument("--broker-endpoints", default="",
+                    help='failover endpoint list "host:port[,host:port]" '
+                         "(primary first, warm standbys after); overrides "
+                         "--broker-host/--broker-port")
     ap.add_argument("--host-id", required=True)
     ap.add_argument("--instance-id", default="sw")
     ap.add_argument("--data-dir", default="")
@@ -319,8 +323,17 @@ def main(argv=None) -> None:
 
         t_ax, d_ax, slots = (int(x) for x in args.mesh.split(","))
         naming = TopicNaming(args.instance_id)
+        if args.broker_endpoints:
+            endpoints = []
+            for spec in args.broker_endpoints.split(","):
+                h, _, p = spec.strip().rpartition(":")
+                endpoints.append((h or "127.0.0.1", int(p)))
+        elif args.broker_port:
+            endpoints = [(args.broker_host, args.broker_port)]
+        else:
+            ap.error("--broker-port or --broker-endpoints required")
         raw_bus = RemoteEventBus(
-            args.broker_host, args.broker_port, naming=naming,
+            endpoints=endpoints, naming=naming,
             reconnect_window_s=30.0,
         )
         await raw_bus.connect()

@@ -122,14 +122,38 @@ class Proc:
             pass
 
 
-def spawn_broker(tmp: Path, instance_id: str, *, durable: bool = False) -> "tuple[Proc, int]":
+def spawn_broker(
+    tmp: Path,
+    instance_id: str,
+    *,
+    durable: bool = False,
+    name: str = "broker",
+    port: int = 0,
+    standby_of: int = None,
+    failover_after: float = None,
+    lease_grace: float = None,
+) -> "tuple[Proc, int]":
+    """Spawn one broker process. ``standby_of`` (a primary's port) makes
+    it a warm STANDBY tailing that primary; ``name`` keys the data dir +
+    log so primary/standby/zombie incarnations stay distinguishable.
+    ``port`` pins the listen port (a zombie restart must come back on
+    the address its pinned clients still hold)."""
     argv = [
         sys.executable, "-m", "sitewhere_tpu.runtime.netbus",
-        "--port", "0", "--instance-id", instance_id,
+        "--port", str(port), "--instance-id", instance_id,
     ]
     if durable:
-        argv += ["--data-dir", str(tmp / "broker")]
-    proc = Proc(argv, tmp / "broker.log")
+        argv += ["--data-dir", str(tmp / name)]
+    if standby_of is not None:
+        argv += ["--standby-of", f"127.0.0.1:{int(standby_of)}"]
+    if failover_after is not None:
+        argv += ["--failover-after", str(failover_after)]
+    if lease_grace is not None:
+        argv += ["--lease-grace", str(lease_grace)]
+    suffix = 0
+    while (tmp / f"{name}.{suffix}.log").exists():
+        suffix += 1
+    proc = Proc(argv, tmp / f"{name}.{suffix}.log")
     ready = proc.ready()
     return proc, int(ready["port"])
 
@@ -145,11 +169,11 @@ def spawn_host(
     probation_probes: int = 2,
     restore: bool = False,
     recover_unscored: bool = False,
+    endpoints: str = "",
 ) -> Proc:
     data_dir = tmp / f"data-{host_id}"
     argv = [
         sys.executable, "-m", "sitewhere_tpu.runtime.hostserve",
-        "--broker-port", str(port),
         "--host-id", host_id,
         "--instance-id", instance_id,
         "--data-dir", str(data_dir),
@@ -157,6 +181,11 @@ def spawn_host(
         "--lease-ttl", str(lease_ttl),
         "--probation-probes", str(probation_probes),
     ]
+    if endpoints:
+        # failover-aware host: primary first, warm standby after
+        argv += ["--broker-endpoints", endpoints]
+    else:
+        argv += ["--broker-port", str(port)]
     if renew_interval is not None:
         argv += ["--renew-interval", str(renew_interval)]
     if restore:

@@ -1,0 +1,605 @@
+"""Broker fault domain, unit tier (docs/ROBUSTNESS.md "Broker fault
+domain"): durable generation fencing, journal compaction + torn-tmp
+recovery, lease-fence durability across broker restart, WAL-streaming
+warm standby → promotion → client failover (zero loss, at-least-once),
+zombie-primary gossip fencing + append diversion, the client's bounded
+fire-and-forget reconnect buffer, endpoint rotation, the supervisor's
+broker-grace window, and the cancellation-atomic DLQ requeue move. The
+multi-process kill -9 scenarios live in tests/test_broker_chaos.py
+(chaos tier)."""
+
+import asyncio
+import socket
+
+import pytest
+
+from sitewhere_tpu.api.rest import RestApi
+from sitewhere_tpu.parallel.placement import HostPlacement
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.dlog import (
+    DurableEventBus,
+    LeaseJournal,
+    OffsetsJournal,
+)
+from sitewhere_tpu.runtime.faultplan import HostFault, HostFaultPlan
+from sitewhere_tpu.runtime.hostlease import HostSupervisor, LeaseTable
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.netbus import (
+    BrokerGeneration,
+    BrokerGenerationFencedError,
+    BusBrokerServer,
+    RemoteEventBus,
+    StandbyReplicator,
+    _ReplRing,
+)
+
+
+# ------------------------------------------------------ generation file
+def test_broker_generation_durable_roundtrip(tmp_path):
+    path = tmp_path / "generation.json"
+    g = BrokerGeneration(path)
+    assert g.generation == 1 and not g.fenced
+    g.bump_to(3)
+    assert BrokerGeneration(path).generation == 3
+    g.fence(7)
+    assert g.fenced and g.fenced_by == 7 and g.seen == 7
+    # the fence is durable: a restart cannot un-fence
+    g2 = BrokerGeneration(path)
+    assert g2.fenced and g2.fenced_by == 7
+    # a promotion past everything seen clears the fence
+    g2.bump_to(8)
+    assert not g2.fenced
+    assert not BrokerGeneration(path).fenced
+
+
+def test_broker_generation_tolerates_corrupt_file(tmp_path):
+    path = tmp_path / "generation.json"
+    path.write_bytes(b"{not json")
+    g = BrokerGeneration(path)
+    assert g.generation == 1 and not g.fenced
+
+
+# -------------------------------------------- journal compaction (sat a)
+def test_offsets_journal_compacts_on_restart(tmp_path):
+    path = tmp_path / "offsets.log"
+    j = OffsetsJournal(path)
+    for i in range(50):
+        j.record("t.a", "g", i)
+    j.tombstone("t.dropped")
+    j.close()
+    many_frames_size = path.stat().st_size
+    # restart: the whole history collapses to one snapshot frame
+    j2 = OffsetsJournal(path)
+    assert j2.compactions >= 1
+    assert path.stat().st_size < many_frames_size
+    assert j2.replay() == {"t.a": {"g": 49}}
+    j2.close()
+
+
+def test_offsets_journal_compacts_past_size_threshold(tmp_path):
+    j = OffsetsJournal(tmp_path / "offsets.log")
+    j.COMPACT_BYTES = 512  # instance override: force the size trigger
+    before = j.compactions
+    for i in range(200):
+        j.record("t.big", "g", i)
+    assert j.compactions > before
+    assert j.replay() == {"t.big": {"g": 199}}
+    j.close()
+
+
+def test_offsets_journal_recovers_torn_compaction(tmp_path):
+    path = tmp_path / "offsets.log"
+    j = OffsetsJournal(path)
+    j.record("t.a", "g", 41)
+    j.close()
+    # killed between writing the snapshot .tmp and the atomic replace:
+    # the journal itself is intact, the .tmp is dead weight
+    path.with_suffix(".tmp").write_bytes(b"\xff" * 64)
+    j2 = OffsetsJournal(path)
+    assert not path.with_suffix(".tmp").exists()
+    assert j2.replay() == {"t.a": {"g": 41}}
+    j2.close()
+
+
+# ------------------------------------------- durable lease fencing state
+def test_lease_journal_replay_fence_then_reacquire_clears(tmp_path):
+    j = LeaseJournal(tmp_path / "leases.log")
+    j.note_high("h0", 3)
+    j.note_fence("h0", 4)
+    assert j.replay() == {"h0": {"high": 4, "fenced": True}}
+    # a fresh grant past the fence clears the fenced flag
+    j.note_high("h0", 5)
+    assert j.replay() == {"h0": {"high": 5, "fenced": False}}
+    j.close()
+
+
+def test_lease_fence_survives_broker_restart(tmp_path):
+    """ISSUE 18 acceptance: a broker restart on the same data dir must
+    not un-fence a zombie — its pre-restart epoch stays refused on the
+    renewal re-adoption path because the journaled high-water outlives
+    the in-memory table."""
+    path = tmp_path / "leases.log"
+    table = LeaseTable(journal=LeaseJournal(path))
+    epoch = table.acquire("h0")["epoch"]
+    high = table.fence("h0")
+    assert not table.check("h0", epoch)
+    table.journal.close()
+    # broker restart: fresh table, same journal
+    table2 = LeaseTable(journal=LeaseJournal(path))
+    # the zombie re-asserts its dead epoch — refused (epoch < high-water)
+    assert table2.renew("h0", epoch) == {"ok": False, "epoch": high}
+    # a legitimate re-acquire lands PAST the durable fence
+    grant = table2.acquire("h0")
+    assert grant["epoch"] > high
+    table2.journal.close()
+
+
+# ----------------------------------------------------- replication ring
+def test_repl_ring_eviction_forces_resync():
+    reg = MetricsRegistry()
+    ring = _ReplRing(capacity=4, metrics=reg)
+    for i in range(10):
+        ring.append(("wal", "t", 0, i, {"i": i}))
+    assert reg.counter("netbus_repl_evicted_total").value == 6
+    assert ring.base_seq == 6 and ring.head_seq == 10
+    recs, nxt, resync = ring.read(0, 100)
+    assert resync and recs == []
+    recs, nxt, resync = ring.read(6, 100)
+    assert not resync and nxt == 10
+    assert [r[3] for r in recs] == [6, 7, 8, 9]
+
+
+async def test_repl_poll_serves_resync_after_eviction(tmp_path):
+    naming = TopicNaming("ha")
+    broker = BusBrokerServer(
+        bus=DurableEventBus(tmp_path / "p", naming), repl_capacity=4
+    )
+    await broker.initialize()
+    await broker.start()
+    try:
+        for i in range(10):
+            broker.bus.publish_nowait(naming.global_topic("t"), {"i": i})
+        reply = await broker._repl_poll(0, 100, timeout_s=0.01)
+        assert reply.get("resync")
+        assert broker.metrics.counter(
+            "netbus_repl_resync_served_total").value == 1
+    finally:
+        await broker.terminate()
+
+
+# ------------------------------------- warm standby → promote → failover
+async def _ha_pair(tmp_path, *, failover_after_s=0.8, promoted=None):
+    naming = TopicNaming("ha")
+    primary = BusBrokerServer(bus=DurableEventBus(tmp_path / "p", naming))
+    await primary.initialize()
+    await primary.start()
+    standby = BusBrokerServer(
+        bus=DurableEventBus(tmp_path / "s", naming), role="standby"
+    )
+    await standby.initialize()
+    await standby.start()
+    repl = StandbyReplicator(
+        standby, [("127.0.0.1", primary.bound_port)],
+        failover_after_s=failover_after_s,
+        on_promote=(promoted.append if promoted is not None else None),
+    )
+    repl.RETRY_S = 0.05
+    repl.FENCE_PERIOD_S = 0.1
+    await repl.initialize()
+    await repl.start()
+    return naming, primary, standby, repl
+
+
+async def _wait_for(cond, timeout_s=10.0, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while not cond():
+        if loop.time() >= deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+async def test_warm_standby_promotion_and_client_failover(tmp_path):
+    """The tentpole lifecycle in-proc: replicate → kill the primary →
+    standby promotes at a fresh durable generation → the client rotates
+    to it and resumes from REPLICATED cursors (at-least-once: committed
+    items never redeliver lost, uncommitted may replay) → publishes
+    continue the primary's offset numbering (no fork, no gap)."""
+    promoted = []
+    naming, primary, standby, repl = await _ha_pair(
+        tmp_path, promoted=promoted)
+    topic = naming.global_topic("t1")
+    client = RemoteEventBus(
+        endpoints=[("127.0.0.1", primary.bound_port),
+                   ("127.0.0.1", standby.bound_port)],
+        naming=naming, reconnect_window_s=10.0,
+    )
+    await client.connect()
+    try:
+        client.subscribe(topic, "g", "earliest")
+        for i in range(20):
+            await client.publish(topic, {"i": i})
+        got = await client.consume(topic, "g", 10, timeout_s=2.0)
+        assert [e["i"] for e in got] == list(range(10))
+        # the second poll journals the first batch's cursor commit
+        got2 = await client.consume(topic, "g", 5, timeout_s=2.0)
+        assert [e["i"] for e in got2] == [10, 11, 12, 13, 14]
+        await _wait_for(lambda: repl.applied_seq > 0, what="replication")
+        await _wait_for(
+            lambda: repl.metrics.gauge("netbus_replication_lag").value == 0,
+            what="replication drain",
+        )
+
+        await primary.terminate()
+        await _wait_for(lambda: bool(promoted), what="promotion")
+        assert standby.role == "primary"
+        assert standby.generation.generation == 2
+        assert promoted[0]["generation"] == 2
+        assert standby.metrics.counter("broker_promotions_total").value == 1
+
+        # failover consume: committed [0..9] stay consumed; the
+        # in-flight batch [10..14] MAY replay (at-least-once); the tail
+        # [15..19] must arrive exactly
+        rest = []
+        while True:
+            batch = await client.consume(topic, "g", 50, timeout_s=2.0)
+            if not batch:
+                break
+            rest.extend(e["i"] for e in batch)
+        assert rest and rest[-1] == 19
+        assert min(rest) >= 10, "committed items redelivered past journal"
+        assert set(rest) >= {15, 16, 17, 18, 19}
+        # offsets continue the primary's numbering on the promoted WAL
+        assert await client.publish(topic, {"i": 20}) == 20
+        assert client.generation_seen == 2
+    finally:
+        await client.close()
+        await repl.terminate()
+        await standby.terminate()
+
+
+async def test_standby_rejects_data_plane_before_promotion(tmp_path):
+    naming, primary, standby, repl = await _ha_pair(tmp_path)
+    try:
+        sclient = RemoteEventBus(
+            host="127.0.0.1", port=standby.bound_port,
+            naming=naming, reconnect_window_s=0.0,
+        )
+        # the hello rejection surfaces through the rotate/backoff loop
+        # as plain unreachability; the ROLE lands on the counter
+        with pytest.raises(ConnectionError, match="unreachable"):
+            await sclient.connect()
+        assert sclient.metrics.counter(
+            "netbus_endpoint_rejected_total", role="standby").value == 1
+        await sclient.close()
+    finally:
+        await repl.terminate()
+        await standby.terminate()
+        await primary.terminate()
+
+
+async def test_zombie_primary_is_fenced_and_appends_diverted(tmp_path):
+    """The double-serve scenario: the dead primary restarts from its old
+    data dir on its old port. Generation gossip from the promoted
+    standby fences it durably; a pinned client's awaited appends raise,
+    fire-and-forget appends divert to the broker-fenced DLQ and are
+    counted — and the fence survives yet another restart."""
+    promoted = []
+    naming, primary, standby, repl = await _ha_pair(
+        tmp_path, promoted=promoted)
+    pport = primary.bound_port
+    topic = naming.global_topic("t1")
+    try:
+        await primary.terminate()
+        await _wait_for(lambda: bool(promoted), what="promotion")
+
+        zombie = BusBrokerServer(
+            bus=DurableEventBus(tmp_path / "p", naming), port=pport)
+        await zombie.initialize()
+        await zombie.start()
+        try:
+            # the standby's fence-peer loop hellos the old endpoint
+            await _wait_for(
+                lambda: zombie.generation.fenced, what="gossip fence")
+            assert zombie.generation.fenced_by == 2
+            assert zombie.metrics.counter(
+                "broker_generation_fenced_total").value == 1
+            assert standby.metrics.counter(
+                "broker_peer_fences_total").value == 1
+
+            # a naive client pinned to the old address is refused at hello
+            naive = RemoteEventBus(
+                host="127.0.0.1", port=pport,
+                naming=naming, reconnect_window_s=0.0,
+            )
+            with pytest.raises(ConnectionError, match="unreachable"):
+                await naive.connect()
+            assert naive.metrics.counter(
+                "netbus_endpoint_rejected_total", role="fenced").value >= 1
+            await naive.close()
+
+            # awaited append on an existing connection: loud error
+            with pytest.raises(BrokerGenerationFencedError):
+                await zombie._dispatch("publish", (topic, {"i": -1}, None))
+            # fire-and-forget append: diverted to the DLQ, counted
+            await zombie._dispatch(
+                "publish_nowait", (topic, {"i": -2}, None), noreply=True)
+            assert zombie.metrics.counter(
+                "netbus_fenced_appends_total", op="publish").value == 1
+            assert zombie.metrics.counter(
+                "netbus_fenced_appends_total", op="publish_nowait"
+            ).value == 1
+            dlq = zombie.bus.peek(naming.global_topic("broker-fenced"))
+            assert dlq["depth"] == 1
+        finally:
+            await zombie.terminate()
+
+        # durability: the fence outlives ANOTHER restart of the old dir
+        z2 = BusBrokerServer(bus=DurableEventBus(tmp_path / "p", naming))
+        assert z2.generation.fenced and z2.generation.fenced_by == 2
+    finally:
+        await repl.terminate()
+        await standby.terminate()
+
+
+async def test_replication_survives_repl_stall_fault(tmp_path):
+    """The chaos knob rides the standard faultplan seam: a repl_stall
+    slows the tail but replication still converges."""
+    naming, primary, standby, repl = await _ha_pair(tmp_path)
+    repl.faultplan = HostFaultPlan(
+        HostFault(kind="repl_stall", hosts=("standby",), ops=("repl",),
+                  delay_s=0.05)
+    )
+    client = RemoteEventBus(
+        host="127.0.0.1", port=primary.bound_port, naming=naming)
+    await client.connect()
+    try:
+        topic = naming.global_topic("t.stall")
+        for i in range(5):
+            await client.publish(topic, {"i": i})
+        await _wait_for(
+            lambda: standby.bus.peek(topic).get("depth", 0) == 5,
+            what="stalled replication to converge",
+        )
+    finally:
+        await client.close()
+        await repl.terminate()
+        await standby.terminate()
+        await primary.terminate()
+
+
+# ------------------------------------- fire-and-forget reconnect buffer
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def test_nowait_frames_buffered_and_flushed_on_reconnect(tmp_path):
+    naming = TopicNaming("ha")
+    broker = BusBrokerServer(bus=DurableEventBus(tmp_path / "b", naming))
+    await broker.initialize()
+    await broker.start()
+    port = broker.bound_port
+    topic = naming.global_topic("t.buf")
+    client = RemoteEventBus(
+        host="127.0.0.1", port=port, naming=naming,
+        reconnect_window_s=10.0,
+    )
+    await client.connect()
+    try:
+        await client.publish(topic, {"i": 0})
+        await broker.terminate()
+        client._mark_disconnected()
+        # fire-and-forget during the outage: buffered, not dropped
+        for i in range(1, 4):
+            client.publish_nowait(topic, {"i": i})
+        assert len(client._pending_nowait) == 3
+        assert client.metrics.gauge("netbus_nowait_buffered").value == 3
+
+        broker2 = BusBrokerServer(
+            bus=DurableEventBus(tmp_path / "b", naming), port=port)
+        await broker2.initialize()
+        await broker2.start()
+        try:
+            await client._ensure_connected()
+            assert not client._pending_nowait
+            assert client.metrics.gauge("netbus_nowait_buffered").value == 0
+            await _wait_for(
+                lambda: broker2.bus.peek(topic).get("depth", 0) == 4,
+                what="buffered frames to land",
+            )
+            assert client.metrics.counter(
+                "netbus_frames_lost_total", op="publish_nowait").value == 0
+        finally:
+            await broker2.terminate()
+    finally:
+        await client.close()
+
+
+async def test_nowait_buffer_overflow_drops_oldest_and_counts(tmp_path):
+    naming = TopicNaming("ha")
+    broker = BusBrokerServer(bus=DurableEventBus(tmp_path / "b", naming))
+    await broker.initialize()
+    await broker.start()
+    client = RemoteEventBus(
+        host="127.0.0.1", port=broker.bound_port, naming=naming)
+    await client.connect()
+    await broker.terminate()
+    client._mark_disconnected()
+    client.NOWAIT_BUFFER_MAX = 2  # instance override
+    topic = naming.global_topic("t.of")
+    for i in range(5):
+        client.publish_nowait(topic, {"i": i})
+    assert len(client._pending_nowait) == 2
+    assert client.metrics.counter(
+        "netbus_frames_lost_total", op="publish_nowait").value == 3
+    # frames still buffered at close are loss too — counted, not silent
+    await client.close()
+    assert client.metrics.counter(
+        "netbus_frames_lost_total", op="publish_nowait").value == 5
+    assert client.metrics.gauge("netbus_nowait_buffered").value == 0
+
+
+async def test_client_rotates_past_dead_endpoint_on_connect(tmp_path):
+    naming = TopicNaming("ha")
+    broker = BusBrokerServer(bus=DurableEventBus(tmp_path / "b", naming))
+    await broker.initialize()
+    await broker.start()
+    try:
+        client = RemoteEventBus(
+            endpoints=[("127.0.0.1", _free_port()),
+                       ("127.0.0.1", broker.bound_port)],
+            naming=naming, reconnect_window_s=10.0,
+        )
+        await client.connect()
+        assert client.port == broker.bound_port
+        topic = naming.global_topic("t.rot")
+        assert await client.publish(topic, {"i": 1}) == 0
+        assert client.metrics.counter(
+            "netbus_reconnects_total", outcome="error").value >= 1
+        await client.close()
+    finally:
+        await broker.terminate()
+
+
+# ------------------------------------------- supervisor grace (failover)
+class _StubLeaseBus:
+    """Minimal lease-plane surface for HostSupervisor unit tests."""
+
+    def __init__(self):
+        self.rows = {}
+        self.fenced = []
+
+    async def lease_table(self):
+        return {h: dict(r) for h, r in self.rows.items()}
+
+    async def lease_fence(self, host):
+        self.fenced.append(host)
+        return 99
+
+
+def _row(expires_in_s, fenced=False, epoch=1):
+    return {"epoch": epoch, "expires_in_s": expires_in_s,
+            "fenced": fenced, "health": {}}
+
+
+async def test_supervisor_grace_window_suppresses_expiry_verdicts():
+    """Broker failover is NOT host death: after a failed tick, the next
+    successful poll opens a grace window during which expiry evidence is
+    suppressed — fences (durable verdicts) still fire."""
+    bus = _StubLeaseBus()
+    placement = HostPlacement(4, 4)
+    placement.register_host("h0", [0, 1])
+    placement.register_host("h1", [2, 3])
+    reg = MetricsRegistry()
+    sup = HostSupervisor(bus, placement, metrics=reg, broker_grace_s=0.3)
+    bus.rows["h0"] = _row(4.0)
+    bus.rows["h1"] = _row(4.0)
+    assert await sup.poll_once() == []
+
+    # broker bounce: table unreadable for a tick, then back with a
+    # rehydrated (stale-looking) expiry on h0
+    sup.note_broker_unreachable()
+    assert reg.counter(
+        "host_supervisor_broker_unreachable_total").value == 1
+    bus.rows["h0"] = _row(-0.5)
+    assert await sup.poll_once() == []  # suppressed: inside grace
+    assert reg.counter("host_supervisor_grace_windows_total").value == 1
+    assert bus.fenced == []
+
+    # a FENCE during the window is still honored — it is a verdict
+    bus.rows["h1"] = _row(4.0, fenced=True)
+    verdicts = await sup.poll_once()
+    assert verdicts == [
+        {"host": "h1", "to": "suspect", "reason": "lease_expired"}
+    ]
+    assert bus.fenced == ["h1"]
+
+    # past the window, a still-expired lease is real evidence again
+    await asyncio.sleep(0.35)
+    verdicts = await sup.poll_once()
+    assert verdicts == [
+        {"host": "h0", "to": "suspect", "reason": "lease_expired"}
+    ]
+
+
+async def test_supervisor_expiry_fires_without_preceding_outage():
+    """No failed tick ⇒ no grace: plain expiry verdicts keep their old
+    latency (the grace window only arms after broker loss)."""
+    bus = _StubLeaseBus()
+    placement = HostPlacement(2, 2)
+    placement.register_host("h0", [0])
+    sup = HostSupervisor(bus, placement, broker_grace_s=5.0)
+    bus.rows["h0"] = _row(-0.1)
+    verdicts = await sup.poll_once()
+    assert verdicts == [
+        {"host": "h0", "to": "suspect", "reason": "lease_expired"}
+    ]
+
+
+# ------------------------------------------- DLQ requeue race (sat c)
+class _StubInstance:
+    def __init__(self, bus):
+        self.bus = bus
+        self.metrics = MetricsRegistry()
+
+
+async def test_dlq_requeue_commit_is_sync_and_counted():
+    """The DLQ → source-topic move is a sync commit section: republish
+    and counter land with no await between them, so a cancelled request
+    can't strand an entry between "polled off the DLQ" and "counted"."""
+    api = RestApi.__new__(RestApi)
+    api.instance = _StubInstance(EventBus(TopicNaming("rq"), 64))
+    entry = {"payload": {"x": 1, "_deadline": 123.0},
+             "stage": "persist", "source_topic": "t.src"}
+    assert await api._requeue_entry(None, entry) == 1
+    assert api.instance.bus.peek("t.src")["depth"] == 1
+    assert api.instance.metrics.counter(
+        "dlq.requeued_entries").value == 1
+    # re-admission strips the deadline stamp
+    assert "_deadline" not in entry["payload"]
+
+
+async def test_dlq_requeue_racing_broker_restart_rides_buffer(tmp_path):
+    """Satellite (c): a broker restart mid-requeue must not lose the
+    moved entry — the publish_nowait frame rides the client's bounded
+    reconnect buffer and flushes once the broker is back."""
+    naming = TopicNaming("rq")
+    broker = BusBrokerServer(bus=DurableEventBus(tmp_path / "b", naming))
+    await broker.initialize()
+    await broker.start()
+    port = broker.bound_port
+    client = RemoteEventBus(
+        host="127.0.0.1", port=port, naming=naming,
+        reconnect_window_s=10.0,
+    )
+    await client.connect()
+    api = RestApi.__new__(RestApi)
+    api.instance = _StubInstance(client)
+    try:
+        await broker.terminate()  # restart races the requeue
+        client._mark_disconnected()
+        entry = {"payload": {"x": 1}, "stage": "persist",
+                 "source_topic": "t.src"}
+        assert await api._requeue_entry(None, entry) == 1
+        assert api.instance.metrics.counter(
+            "dlq.requeued_entries").value == 1
+        assert len(client._pending_nowait) == 1
+
+        broker2 = BusBrokerServer(
+            bus=DurableEventBus(tmp_path / "b", naming), port=port)
+        await broker2.initialize()
+        await broker2.start()
+        try:
+            await client._ensure_connected()
+            await _wait_for(
+                lambda: broker2.bus.peek("t.src").get("depth", 0) == 1,
+                what="requeued entry to land after restart",
+            )
+        finally:
+            await broker2.terminate()
+    finally:
+        await client.close()

@@ -56,8 +56,8 @@ REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = registries.QUEUE_REGISTRY
 BOUNDED_RE = re.compile(
     r"(asyncio\.Queue\(\s*maxsize\s*=|PriorityClassQueue\(\s*maxsize\s*="
     r"|= _LaneRing\(|= _FrameRing\(|= _ReapQueue\(|= _ReplayRing\("
-    r"|= _ByteRing\(|= _TrainLaneRing\(|ThreadPoolExecutor\("
-    r"|\[_StagingSet\()"
+    r"|= _ByteRing\(|= _TrainLaneRing\(|= _ReplRing\("
+    r"|ThreadPoolExecutor\(|\[_StagingSet\()"
 )
 
 

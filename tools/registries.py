@@ -226,6 +226,25 @@ QUEUE_REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         # the NEXT flush at the semaphore (counted before the acquire)
         "backpressure_counter": "tpu_inference.deliver_backpressure",
     },
+    ("runtime/netbus.py", r"= _ReplRing\("): {
+        "queue": "broker replication ring (primary-side mutation tail — "
+                 "WAL appends, journaled cursors, lease + control ops — "
+                 "the warm standby drains via repl_poll long-polls)",
+        "depth_gauge": "netbus_repl_ring_depth",
+        # the ring sheds OLDEST when a standby lags past capacity; the
+        # evicted poller is told to resync from a full snapshot, so the
+        # shed is a forced resync, never silent record loss
+        "shed_counter": "netbus_repl_evicted_total",
+    },
+    ("runtime/netbus.py", r"_pending_nowait: deque = deque\(\)"): {
+        "queue": "client fire-and-forget reconnect buffer (bounded at "
+                 "NOWAIT_BUFFER_MAX; flushed in order on reconnect / "
+                 "failover; subscriptions replay separately via _subs)",
+        "depth_gauge": "netbus_nowait_buffered",
+        # overflow drops the OLDEST buffered frame, counted by op —
+        # bounded memory during an outage, loud loss accounting
+        "shed_counter": "netbus_frames_lost_total",
+    },
     ("pipeline/inference.py", r"\[_StagingSet\("): {
         "queue": "per-(family, mesh-slice, bucket) rotating flush "
                  "staging sets (bounded by staging_slots per rotation)",
@@ -334,7 +353,13 @@ BLOCKING_LEAVES: Dict[str, str] = {
     "runtime/dlog.py::SegmentWriter.append": "WAL append (flush+fsync)",
     "runtime/dlog.py::SegmentWriter.close": "WAL close (flush+fsync)",
     "runtime/dlog.py::OffsetsJournal.record": "cursor journal write",
-    "runtime/dlog.py::OffsetsJournal.compact": "cursor journal rewrite+fsync",
+    # the shared frame-journal base (cursor + lease journals): per-frame
+    # flush and the threshold-triggered snapshot rewrite+fsync
+    "runtime/dlog.py::FrameJournal._write": "journal frame write (flush)",
+    "runtime/dlog.py::FrameJournal.compact": "journal rewrite+fsync",
+    # broker generation file: fsync + atomic replace on promotion/fence
+    "runtime/netbus.py::BrokerGeneration._persist":
+        "broker generation fsync+replace",
 }
 
 # Rule 3a (cancellation-atomicity) commit sections: between the ``begin``
@@ -393,6 +418,59 @@ COMMIT_SECTIONS: Dict[str, List[Dict[str, str]]] = {
             "function": "HostSupervisor._commit_fence_lift",
             "name": "cross-host fence lift → accounting",
             "begin": "lift_fences",
+            "end": "inc",
+        },
+    ],
+    "runtime/netbus.py": [
+        {
+            # standby → primary takeover: durable generation bump, role
+            # flip, and lease grace extension must land as one step — a
+            # cancellation between them yields a primary serving
+            # un-graced leases (mass host expiry) or a standby whose
+            # generation already outranks the fleet
+            "function": "BusBrokerServer._commit_promotion",
+            "name": "promotion (generation bump → role flip → lease grace)",
+            "begin": "bump_to",
+            "end": "inc",
+        },
+        {
+            # zombie self-fence: the durable fence and its counter land
+            # together, so a fenced broker is never un-counted (or a
+            # counted broker un-fenced) across a cancellation
+            "function": "BusBrokerServer._commit_fence_generation",
+            "name": "generation fence → accounting",
+            "begin": "fence",
+            "end": "inc",
+        },
+        {
+            # replication batch apply: records apply in ring order and
+            # the applied-seq watermark moves with them — an await in
+            # between lets a cancellation strand the watermark past
+            # records that never applied (silent standby divergence)
+            "function": "StandbyReplicator._commit_records",
+            "name": "replication apply → watermark advance",
+            "begin": "_apply_record",
+            "end": "inc",
+        },
+        {
+            # snapshot resync: logs, cursors, lease table, and the
+            # watermark move to the snapshot as ONE unit
+            "function": "StandbyReplicator._commit_snapshot",
+            "name": "resync snapshot apply → watermark reset",
+            "begin": "restore_state",
+            "end": "inc",
+        },
+    ],
+    "api/rest.py": [
+        {
+            # DLQ → source-topic move: republish and requeue accounting
+            # land together, so a client disconnect cancelling the
+            # requeue request (or a broker restart racing it) cannot
+            # strand an entry between "taken from the DLQ poll" and
+            # "counted as requeued"
+            "function": "RestApi._commit_requeue",
+            "name": "DLQ requeue move (republish → accounting)",
+            "begin": "publish_nowait",
             "end": "inc",
         },
     ],

@@ -21,6 +21,13 @@
 #     netbus-partition runs over a shared durable broker — zero event
 #     loss, per-tenant FIFO across adoption, zombie-epoch writes fenced,
 #     tenants rebalanced home after probation)
+#   BROKER_ONLY=1 tools/run_chaos.sh     # just the BROKER-fault suite
+#     (tests/test_broker_chaos.py: kill -9 the PRIMARY broker mid-
+#     traffic — WAL-streaming warm standby promotes at a fresh durable
+#     generation, clients fail over and accounting closes to zero loss
+#     with no spurious host adoption; restart the old primary as a
+#     zombie — generation gossip fences it durably and its appends are
+#     counted + diverted, never double-served)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # preflight: the sub-second pure-AST lint suite (docs/STATIC_ANALYSIS.md)
@@ -39,6 +46,10 @@ if [[ "${MESH_ONLY:-}" == "1" ]]; then
 fi
 if [[ "${HOST_ONLY:-}" == "1" ]]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_host_chaos.py \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+fi
+if [[ "${BROKER_ONLY:-}" == "1" ]]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_broker_chaos.py \
         -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
