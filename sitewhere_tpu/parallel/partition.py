@@ -123,6 +123,38 @@ def stacked_specs(rules: Sequence[Tuple[str, P]], tree, mesh: Mesh):
     return named_tree_map(stack_one, tree)
 
 
+def unstacked_specs(rules: Sequence[Tuple[str, P]], tree, mesh: Mesh):
+    """Placement for ONE slot's UNSTACKED tree on a slice mesh: the
+    :func:`stacked_specs` logic minus the tenant-axis prepend. This is
+    the weight-paging staging surface (``ShardedScorer
+    .stage_slot_params``): a page-in ``device_put``s one tenant's param
+    tree onto these shardings asynchronously — double-buffered like
+    ``stage_inputs`` — so ``set_slot`` consumes already-device-resident
+    leaves instead of blocking activation on the h2d copy. Same
+    degradation guard: a named axis survives only when the mesh has it
+    with size > 1 AND it divides the dim it shards."""
+    mesh_shape = dict(mesh.shape)
+
+    def keeps(axis, dim: int) -> bool:
+        return (
+            axis is not None
+            and mesh_shape.get(axis, 1) > 1
+            and dim % mesh_shape[axis] == 0
+        )
+
+    def one(name: str, leaf) -> P:
+        leaf_shape = tuple(getattr(leaf, "shape", None) or np.shape(leaf))
+        if len(leaf_shape) == 0 or int(np.prod(leaf_shape)) == 1:
+            return P()
+        base = tuple(_first_match(rules, name))
+        base = base[: len(leaf_shape)] + (None,) * (len(leaf_shape) - len(base))
+        return P(*(
+            ax if keeps(ax, d) else None for ax, d in zip(base, leaf_shape)
+        ))
+
+    return named_tree_map(one, tree)
+
+
 def make_shard_and_gather_fns(mesh: Mesh, specs):
     """Per-leaf (shard, gather) callables from a spec pytree — the
     SNIPPETS [2][3] surface. ``shard_fns`` place host/replicated arrays

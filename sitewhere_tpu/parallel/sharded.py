@@ -295,6 +295,9 @@ class ShardedScorer:
         # these so the jit never reshards and the h2d copy can overlap a
         # previous flush's dispatch
         self._wire_sharding = mm.sharding(AXIS_TENANT, AXIS_DATA)
+        # lazy per-slot (unstacked) shard fns for weight paging's
+        # stage_slot_params — most scorers never page and must not pay
+        self._slot_shard_fns = None
 
     # -- fused kernel param view -----------------------------------------
     def _invalidate_kernel(self) -> None:
@@ -817,6 +820,61 @@ class ShardedScorer:
     def slot_params(self, global_slot: int) -> Params:
         return unstack_slot(self.params, global_slot)
 
+    # -- weight paging (runtime.paging / docs/PERFORMANCE.md) ------------
+    def stage_slot_params(self, params: Params) -> Params:
+        """Asynchronously stage ONE tenant's unstacked param tree onto
+        the slice mesh ahead of ``activate`` — the ``stage_inputs``
+        double-buffer pattern applied to weights: ``device_put`` returns
+        with the h2d copy in flight, so a page-in's transfer overlaps
+        the previous flush's dispatch and ``set_slot`` consumes
+        already-device-resident leaves instead of blocking the
+        activation (and the flush critical path) on the copy. Specs are
+        the partition rules matched WITHOUT the tenant-axis prepend
+        (parallel.partition.unstacked_specs)."""
+        if self._slot_shard_fns is None:
+            from sitewhere_tpu.parallel.partition import (
+                make_shard_and_gather_fns,
+                unstacked_specs,
+            )
+
+            specs = unstacked_specs(
+                self.partition_rules, self._base_params, self.mm.mesh
+            )
+            self._slot_shard_fns, _ = make_shard_and_gather_fns(
+                self.mm.mesh, specs
+            )
+        from sitewhere_tpu.parallel.partition import shard_tree
+
+        return shard_tree(params, self._slot_shard_fns)
+
+    def slot_opt_state(self, global_slot: int):
+        """One slot's optimizer state as COPIED host numpy (None while
+        no optimizer is attached). Must run ON THE EVENT-LOOP THREAD:
+        train steps donate the stacked opt buffer, so a worker-thread
+        zero-copy view would be the same use-after-free
+        ``checkpoint.host_copy_params`` guards params against."""
+        if getattr(self, "_opt_state", None) is None:
+            return None
+        import numpy as np
+
+        return jax.tree_util.tree_map(
+            lambda x: np.array(x[global_slot], copy=True), self._opt_state
+        )
+
+    def restore_slot_opt(self, global_slot: int, opt) -> None:
+        """Write one slot's saved optimizer moments back after a
+        page-in, so a train-lane tenant resumes mid-descent instead of
+        restarting Adam cold. No-op when either side has no optimizer
+        state (the family-pinned optimizer is identical across slices,
+        so saved/live tree structures always match)."""
+        if opt is None or getattr(self, "_opt_state", None) is None:
+            return
+        self._opt_state = jax.tree_util.tree_map(
+            lambda s, o: s.at[global_slot].set(jnp.asarray(o).astype(s.dtype)),
+            self._opt_state,
+            opt,
+        )
+
     def rebuild_runtime(self) -> None:
         """Recover from a poisoned device runtime: re-materialize params
         host-side if they still answer (else pristine), allocate FRESH
@@ -883,6 +941,7 @@ class ShardedScorer:
         self._shadow_step_fn = None  # rebuilt lazily on next canary flush
         self.last_sketch = None      # may reference dead buffers
         self._wire_sharding = self.mm.sharding(AXIS_TENANT, AXIS_DATA)
+        self._slot_shard_fns = None  # rebuilt lazily on next page-in
         if getattr(self, "_optimizer", None) is not None:
             from sitewhere_tpu.parallel.partition import (
                 make_shard_and_gather_fns,

@@ -77,7 +77,11 @@ from sitewhere_tpu.core.events import DeviceMeasurement
 from sitewhere_tpu.models import get_model, make_config
 from sitewhere_tpu.parallel.mesh import MeshManager
 from sitewhere_tpu.parallel.sharded import ShardedScorer
-from sitewhere_tpu.parallel.tenant_router import TenantRouter
+from sitewhere_tpu.parallel.tenant_router import (
+    PlacementError,
+    TenantPlacement,
+    TenantRouter,
+)
 from sitewhere_tpu.runtime.bus import (
     CircuitBreaker,
     EventBus,
@@ -576,12 +580,18 @@ class _SliceFence:
     ``_LaneRing`` per data shard, counted against the tenant's lane
     watermark so a long fence backpressures into the bus); the scoring
     loop lifts the fence when the snapshot drains and pushes the stash
-    into the new slice's lanes in arrival order."""
+    into the new slice's lanes in arrival order.
+
+    Weight paging reuses the same machinery with ``new_sl=None``: a
+    NON-RESIDENT tenant's fence has no landing target yet (its weights
+    live host-side as encoded bytes), so rows park indefinitely —
+    ``_lift_fences`` skips target-less fences — until a page-in
+    activates the tenant and retargets the fence at its new slot."""
 
     __slots__ = ("tenant", "family", "pending", "stash", "new_sl", "new_slot")
 
     def __init__(self, tenant: str, family: str, pending: List[_PendingFlush],
-                 new_sl: int, new_slot: int) -> None:
+                 new_sl: Optional[int], new_slot: Optional[int]) -> None:
         self.tenant = tenant
         self.family = family
         self.pending = pending        # old-slice flushes to outwait
@@ -614,7 +624,22 @@ class TpuInferenceEngine(TenantEngine):
 
     async def on_start(self) -> None:
         svc = self.service
-        self.placement = svc.router.place(self.tenant, family=self.config.model)
+        try:
+            self.placement = svc.router.place(
+                self.tenant, family=self.config.model
+            )
+        except PlacementError:
+            if svc.pager is None:
+                raise
+            # family at physical capacity and weight paging is on: the
+            # tenant starts NON-RESIDENT (virtualized slot). Its ghost
+            # placement points at a real slice (for scorer/lane lookups)
+            # with slot -1 = no device slot held; arriving rows park
+            # behind a paging fence and the first demand (or a rising-lag
+            # prefetch) pages it in, evicting the LRU victim.
+            self.placement = svc._ghost_placement(
+                self.tenant, self.config.model
+            )
         # the tenant's scorer is its mesh SLICE's scorer: one compiled
         # step per (family, tenant-axis slice), dispatching only to that
         # slice's devices (docs/PERFORMANCE.md "Multi-chip serving")
@@ -647,37 +672,55 @@ class TpuInferenceEngine(TenantEngine):
         # fair-queue registration: this tenant's intake is rationed by
         # its OverloadPolicy weight from the first poll
         svc.fair.configure(self.tenant, self.config.overload.weight)
-        params = None
-        if svc.checkpoints is not None:
-            # resume this tenant's trained weights (possibly onto a
-            # DIFFERENT slot/shard than before — mesh re-placement)
-            params = await asyncio.get_running_loop().run_in_executor(
-                None, svc.checkpoints.load_params,
-                self.tenant, self.config.model,
+        if self.placement.slot >= 0:
+            params = None
+            if svc.checkpoints is not None:
+                # resume this tenant's trained weights (possibly onto a
+                # DIFFERENT slot/shard than before — mesh re-placement)
+                params = await asyncio.get_running_loop().run_in_executor(
+                    None, svc.checkpoints.load_params,
+                    self.tenant, self.config.model,
+                )
+            scorer.activate(
+                self.placement.slot, params=params,
+                trainable=self.config.training.enabled,
+                lr=self.config.training.lr,
             )
-        scorer.activate(
-            self.placement.slot, params=params,
-            trainable=self.config.training.enabled,
-            lr=self.config.training.lr,
-        )
-        # score-health registration: bind this tenant to its stacked slot
-        # so the resolve path can attribute device sketches, and start a
-        # FRESH drift baseline — an engine (re)start activates params
-        # explicitly, so the reference must re-learn the current model's
-        # output distribution (docs/OBSERVABILITY.md "re-baseline")
-        svc.scorehealth.register(
-            self.tenant, self.config.model,
-            self.placement.slot,
-            getattr(scorer, "sketch_edges", []),
-            mesh_slice=self.placement.shard,
-            variant={
-                "fused": bool(getattr(scorer, "fused", False)),
-                "k_steps": int(getattr(scorer, "k_steps", 1)),
-                "param_dtype": getattr(scorer, "param_dtype", "f32"),
-                "wire_dtype": getattr(scorer, "wire_dtype", "f32"),
-            },
-        )
-        svc.scorehealth.rebaseline(self.tenant)
+            # score-health registration: bind this tenant to its stacked
+            # slot so the resolve path can attribute device sketches, and
+            # start a FRESH drift baseline — an engine (re)start activates
+            # params explicitly, so the reference must re-learn the current
+            # model's output distribution (docs/OBSERVABILITY.md
+            # "re-baseline")
+            svc.scorehealth.register(
+                self.tenant, self.config.model,
+                self.placement.slot,
+                getattr(scorer, "sketch_edges", []),
+                mesh_slice=self.placement.shard,
+                variant={
+                    "fused": bool(getattr(scorer, "fused", False)),
+                    "k_steps": int(getattr(scorer, "k_steps", 1)),
+                    "param_dtype": getattr(scorer, "param_dtype", "f32"),
+                    "wire_dtype": getattr(scorer, "wire_dtype", "f32"),
+                },
+            )
+            svc.scorehealth.rebaseline(self.tenant)
+            if svc.pager is not None:
+                # residency ledger: this tenant holds a physical slot —
+                # it is an LRU eviction candidate from now on
+                svc.pager.slice_pager(
+                    self.config.model, self.placement.shard,
+                    svc.slots_per_shard,
+                ).note_resident(self.tenant, self.placement.slot)
+        else:
+            # NON-RESIDENT start: no device work at all. Install the
+            # paging fence so rows arriving before the first page-in
+            # park (counted against the lane watermark → backpressure)
+            # instead of landing in a slot the tenant doesn't hold.
+            svc._install_paging_fence(self)
+            svc.metrics.counter(
+                "tpu_paging.virtual_starts", family=self.config.model
+            ).inc()
         # a tenant lifecycle event is the unpark signal for its family —
         # and clears the family breaker's failure history with it
         svc._parked.discard(self.config.model)
@@ -698,7 +741,7 @@ class TpuInferenceEngine(TenantEngine):
             sl = self.placement.shard
             slot = self.placement.slot
             scorer = svc.scorers.get((self.config.model, sl))
-            if scorer is not None and svc.checkpoints is not None:
+            if slot >= 0 and scorer is not None and svc.checkpoints is not None:
                 # save this tenant's (possibly trained) weights BEFORE the
                 # slot wipe below destroys them. Materialize to numpy ON
                 # THIS (loop) thread: the reset_slot below DONATES the
@@ -711,10 +754,34 @@ class TpuInferenceEngine(TenantEngine):
                     None, svc.checkpoints.save_params,
                     self.tenant, self.config.model, params,
                 )
-            if scorer is not None:
+            if slot >= 0 and scorer is not None:
                 # full wipe: a recycled slot must not leak this tenant's
                 # window history or params to the next occupant
                 scorer.reset_slot(slot)
+            if slot < 0 and svc.pager is not None:
+                # PAGED-OUT tenant leaving: its only durable state is the
+                # host-side segment blob — persist it iff dirty (train-lane
+                # tenants mutate weights between page-outs) so the cached
+                # training progress survives the engine teardown
+                blob = svc.pager.cache.get(self.tenant)
+                if (
+                    blob is not None
+                    and blob[1]
+                    and svc.checkpoints is not None
+                ):
+                    from sitewhere_tpu.runtime.checkpoint import (
+                        decode_segment,
+                    )
+
+                    def _persist(data=blob[0]):
+                        p, _opt = decode_segment(data)
+                        svc.checkpoints.save_params(
+                            self.tenant, self.config.model, p
+                        )
+
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, _persist
+                    )
             # drain pending lanes keyed by the freed slot: the bus cursor
             # already advanced past these rows, so dropping them would lose
             # them from the store on every tenant restart — resolve them
@@ -774,6 +841,10 @@ class TpuInferenceEngine(TenantEngine):
                 )
             svc.router.remove(self.tenant)
             self.placement = None
+        if svc.pager is not None:
+            # drop every paging artifact (cached blob, queued page-in,
+            # residency entry) — a restarted tenant begins cold
+            svc.pager.forget(self.tenant)
         svc.fair.remove(self.tenant)
         svc.scorehealth.remove(self.tenant)
         # bounded label cardinality: the per-tenant train-lane ledger
@@ -1012,6 +1083,45 @@ class TpuInferenceService(MultitenantService):
             "slice) — the flush supervisor's deadline source, surfaced "
             "live for the latency waterfall",
         )
+        # -- weight paging (runtime.paging; docs/PERFORMANCE.md "Weight
+        # paging") -------------------------------------------------------
+        # virtualized slots: tenants beyond a family's physical capacity
+        # get a GHOST placement (slot=-1) and page in on demand/prefetch.
+        # The kill switch is captured HERE, at build (FUSED_STEP_ENABLED
+        # pattern): flip runtime.paging.WEIGHT_PAGING_ENABLED to False
+        # before construction and pager is None — every hook below is
+        # guarded on it, restoring physical-slot semantics bitwise.
+        from sitewhere_tpu.runtime import paging as _paging
+
+        self.paging_enabled = bool(_paging.WEIGHT_PAGING_ENABLED)
+        self.pager = (
+            _paging.WeightPager(self.metrics) if self.paging_enabled else None
+        )
+        # ≤ 1 page-in in flight: activation serializes device mutation
+        # (set_slot donates the stacked buffer) exactly like failover
+        self._pagein_task: Optional[asyncio.Task] = None
+        self._paging_next_prefetch = 0.0
+        self.metrics.describe(
+            "tpu_paging.page_ins",
+            "tenant activations from the host byte cache / checkpoint "
+            "store per family and origin (demand|prefetch)",
+        )
+        self.metrics.describe(
+            "tpu_paging.page_outs",
+            "resident tenants evicted to the host byte cache per family "
+            "(LRU weighted by OverloadController traffic)",
+        )
+        self.metrics.describe(
+            "tpu_paging.train_rows_dropped",
+            "pending train-lane rows dropped at page-out per family — "
+            "replayed history the store still holds (PR 12 round-4 rule)",
+        )
+        self.metrics.describe(
+            "tpu_paging.stalled",
+            "page-in attempts that found no evictable victim (every "
+            "resident pinned/fenced/quarantined) — the request re-queues "
+            "on the next demand touch",
+        )
 
     @property
     def group(self) -> str:
@@ -1215,6 +1325,16 @@ class TpuInferenceService(MultitenantService):
         if getattr(self, "_loop_super", None) is not None:
             await self._loop_super.terminate()
             self._loop_super = None
+        # an in-flight page-in dies with the loop that launched it; its
+        # tenant's parked rows resolve unscored in the fence sweep below
+        task = getattr(self, "_pagein_task", None)
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._pagein_task = None
         # let in-flight transfers land and resolve through the reaper
         # (they hold rows already popped from lanes — dropping them would
         # lose events); only give up if the device never answers
@@ -1317,9 +1437,28 @@ class TpuInferenceService(MultitenantService):
         so the TPU budget shrinks without breaking accounting."""
         family = engine.config.model
         sl = engine.placement.shard
-        lanes = self._lanes[(family, sl)]
+        # setdefault: a GHOST (paged-out) tenant's slice may not have
+        # served yet — its rows only ever park behind the paging fence
+        lanes = self._lanes.setdefault((family, sl), {})
         slot = engine.placement.slot
         fence = self._fences.get(engine.tenant)
+        if self.pager is not None:
+            if slot >= 0:
+                # resident: LRU refresh + hit-rate / prefetch-accuracy
+                # bookkeeping (pure dict ops — stays off check_hotpath's
+                # forbidden list)
+                self.pager.slice_pager(
+                    family, sl, self.slots_per_shard
+                ).touch(engine.tenant)
+                self.pager.note_touch(engine.tenant, True)
+            else:
+                # non-resident: rows park behind the paging fence below;
+                # queue a DEMAND page-in (always admitted — parked rows
+                # must never strand behind an unserviceable fence)
+                self.pager.note_touch(engine.tenant, False)
+                self.pager.queue.push(
+                    engine.tenant, "demand", time.monotonic()
+                )
         n = batch.n
         if batch.scores is None:
             batch.scores = np.full((n,), np.nan, np.float32)
@@ -1381,6 +1520,12 @@ class TpuInferenceService(MultitenantService):
         if fence is not None:
             if parked:
                 self.metrics.counter("tpu_inference.fenced_rows").inc(parked)
+                if fence.new_sl is None and "paged" not in batch.trace:
+                    # cold-start activation SLO (docs/OBSERVABILITY.md):
+                    # the batch waited on a page-in — its parked time
+                    # folds into lane_wait in the stage ledger, and this
+                    # mark keys it out of the hot-path latency columns
+                    batch.mark("paged")
             return
         if (family, sl) not in self._first_pending_ts:
             self._first_pending_ts[(family, sl)] = time.monotonic()
@@ -2515,6 +2660,20 @@ class TpuInferenceService(MultitenantService):
                 and engine.config.model == family
                 and engine.placement.shard == sl
             ):
+                if engine.placement.slot < 0:
+                    # paged-out tenant on the quarantined slice: its
+                    # weights are host-side encoded bytes — failing over
+                    # means re-pointing the ghost at a healthy slice, NO
+                    # device touch (router.quarantine above already
+                    # steers the eventual page-in's place() call)
+                    engine.placement = self._ghost_placement(
+                        engine.tenant, family
+                    )
+                    self.metrics.counter(
+                        "tpu_paging.quarantine_ghosts", family=family
+                    ).inc()
+                    moved += 1
+                    continue
                 if await self._failover_tenant(engine):
                     moved += 1
                 else:
@@ -2886,6 +3045,11 @@ class TpuInferenceService(MultitenantService):
         is in flight)."""
         for tenant in list(self._fences):
             fence = self._fences[tenant]
+            if fence.new_sl is None:
+                # paging fence: the tenant is non-resident — rows stay
+                # parked until a page-in retargets the fence at the
+                # landed (slice, slot); only _page_in lifts it
+                continue
             if not fence.ready():
                 continue
             del self._fences[tenant]
@@ -2925,6 +3089,316 @@ class TpuInferenceService(MultitenantService):
             applied += 1
             self.metrics.counter("tpu_inference.rebalanced").inc()
         return applied
+
+    # -- weight paging (runtime.paging; docs/PERFORMANCE.md) ---------------
+    def _ghost_placement(
+        self, tenant: str, family: str
+    ) -> TenantPlacement:
+        """A slot=-1 placement for a non-resident tenant: the shard is
+        a real serving slice of the family (preferring healthy ones) so
+        stream→data-shard routing and fence parking have a home, but no
+        physical slot is held — a page-in claims one later."""
+        slices = sorted(s for (f, s) in self.scorers if f == family)
+        avoid = self.router.quarantined(family)
+        healthy = [s for s in slices if s not in avoid]
+        shard = (healthy or slices or [0])[0]
+        return TenantPlacement(tenant, family, shard, -1)
+
+    def _install_paging_fence(self, engine: "TpuInferenceEngine") -> None:
+        """Park every row a non-resident tenant receives: a paging
+        fence (``new_sl=None``) with an EMPTY old-slice snapshot —
+        nothing gates it but the page-in that retargets it at the
+        landed (slice, slot). Parked depth counts against the lane
+        watermark, so a long page-in backpressures intake into the bus
+        instead of buffering unboundedly host-side."""
+        if engine.tenant in self._fences:
+            return
+        self._fences[engine.tenant] = _SliceFence(
+            engine.tenant, engine.config.model, [], None, None
+        )
+        self.metrics.gauge("tpu_inference_fences").set(len(self._fences))
+
+    def _page_out(self, engine: "TpuInferenceEngine") -> None:
+        """Evict one RESIDENT tenant to the host byte cache and leave a
+        ghost placement behind. Synchronous on the event loop — the
+        whole evict→write-back→commit runs without an await, so no
+        flush can interleave with a half-freed slot (the commit section
+        tools/check_commit.py guards: ``host_copy_params`` …
+        ``commit_page_out``)."""
+        from sitewhere_tpu.runtime.checkpoint import (
+            encode_segment, host_copy_params,
+        )
+
+        p = engine.placement
+        tenant = engine.tenant
+        family = engine.config.model
+        scorer = self.scorers[(family, p.shard)]
+        trainable = bool(engine.config.training.enabled)
+        cached = self.pager.cache.get(tenant)
+        if not trainable and cached is not None:
+            # clean write-back elided: a non-trainable tenant's weights
+            # cannot have diverged from the blob its last page-in used
+            blob, dirty = cached[0], False
+        else:
+            # materialize on THIS (loop) thread: reset_slot below
+            # donates the stacked buffers (see host_copy_params)
+            params = host_copy_params(scorer.slot_params(p.slot))
+            opt = scorer.slot_opt_state(p.slot)
+            blob = encode_segment(params, opt)
+            dirty = trainable
+        scorer.reset_slot(p.slot)
+        # pending TRAIN rows are droppable history (the store re-feeds —
+        # PR 12 round-4 rule), but COUNTED: a paging storm that starves
+        # training must be visible
+        tl = self._train_lanes.get((family, p.shard))
+        if tl is not None:
+            dropped = 0
+            for key in [k for k in tl if k[0] == p.slot]:
+                dropped += tl.pop(key).count
+            if dropped:
+                self.metrics.counter(
+                    "tpu_paging.train_rows_dropped", family=family
+                ).inc(dropped)
+            self._train_rows_gauge(family, p.shard)
+        self._train_ticks.get((family, p.shard), {}).pop(p.slot, None)
+        # serve rows still pending re-park behind a paging fence, FIFO
+        # behind the old slice's in-flight flushes — the same ordering
+        # machinery as a failover move, targetless until the next
+        # page-in lands
+        fence = self._fences.get(tenant)
+        if fence is None:
+            fence = self._fences[tenant] = _SliceFence(
+                tenant, family,
+                list(self._reap.get((family, p.shard), ())), None, None,
+            )
+            self.metrics.gauge(
+                "tpu_inference_fences"
+            ).set(len(self._fences))
+        else:
+            fence.new_sl, fence.new_slot = None, None
+        lanes = self._lanes.get((family, p.shard), {})
+        for d in range(self.mm.n_data_shards):
+            lane = lanes.pop((p.slot, d), None)
+            if lane is not None and lane.count:
+                li, lv, ls, lr = lane.pop(lane.count)
+                fence.park(d, li, lv, ls, lr)
+                # eviction raced these batches' rows: key them out of the
+                # hot-path latency columns like any fence-parked arrival
+                for seq in np.unique(ls):
+                    entry = self._batches.get(int(seq))
+                    if entry is not None and "paged" not in entry[0].trace:
+                        entry[0].mark("paged")
+        # score-health: free the slot binding WITHOUT touching the
+        # frozen reference or PSI window history — they survive
+        # residency gaps exactly like failover re-maps
+        self.scorehealth.unbind_slot(tenant)
+        self.router.remove(tenant)
+        engine.placement = TenantPlacement(
+            tenant, family, p.shard, -1, generation=p.generation + 1
+        )
+        self.pager.slice_pager(
+            family, p.shard, self.slots_per_shard
+        ).drop(tenant)
+        self.pager.cache.commit_page_out(tenant, blob, dirty)
+        self.metrics.counter("tpu_paging.page_outs", family=family).inc()
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "paging", family, paged=True, event="page_out",
+                tenant=tenant, mesh_slice=p.shard, slot=p.slot,
+                dirty=dirty,
+            )
+
+    def _pick_victim(
+        self, family: str
+    ) -> Optional["TpuInferenceEngine"]:
+        """The cheapest resident tenant of ``family`` to evict: LRU
+        weighted by the OverloadController's live traffic signal.
+        Pinned, fenced (mid-move), quarantined-slice, and already-ghost
+        tenants are exempt. Tenants with rows already packed in serve
+        lanes rank BEHIND row-free ones regardless of LRU score:
+        evicting them parks those rows behind the paging fence for a
+        full page-out/page-in cycle — hot-path latency spent on a tenant
+        that is demonstrably still serving (used only when every
+        candidate has pending rows: a demand page-in must not stall)."""
+        if self.overload is not None:
+            traffic = self.overload.tenant_lag
+        else:
+            def traffic(_t: str) -> float:
+                return 0.0
+        now = time.monotonic()
+        best = busy_best = None
+        best_score = busy_score = -1.0
+        for (fam, sl), pager in self.pager.pagers.items():
+            if fam != family or (fam, sl) in self._quarantined:
+                continue
+            lanes = self._lanes.get((fam, sl), {})
+            for tenant in pager.residents():
+                if tenant in pager.pinned or tenant in self._fences:
+                    continue
+                eng = self.engines.get(tenant)
+                if (
+                    not isinstance(eng, TpuInferenceEngine)
+                    or eng.state is not LifecycleState.STARTED
+                    or eng.placement is None
+                    or eng.placement.slot < 0
+                ):
+                    continue
+                score = pager.eviction_score(tenant, traffic, now)
+                slot = eng.placement.slot
+                pending = any(
+                    ring.count for (s, _d), ring in lanes.items()
+                    if s == slot
+                )
+                if pending:
+                    if score > busy_score:
+                        busy_score, busy_best = score, eng
+                elif score > best_score:
+                    best_score, best = score, eng
+        return best if best is not None else busy_best
+
+    async def _page_in(
+        self, tenant: str, origin: str, t_req: float
+    ) -> None:
+        """Activate one non-resident tenant: claim a slot (evicting the
+        LRU victim if the family is at physical capacity), stage its
+        cached params asynchronously onto the slice's shardings
+        (``stage_slot_params`` — the stage_inputs double-buffer pattern
+        for weights), then activate + restore opt state and retarget
+        the paging fence so parked rows drain FIFO into the new slot."""
+        engine = self.engines.get(tenant)
+        if (
+            not isinstance(engine, TpuInferenceEngine)
+            or engine.state is not LifecycleState.STARTED
+            or engine.placement is None
+            or engine.placement.slot >= 0
+        ):
+            return  # stopped / already resident: request is stale
+        family = engine.config.model
+        try:
+            new_p = self.router.place(tenant, family=family)
+        except PlacementError:
+            victim = self._pick_victim(family)
+            if victim is None:
+                # every resident is pinned/fenced/quarantined — the
+                # request re-queues on the tenant's next demand touch
+                self.metrics.counter(
+                    "tpu_paging.stalled", family=family
+                ).inc()
+                return
+            self._page_out(victim)
+            new_p = self.router.place(tenant, family=family)
+        scorer = self.scorer_for_slice(family, new_p.shard, engine.config)
+        loop = asyncio.get_running_loop()
+        params = opt = None
+        entry = self.pager.cache.get(tenant)
+        if entry is not None:
+            from sitewhere_tpu.runtime.checkpoint import decode_segment
+
+            params, opt = await loop.run_in_executor(
+                None, decode_segment, entry[0]
+            )
+        elif self.checkpoints is not None:
+            params = await loop.run_in_executor(
+                None, self.checkpoints.load_params, tenant, family
+            )
+        staged = (
+            scorer.stage_slot_params(params) if params is not None else None
+        )
+        if (
+            self.engines.get(tenant) is not engine
+            or engine.state is not LifecycleState.STARTED
+            or engine.placement is None
+            or engine.placement.slot >= 0
+        ):
+            # the tenant stopped (or somehow activated) during the
+            # decode/stage awaits: release the slot we claimed
+            self.router.remove(tenant)
+            return
+        scorer.activate(
+            new_p.slot, params=staged,
+            trainable=engine.config.training.enabled,
+            lr=engine.config.training.lr,
+        )
+        scorer.restore_slot_opt(new_p.slot, opt)
+        engine.placement = new_p
+        # slot re-map only: same-family register keeps the frozen drift
+        # reference and PSI window history — NO rebaseline (the whole
+        # point of surviving page-out like a failover re-map)
+        self.scorehealth.register(
+            tenant, family, new_p.slot,
+            getattr(scorer, "sketch_edges", []),
+            mesh_slice=new_p.shard,
+        )
+        self.pager.slice_pager(
+            family, new_p.shard, self.slots_per_shard
+        ).note_resident(tenant, new_p.slot)
+        fence = self._fences.get(tenant)
+        if fence is not None and fence.new_sl is None:
+            # retarget: _lift_fences releases it once the snapshot (if
+            # any) resolves, draining parked rows FIFO into the slot
+            fence.new_sl, fence.new_slot = new_p.shard, new_p.slot
+        wait_ms = (time.monotonic() - t_req) * 1e3
+        self.metrics.histogram(
+            "tenant_activation_ms", unit="ms", family=family
+        ).record(wait_ms)
+        self.pager.note_activation(tenant, wait_ms, origin)
+        self.metrics.counter(
+            "tpu_paging.page_ins", family=family, origin=origin
+        ).inc()
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "paging", family, paged=True, event="page_in",
+                tenant=tenant, origin=origin,
+                wait_ms=round(wait_ms, 3),
+                mesh_slice=new_p.shard, slot=new_p.slot,
+            )
+
+    def _paging_tick(self) -> None:
+        """One scoring-loop pass of paging work: (a) queue prefetches
+        for ghost tenants whose bus lag is RISING (the
+        OverloadController's lag_prev comparison — pressure building
+        before any row is consumed), (b) re-demand tenants whose paging
+        fence holds parked rows — rows parked at EVICTION time precede
+        any future arrival, so without this they'd strand until the
+        tenant happens to get new traffic (arrival-side demand pushes
+        only fire in ``_enqueue_batch``), (c) launch at most ONE page-in
+        task (activation mutates the stacked buffers; serializing keeps
+        it off the flush critical path and race-free)."""
+        now = time.monotonic()
+        if self.overload is not None and now >= self._paging_next_prefetch:
+            self._paging_next_prefetch = now + 0.25
+            for tenant in self.overload.rising_tenants():
+                eng = self.engines.get(tenant)
+                if (
+                    isinstance(eng, TpuInferenceEngine)
+                    and eng.state is LifecycleState.STARTED
+                    and eng.placement is not None
+                    and eng.placement.slot < 0
+                ):
+                    self.pager.queue.push(tenant, "prefetch", now)
+        for tenant, fence in self._fences.items():
+            if fence.new_sl is None and fence.depth():
+                self.pager.queue.push(tenant, "demand", now)
+        task = self._pagein_task
+        if task is not None and not task.done():
+            return
+        self._pagein_task = None
+        req = self.pager.queue.pop()
+        if req is None:
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._page_in(*req)
+        )
+        self._pagein_task = task
+
+        def _done(t: asyncio.Task, _tenant: str = req[0]) -> None:
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                self._record_error(f"page-in:{_tenant}", exc)
+
+        task.add_done_callback(_done)
 
     def _train_tick(
         self, family: str, sl: int, scorer: ShardedScorer,
@@ -4035,13 +4509,21 @@ class TpuInferenceService(MultitenantService):
                 # probation: launch due probes for quarantined slices
                 # (no-op dict check on the healthy path)
                 self._probe_quarantined()
+            if self.pager is not None:
+                # weight paging: issue prefetches for rising-lag ghost
+                # tenants, then service ≤ 1 queued page-in — all device
+                # mutation stays OFF the flush critical path
+                self._paging_tick()
             for tenant, engine in list(self.engines.items()):
                 if engine.state is not LifecycleState.STARTED:
                     continue
                 assert isinstance(engine, TpuInferenceEngine)
-                if engine.placement is not None:
+                if engine.placement is not None and engine.placement.slot >= 0:
                     # register for flush even when throttled below: lanes
-                    # already holding this tenant's rows must still drain
+                    # already holding this tenant's rows must still drain.
+                    # Ghost (paged-out, slot=-1) tenants register nothing:
+                    # their rows park behind the paging fence and no slot
+                    # of theirs exists to flush or train
                     fam_cfgs.setdefault(
                         (engine.config.model, engine.placement.shard), {}
                     )[engine.placement.slot] = engine.config
@@ -4231,6 +4713,10 @@ class TpuInferenceService(MultitenantService):
             engine = self.engines.get(tenant)
             if engine is None or engine.placement is None:
                 return None
+            if engine.placement.slot < 0:
+                # paged out: the host byte cache is the source of truth
+                # (slot_params(-1) would read ANOTHER tenant's last slot)
+                return self._cached_params(tenant)
             scorer = self.scorers.get(
                 (engine.config.model, engine.placement.shard)
             )
@@ -4239,6 +4725,19 @@ class TpuInferenceService(MultitenantService):
             return scorer.slot_params(engine.placement.slot)
 
         return source
+
+    def _cached_params(self, tenant: str):
+        """Decode a paged-out tenant's params from its cache blob (host
+        numpy tree) — None when no blob exists (a pristine ghost)."""
+        if self.pager is None:
+            return None
+        entry = self.pager.cache.get(tenant)
+        if entry is None:
+            return None
+        from sitewhere_tpu.runtime.checkpoint import decode_segment
+
+        params, _opt = decode_segment(entry[0])
+        return params
 
     def snapshot_params(self) -> Dict[Tuple[str, str], object]:
         """Live param cut for checkpointing: (tenant, family) → param
@@ -4249,6 +4748,13 @@ class TpuInferenceService(MultitenantService):
         for tenant, engine in self.engines.items():
             assert isinstance(engine, TpuInferenceEngine)
             if engine.placement is None:
+                continue
+            if engine.placement.slot < 0:
+                # paged out: snapshot from the cache blob, not the
+                # device (slot -1 would alias another tenant's slot)
+                cached = self._cached_params(tenant)
+                if cached is not None:
+                    out[(tenant, engine.config.model)] = cached
                 continue
             scorer = self.scorers.get(
                 (engine.config.model, engine.placement.shard)
@@ -4280,4 +4786,5 @@ class TpuInferenceService(MultitenantService):
                 }
                 for (fam, sl), s in sorted(self.scorers.items())
             },
+            "paging": self.pager.stats() if self.pager is not None else None,
         }

@@ -62,6 +62,29 @@ def host_copy_params(params: Any) -> Any:
     )
 
 
+def encode_segment(params: Any, opt_state: Any = None) -> bytes:
+    """Encode one tenant's (params, opt-state) into checkpoint segment
+    bytes — the encoding the weight pager's host byte cache holds for
+    NON-RESIDENT tenants (runtime.paging): the same numpy-tree pickle
+    ``save_params`` writes, extended with the optimizer moments so a
+    train-lane tenant pages back in mid-descent. Trees must already be
+    host-materialized (``host_copy_params`` ON THE LOOP THREAD — the
+    donation hazard above applies identically here); encode itself is
+    pure bytes work, safe anywhere."""
+    return pickle.dumps(
+        {"params": params, "opt": opt_state},
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+
+
+def decode_segment(data: bytes) -> tuple:
+    """Decode :func:`encode_segment` bytes → (params, opt_state).
+    Restricted unpickler (runtime.safepickle) — same trust story as
+    ``load_params``."""
+    obj = safepickle.loads(data)
+    return obj["params"], obj.get("opt")
+
+
 class CheckpointManager:
     """Owns the data_dir layout; all methods are synchronous (callers
     off-load to an executor when on the event loop)."""

@@ -446,7 +446,7 @@ class _TenantOverloadState:
     __slots__ = (
         "policy", "deadline_budget_ms", "credit", "level",
         "above_since", "below_since", "expired_marks", "engaged_at",
-        "lag", "shed_recent",
+        "lag", "lag_prev", "shed_recent",
     )
 
     def __init__(self, policy: OverloadPolicy, deadline_budget_ms: float) -> None:
@@ -459,6 +459,7 @@ class _TenantOverloadState:
         self.expired_marks: deque = deque(maxlen=256)  # (epoch-s, n) drops
         self.engaged_at: Optional[float] = None
         self.lag = 0
+        self.lag_prev = 0  # previous refresh tick's lag (trend signal)
         self.shed_recent = 0
 
 
@@ -621,6 +622,7 @@ class OverloadController:
             if not pol.enabled:
                 continue
             lag = self._tenant_lag(tenant, lags)
+            st.lag_prev = st.lag
             st.lag = lag
             # credit: 1.0 at/below lo, linear to 0.0 at hi
             lo, hi = pol.credit_lag_lo, max(pol.credit_lag_hi, pol.credit_lag_lo + 1)
@@ -674,6 +676,30 @@ class OverloadController:
                 # clocks (hysteresis measures *sustained* pressure/calm)
                 st.above_since = None
                 st.below_since = None
+
+    # -- traffic signals (weight paging reads these) -----------------------
+    def tenant_lag(self, tenant: str) -> int:
+        """The tenant's pipeline consumer lag as of the last refresh
+        tick — the per-tenant traffic-rate signal the weight pager's
+        LRU eviction discounts by (runtime.paging: a lagging tenant is
+        about to need its slot)."""
+        st = self._tenants.get(tenant)
+        return st.lag if st is not None else 0
+
+    def lag_rising(self, tenant: str) -> bool:
+        """Did the tenant's lag GROW across the last two refresh ticks?
+        Rising lag on a non-resident tenant is the predictive-prefetch
+        trigger: rows are accumulating on the bus faster than they
+        drain, so page the weights in before the rows arrive."""
+        st = self._tenants.get(tenant)
+        return st is not None and st.lag > st.lag_prev
+
+    def rising_tenants(self):
+        """Tenants whose lag rose this tick (prefetch candidates)."""
+        return [
+            t for t, st in self._tenants.items()
+            if st.policy.enabled and st.lag > st.lag_prev and st.lag > 0
+        ]
 
     # -- introspection -----------------------------------------------------
     def report(self, tenant: str) -> Optional[dict]:

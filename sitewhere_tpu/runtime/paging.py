@@ -1,0 +1,371 @@
+"""Weight paging: virtualized tenant slots over an LRU-resident working set.
+
+A (family, mesh-slice) param stack holds ``slots_per_shard`` physical
+slots — a few dozen resident tenants per slice tops (ROADMAP open item
+2), which caps the tenants-per-chip axis the multi-chip stack was built
+to scale. This module decouples REGISTERED tenants from RESIDENT slots:
+
+- a :class:`SlotPager` per (family, slice) owns the LRU working set of
+  resident tenants (who holds a slot, when it was last touched, which
+  tenants are pinned);
+- non-resident tenants' params + opt-state live host-side in the
+  :class:`_HostByteCache` as already-encoded checkpoint segment bytes
+  (``runtime.checkpoint.encode_segment`` — the same numpy-tree pickle
+  the PR 7/16 checkpoint encoding uses);
+- the :class:`_PageInQueue` holds pending activation requests (demand:
+  rows arrived for a non-resident tenant and parked behind its paging
+  fence; prefetch: the OverloadController saw the tenant's bus lag
+  rising before any row was consumed);
+- :class:`WeightPager` is the service-level coordinator the inference
+  service drives: one byte cache + one request queue + the per-slice
+  pagers + the activation-latency / hit-rate / prefetch-accuracy
+  ledger the ``zipf512`` bench reports.
+
+The device work (stage → activate → fence retarget) stays in
+``pipeline.inference`` — this module is deliberately jax-free so the
+eviction policy and accounting are unit-testable without a mesh.
+
+Kill switch: flip :data:`WEIGHT_PAGING_ENABLED` to ``False`` BEFORE
+service construction (the ``FUSED_STEP_ENABLED`` pattern — captured at
+build) to restore physical-slot semantics bitwise: tenants beyond
+family capacity fail placement exactly as before, no pager objects
+exist, and no paging hook runs (docs/PERFORMANCE.md "Weight paging" →
+rollback).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Deque, Dict, Optional, Set, Tuple
+
+# Virtualized-slot kill switch (mirrors parallel.sharded.FUSED_STEP_ENABLED):
+# flip to False BEFORE TpuInferenceService construction to restore
+# physical-slot semantics bitwise — placement beyond capacity raises, no
+# paging state is allocated, every hook is a no-op.
+WEIGHT_PAGING_ENABLED = True
+
+# host byte-cache budget: encoded segments beyond this evict CLEAN
+# entries oldest-first (a dirty blob is the only copy of trained weights
+# and never silently drops — it leaves only through page-in or teardown)
+DEFAULT_CACHE_BYTES = 512 << 20
+
+# pending page-in requests the queue holds; prefetches shed beyond it
+# (demand requests always admit — parked rows must never strand behind
+# an unserviceable fence)
+DEFAULT_PENDING_CAP = 64
+
+# a prefetch "hit" = rows arrive for the tenant within this window after
+# its prefetch-origin activation landed
+PREFETCH_HIT_WINDOW_S = 30.0
+
+
+class _HostByteCache:
+    """Host-side blob store for paged-out tenants: tenant → (encoded
+    segment bytes, dirty). Bounded by bytes; overflow evicts CLEAN
+    entries oldest-first (they re-fetch from the checkpoint store at
+    page-in) and never dirty ones. Observability contract
+    (tools/check_queues): ``tpu_paging_cache_bytes`` /
+    ``tpu_paging_cache_entries`` gauges + ``tpu_paging.cache_evictions``
+    counter."""
+
+    def __init__(self, registry, cap_bytes: int = DEFAULT_CACHE_BYTES) -> None:
+        self.registry = registry
+        self.cap_bytes = int(cap_bytes)
+        self._blobs: "OrderedDict[str, Tuple[bytes, bool]]" = OrderedDict()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._blobs)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def commit_page_out(self, tenant: str, blob: bytes, dirty: bool) -> None:
+        """The page-out COMMIT: after this returns, the blob is the
+        tenant's source of truth (the device slot was already wiped).
+        Registered as the end of the evict→write-back→commit section in
+        tools/registries.py COMMIT_SECTIONS."""
+        old = self._blobs.pop(tenant, None)
+        if old is not None:
+            self._bytes -= len(old[0])
+        self._blobs[tenant] = (blob, bool(dirty))
+        self._bytes += len(blob)
+        while self._bytes > self.cap_bytes:
+            victim = next(
+                (t for t, (_b, d) in self._blobs.items() if not d), None
+            )
+            if victim is None:
+                break  # all dirty: over budget beats losing trained weights
+            b, _d = self._blobs.pop(victim)
+            self._bytes -= len(b)
+            self.registry.counter("tpu_paging.cache_evictions").inc()
+        self._export()
+
+    def get(self, tenant: str) -> Optional[Tuple[bytes, bool]]:
+        return self._blobs.get(tenant)
+
+    def pop(self, tenant: str) -> Optional[Tuple[bytes, bool]]:
+        entry = self._blobs.pop(tenant, None)
+        if entry is not None:
+            self._bytes -= len(entry[0])
+            self._export()
+        return entry
+
+    def mark_clean(self, tenant: str) -> None:
+        entry = self._blobs.get(tenant)
+        if entry is not None:
+            self._blobs[tenant] = (entry[0], False)
+
+    def _export(self) -> None:
+        self.registry.gauge("tpu_paging_cache_bytes").set(self._bytes)
+        self.registry.gauge("tpu_paging_cache_entries").set(len(self._blobs))
+
+
+class _PageInQueue:
+    """Bounded FIFO of pending page-in requests, deduplicated by tenant.
+    Demand requests always admit; prefetch requests shed when the queue
+    is at capacity (``tpu_paging.prefetch_shed``) — speculative work
+    must never crowd out rows already parked behind a fence. Depth is
+    the ``tpu_paging_pending`` gauge (tools/check_queues)."""
+
+    def __init__(self, registry, cap: int = DEFAULT_PENDING_CAP) -> None:
+        self.registry = registry
+        self.cap = int(cap)
+        self._q: Deque[Tuple[str, str, float]] = deque()
+        self._pending: Set[str] = set()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, tenant: str, origin: str, now: float) -> bool:
+        """Enqueue one activation request; False when deduplicated or
+        shed. ``origin`` is "demand" | "prefetch"."""
+        if tenant in self._pending:
+            return False
+        if origin == "prefetch" and len(self._q) >= self.cap:
+            self.registry.counter("tpu_paging.prefetch_shed").inc()
+            return False
+        self._q.append((tenant, origin, now))
+        self._pending.add(tenant)
+        self.registry.gauge("tpu_paging_pending").set(len(self._q))
+        return True
+
+    def pop(self) -> Optional[Tuple[str, str, float]]:
+        if not self._q:
+            return None
+        req = self._q.popleft()
+        self._pending.discard(req[0])
+        self.registry.gauge("tpu_paging_pending").set(len(self._q))
+        return req
+
+    def discard(self, tenant: str) -> None:
+        """Drop a tenant's pending request (engine stop mid-queue)."""
+        if tenant not in self._pending:
+            return
+        self._pending.discard(tenant)
+        self._q = deque(r for r in self._q if r[0] != tenant)
+        self.registry.gauge("tpu_paging_pending").set(len(self._q))
+
+
+class SlotPager:
+    """One (family, mesh-slice)'s LRU working set of resident tenants.
+    Pure bookkeeping — the service owns the device work; this object
+    answers "who is resident", "who was touched when", and "who is the
+    cheapest eviction"."""
+
+    def __init__(
+        self,
+        family: str,
+        mesh_slice: int,
+        capacity: int,
+        registry,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.family = family
+        self.mesh_slice = int(mesh_slice)
+        self.capacity = int(capacity)
+        self.registry = registry
+        self.clock = clock
+        # tenant → slot, insertion/touch order = LRU order (oldest first)
+        self._lru: "OrderedDict[str, int]" = OrderedDict()
+        self._last_touch: Dict[str, float] = {}
+        self.pinned: Set[str] = set()
+
+    def __contains__(self, tenant: str) -> bool:
+        return tenant in self._lru
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def residents(self):
+        """Tenants oldest-touch first (the LRU scan order)."""
+        return list(self._lru)
+
+    def slot_of(self, tenant: str) -> Optional[int]:
+        return self._lru.get(tenant)
+
+    def last_touch(self, tenant: str) -> float:
+        return self._last_touch.get(tenant, 0.0)
+
+    def touch(self, tenant: str) -> bool:
+        """Rows arrived for ``tenant``; True ⇔ resident (LRU refresh)."""
+        if tenant not in self._lru:
+            return False
+        self._lru.move_to_end(tenant)
+        self._last_touch[tenant] = self.clock()
+        return True
+
+    def note_resident(self, tenant: str, slot: int) -> None:
+        self._lru.pop(tenant, None)
+        self._lru[tenant] = int(slot)
+        self._last_touch[tenant] = self.clock()
+        self._export()
+
+    def drop(self, tenant: str) -> Optional[int]:
+        slot = self._lru.pop(tenant, None)
+        self._last_touch.pop(tenant, None)
+        self.pinned.discard(tenant)
+        self._export()
+        return slot
+
+    def pin(self, tenant: str) -> None:
+        """Exempt a tenant from eviction (latency-critical tenants an
+        operator never wants cold — docs/PERFORMANCE.md "when to pin")."""
+        self.pinned.add(tenant)
+
+    def unpin(self, tenant: str) -> None:
+        self.pinned.discard(tenant)
+
+    def eviction_score(
+        self, tenant: str, traffic: Callable[[str], float], now: float
+    ) -> float:
+        """LRU weighted by live traffic: idle seconds discounted by the
+        tenant's bus lag (the OverloadController's per-tenant pressure
+        signal) — between two equally idle tenants, evict the one the
+        bus is quietest about. Higher = better victim."""
+        idle = max(0.0, now - self._last_touch.get(tenant, 0.0))
+        return idle / (1.0 + max(0.0, float(traffic(tenant))))
+
+    def _export(self) -> None:
+        self.registry.gauge(
+            "score_paging_resident",
+            family=self.family, slice=str(self.mesh_slice),
+        ).set(len(self._lru))
+
+
+class WeightPager:
+    """Service-level paging coordinator: the host byte cache, the
+    page-in request queue, the per-(family, slice) pagers, and the
+    stats ledger (resident hit rate, page-in latency, prefetch
+    accuracy) the bench and ``describe()`` read."""
+
+    def __init__(
+        self,
+        registry,
+        cap_bytes: int = DEFAULT_CACHE_BYTES,
+        pending_cap: int = DEFAULT_PENDING_CAP,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self.clock = clock
+        self.cache = _HostByteCache(registry, cap_bytes)
+        self.queue = _PageInQueue(registry, pending_cap)
+        self.pagers: Dict[Tuple[str, int], SlotPager] = {}
+        self.hits = 0
+        self.misses = 0
+        self.prefetch_issued = 0
+        self.prefetch_hits = 0
+        self.pagein_ms: Deque[float] = deque(maxlen=1024)
+        self._prefetch_window: Dict[str, float] = {}
+        registry.describe(
+            "tpu_paging_cache_bytes",
+            "encoded param+opt segment bytes held host-side for "
+            "paged-out (non-resident) tenants",
+        )
+        registry.describe(
+            "tpu_paging_cache_entries",
+            "paged-out tenants with a host-side segment blob cached",
+        )
+        registry.describe(
+            "tpu_paging_pending",
+            "page-in requests queued (demand = rows parked behind a "
+            "paging fence; prefetch = rising bus lag)",
+        )
+        registry.describe(
+            "score_paging_resident",
+            "tenants currently RESIDENT (holding a physical slot) per "
+            "(family, mesh slice) — capacity minus this is free slots",
+        )
+        registry.describe(
+            "tenant_activation_ms",
+            "page-in request → activation landed, per family: the "
+            "cold-start SLO histogram (p99 gated as "
+            "cold_activation_p99_ms in the zipf512 bench)",
+        )
+
+    def slice_pager(self, family: str, sl: int, capacity: int) -> SlotPager:
+        key = (family, int(sl))
+        pager = self.pagers.get(key)
+        if pager is None:
+            pager = self.pagers[key] = SlotPager(
+                family, sl, capacity, self.registry, self.clock
+            )
+        return pager
+
+    # -- stats ledger -----------------------------------------------------
+    def note_touch(self, tenant: str, resident: bool) -> None:
+        """One enqueue-time residency check: feeds the hit rate and the
+        prefetch-accuracy window (a prefetch 'paid off' when rows arrive
+        while its window is open)."""
+        if resident:
+            self.hits += 1
+            deadline = self._prefetch_window.pop(tenant, None)
+            if deadline is not None and self.clock() <= deadline:
+                self.prefetch_hits += 1
+        else:
+            self.misses += 1
+
+    def note_activation(self, tenant: str, wait_ms: float, origin: str) -> None:
+        self.pagein_ms.append(float(wait_ms))
+        if origin == "prefetch":
+            self.prefetch_issued += 1
+            self._prefetch_window[tenant] = self.clock() + PREFETCH_HIT_WINDOW_S
+
+    def forget(self, tenant: str) -> None:
+        """Engine stop: drop every per-tenant paging artifact."""
+        self.cache.pop(tenant)
+        self.queue.discard(tenant)
+        self._prefetch_window.pop(tenant, None)
+        for pager in self.pagers.values():
+            if tenant in pager:
+                pager.drop(tenant)
+
+    def stats(self) -> dict:
+        """The bench/describe() roll-up."""
+        total = self.hits + self.misses
+        lat = sorted(self.pagein_ms)
+
+        def pct(q: float) -> Optional[float]:
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(q * len(lat)))], 3)
+
+        return {
+            "resident": {
+                f"{fam}/s{sl}": len(p)
+                for (fam, sl), p in sorted(self.pagers.items())
+            },
+            "cache_entries": len(self.cache),
+            "cache_bytes": self.cache.nbytes,
+            "pending": len(self.queue),
+            "hit_rate": round(self.hits / total, 4) if total else None,
+            "page_ins": len(self.pagein_ms),
+            "pagein_p50_ms": pct(0.50),
+            "pagein_p99_ms": pct(0.99),
+            "prefetch_accuracy": (
+                round(self.prefetch_hits / self.prefetch_issued, 4)
+                if self.prefetch_issued else None
+            ),
+        }

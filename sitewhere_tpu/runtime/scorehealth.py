@@ -284,20 +284,44 @@ class ScoreHealth:
         self._edges[family] = np.asarray(edges, np.float32)
         th = self._tenants.get(tenant)
         if th is not None and th.family == family:
-            # slot re-map (failover): keep distributions and reference
-            self._slots.pop((family, th.mesh_slice, th.slot), None)
+            # slot re-map (failover / page-in): keep distributions and
+            # reference. The old binding pops ONLY while it still maps
+            # to THIS tenant — after a page-out freed the slot, another
+            # tenant may hold the key by now, and an unguarded pop would
+            # silently sever the new occupant's sketch join.
+            old_key = (family, th.mesh_slice, th.slot)
+            if self._slots.get(old_key) == tenant:
+                del self._slots[old_key]
             th.slot = int(slot)
             th.mesh_slice = int(mesh_slice)
             if variant is not None:
                 th.variant = dict(variant)
         else:
             if th is not None:
-                self._slots.pop((th.family, th.mesh_slice, th.slot), None)
+                old_key = (th.family, th.mesh_slice, th.slot)
+                if self._slots.get(old_key) == tenant:
+                    del self._slots[old_key]
             th = self._tenants[tenant] = _TenantHealth(
                 tenant, family, int(slot), variant or {}, self.nbins,
                 self._clock(), mesh_slice=int(mesh_slice),
             )
         self._slots[(family, int(mesh_slice), int(slot))] = tenant
+
+    def unbind_slot(self, tenant: str) -> None:
+        """Page-out: release the tenant's (family, mesh_slice, slot)
+        join WITHOUT dropping health history — the frozen drift
+        reference and PSI windows survive non-residency exactly as they
+        survive failover re-maps, and ``register`` at the next page-in
+        re-binds the new slot. Guarded like ``register``'s re-map pop:
+        a stale binding never severs a slot another tenant took since
+        (runtime.paging / docs/OBSERVABILITY.md "Weight paging")."""
+        th = self._tenants.get(tenant)
+        if th is None:
+            return
+        key = (th.family, th.mesh_slice, th.slot)
+        if self._slots.get(key) == tenant:
+            del self._slots[key]
+        th.slot = -1
 
     def rebaseline(self, tenant: str) -> bool:
         """Drop the frozen reference and rolling windows — the warmup
@@ -337,7 +361,11 @@ class ScoreHealth:
         th = self._tenants.pop(tenant, None)
         if th is None:
             return
-        self._slots.pop((th.family, th.mesh_slice, th.slot), None)
+        key = (th.family, th.mesh_slice, th.slot)
+        if self._slots.get(key) == tenant:
+            # guarded like register's re-map pop: a paged-out tenant's
+            # remembered slot may belong to another tenant by now
+            del self._slots[key]
         # cardinality guard: a removed tenant's score-health gauges must
         # not be exported forever — scoped to THIS module's families
         self.registry.drop_labeled(

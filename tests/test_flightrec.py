@@ -629,6 +629,41 @@ def test_check_bench_classify_and_tolerances():
     assert regs == []
 
 
+def test_check_bench_gates_paging_keys():
+    """ISSUE 19 bench keys: the zipf512 density row's latency columns
+    gate as p99 (a doctored +50% cold-activation p99 must FAIL), the
+    acceptance ratio gates by name, throughput by suffix — while the
+    hit-rate / prefetch-accuracy companions stay info-class."""
+    assert check_bench.classify("zipf512_ev_s") == "throughput"
+    assert check_bench.classify("p99_zipf512_ms") == "p99"
+    assert check_bench.classify("cold_activation_p99_ms") == "p99"
+    assert check_bench.classify("zipf512_p99_ratio") == "p99"
+    assert check_bench.classify("zipf512_hit_rate") == "info"
+    assert check_bench.classify("zipf512_prefetch_acc") == "info"
+
+    base = {
+        "zipf512_ev_s": 10_000.0, "p99_zipf512_ms": 40.0,
+        "zipf512_p99_ratio": 1.1, "cold_activation_p99_ms": 20.0,
+        "zipf512_hit_rate": 0.9, "zipf512_prefetch_acc": 0.5,
+    }
+    # doctored regressions: +50% cold-activation p99, ratio 1.1 → 1.65
+    fresh = dict(base, cold_activation_p99_ms=30.0, zipf512_p99_ratio=1.65)
+    _, regs = check_bench.compare(fresh, base)
+    assert {r["key"] for r in regs} == {
+        "cold_activation_p99_ms", "zipf512_p99_ratio"
+    }
+    # -16% Zipf throughput gates; a hit-rate collapse reports info only
+    _, regs = check_bench.compare(
+        dict(base, zipf512_ev_s=8_400.0, zipf512_hit_rate=0.2), base
+    )
+    assert {r["key"] for r in regs} == {"zipf512_ev_s"}
+    # within tolerance: +20% on both latency keys stays clean
+    _, regs = check_bench.compare(
+        dict(base, p99_zipf512_ms=48.0, cold_activation_p99_ms=24.0), base
+    )
+    assert regs == []
+
+
 # -- (g) exposition lint additions ---------------------------------------
 
 

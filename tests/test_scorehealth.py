@@ -671,3 +671,57 @@ async def test_e2e_drift_fires_watchdog_healthy_tenants_quiet():
         assert not check_metrics.lint_exposition(
             inst.metrics.prometheus_text()
         )
+
+
+def test_page_out_rekey_preserves_reference_and_neighbor_binding():
+    """Weight paging (ISSUE 19): ``unbind_slot`` at page-out releases the
+    (family, slice, slot) join WITHOUT touching the frozen reference or
+    PSI window history, and the re-register at page-in re-maps the key
+    without severing a NEIGHBOR that took the freed slot in between —
+    the guarded-pop rule in ``ScoreHealth.register``."""
+    reg = MetricsRegistry()
+    sh = ScoreHealth(reg, window_rows=100, warmup_windows=2, skip_windows=1,
+                     min_eval_interval_s=0.0)
+    edges = sketch_edges()
+    sh.register("pa", "lstm_ad", 0, edges)
+
+    def ingest(slot, hist):
+        full = np.zeros((4, SKETCH_NBINS), np.int64)
+        full[slot] = hist
+        sh.ingest_sketch("lstm_ad", full)
+
+    base = np.zeros(SKETCH_NBINS, np.int64)
+    base[20:30] = 10  # 100 rows/window
+    for _ in range(3):                       # skip + warmup → frozen ref
+        ingest(0, base.copy())
+    assert sh.health_report("pa")["reference_rows"] == 200
+
+    # page-out: the join is released, history is not
+    sh.unbind_slot("pa")
+    rep = sh.health_report("pa")
+    assert rep["reference_rows"] == 200, "page-out reset the reference"
+    # slot 0 is free — the sketch plane's slot-0 row joins to nobody
+    ingest(0, base.copy())
+    assert sh.health_report("pa")["reference_rows"] == 200
+
+    # a neighbor pages IN to the freed slot
+    sh.register("pb", "lstm_ad", 0, edges)
+    ingest(0, base.copy())                   # pb's skip window
+    # pa pages back in on a DIFFERENT slot: the re-map must not pop
+    # pb's (family, 0, 0) binding (pa's remembered key) and must keep
+    # pa's frozen reference — no re-warmup after a residency gap
+    sh.register("pa", "lstm_ad", 2, edges)
+    ingest(0, base.copy())
+    ingest(2, base.copy())
+    rep_a, rep_b = sh.health_report("pa"), sh.health_report("pb")
+    assert rep_a["reference_rows"] == 200, "page-in re-warmed the reference"
+    assert rep_a["verdict"] == "ok"
+    for _ in range(2):
+        ingest(0, base.copy())               # pb finishes warmup intact
+    assert sh.health_report("pb")["reference_rows"] == 200, (
+        "pa's re-register severed pb's slot binding"
+    )
+    # double unbind is a no-op; unbind of an unknown tenant too
+    sh.unbind_slot("pa")
+    sh.unbind_slot("pa")
+    sh.unbind_slot("nobody")

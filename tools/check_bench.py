@@ -83,7 +83,12 @@ _INFO_EXACT = {"vit_wire_mbps"}
 # (the twins run back-to-back in one process, so common-mode rig drift
 # cancels in the ratio; chip baselines make it stable). train_ev_s (the
 # lane's replay-fed rows/s) gates via the _ev_s suffix rule.
-_P99_EXACT = {"serve_p99_train_delta"}
+# zipf512_p99_ratio (ISSUE 19): Zipf-mix p99 over 512 virtualized
+# tenants ÷ the all-resident 32-tenant row's p99, same rig/process —
+# the weight-paging acceptance figure (goal ≤ 1.2). Lower is better;
+# zipf512_ev_s / p99_zipf512_ms / cold_activation_p99_ms gate via the
+# suffix/prefix rules above (n/a against pre-paging baselines).
+_P99_EXACT = {"serve_p99_train_delta", "zipf512_p99_ratio"}
 
 
 def _is_latency_key(key: str) -> bool:

@@ -65,6 +65,24 @@ HOT_PATHS: Dict[str, List[str]] = {
         "_LaneRing.push",
         "_LaneRing.pop_into",
         "_SliceFence.park",
+        # weight paging: the evict path runs synchronously ON the event
+        # loop (no await may split the commit section) and the per-pass
+        # tick runs every scoring-loop iteration — both must stay free
+        # of list accumulators and blocking materialization beyond the
+        # single loop-thread host_copy the donation hazard requires
+        "TpuInferenceService._page_out",
+        "TpuInferenceService._paging_tick",
+    ],
+    # the weight-paging bookkeeping runs per enqueue (touch/hit-rate) and
+    # per page-in/evict: pure dict/deque ops, no per-row Python, no
+    # device round-trips (the module is deliberately jax-free)
+    "runtime/paging.py": [
+        "SlotPager.touch",
+        "SlotPager.note_resident",
+        "SlotPager.eviction_score",
+        "_HostByteCache.commit_page_out",
+        "_PageInQueue.push",
+        "WeightPager.note_touch",
     ],
     # the score-quality feed runs once per resolved flush at full ingest
     # rate: sketches fold in as vectorized 64-bin adds per touched slot,
@@ -245,6 +263,23 @@ QUEUE_REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {
         # bounded memory during an outage, loud loss accounting
         "shed_counter": "netbus_frames_lost_total",
     },
+    ("runtime/paging.py", r"self\.cache = _HostByteCache\("): {
+        "queue": "weight-paging host byte cache (encoded param+opt "
+                 "segments for paged-out tenants; bounded by cap_bytes)",
+        "depth_gauge": "tpu_paging_cache_entries",
+        # the byte watermark is the capacity signal: overflow evicts
+        # CLEAN blobs oldest-first (they re-fetch from the checkpoint
+        # store at page-in); dirty blobs never silently drop
+        "bytes_gauge": "tpu_paging_cache_bytes",
+        "shed_counter": "tpu_paging.cache_evictions",
+    },
+    ("runtime/paging.py", r"self\.queue = _PageInQueue\("): {
+        "queue": "page-in staging queue (pending tenant activations, "
+                 "deduplicated; demand always admits, prefetch sheds "
+                 "at capacity)",
+        "depth_gauge": "tpu_paging_pending",
+        "shed_counter": "tpu_paging.prefetch_shed",
+    },
     ("pipeline/inference.py", r"\[_StagingSet\("): {
         "queue": "per-(family, mesh-slice, bucket) rotating flush "
                  "staging sets (bounded by staging_slots per rotation)",
@@ -382,6 +417,17 @@ COMMIT_SECTIONS: Dict[str, List[Dict[str, str]]] = {
             "name": "reap-registry pop → gauge publish → permit release",
             "begin": "popleft",
             "end": "release",
+        },
+        {
+            # page-out atomicity: the host copy of the slot's weights,
+            # the slot wipe, the placement ghosting, and the byte-cache
+            # commit must land as one step — an await in between lets a
+            # flush (or a cancellation) observe a half-freed slot whose
+            # only weight copy is neither on device nor committed
+            "function": "TpuInferenceService._page_out",
+            "name": "evict (host copy → slot wipe → cache commit)",
+            "begin": "host_copy_params",
+            "end": "commit_page_out",
         },
     ],
     "runtime/bus.py": [
